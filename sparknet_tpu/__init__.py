@@ -1,0 +1,24 @@
+"""sparknet_tpu — a TPU-native distributed deep-learning framework.
+
+A ground-up JAX/XLA re-design of the capabilities of SparkNet
+(Moritz et al., ICLR 2016; reference: ShuaiW/SparkNet):
+
+- prototxt (``NetParameter``/``SolverParameter``) model configs compile to
+  jit-compiled XLA programs (ref: ``libccaffe/ccaffe.cpp``, ``caffe/src/caffe/net.cpp``);
+- the full Caffe solver family (SGD/Nesterov/AdaGrad/RMSProp/AdaDelta/Adam,
+  7 LR policies) as pure functional updates (ref: ``caffe/src/caffe/solvers/``);
+- distributed training over a ``jax.sharding.Mesh`` (``sparknet_tpu.parallel``):
+  fully-synchronous data parallelism via in-step ``psum`` on ICI, plus
+  SparkNet's tau-step local-SGD periodic model averaging as a configurable
+  communication-reduction mode (ref: ``src/main/scala/apps/CifarApp.scala:95-136``);
+- a host data plane (``sparknet_tpu.data``: loaders, transformer, minibatch
+  sampler, double-buffered device prefetch) replacing the Spark-RDD/
+  JNA-callback feed path (ref: ``caffe/src/caffe/layers/java_data_layer.cpp``).
+
+Layout is logically NCHW (Caffe blob semantics); XLA:TPU performs its own
+physical layout assignment, so no manual transposition is needed.
+"""
+
+__version__ = "0.1.0"
+
+from sparknet_tpu.common import Phase, get_config, set_config  # noqa: F401

@@ -1,0 +1,78 @@
+"""Global configuration and PRNG-key discipline.
+
+TPU-native analog of the per-thread ``Caffe`` singleton
+(ref: caffe/src/caffe/common.cpp:1-282, common.hpp:107-156): Brew mode,
+device selection, seeded RNG, and ``solver_count`` all collapse into a small
+immutable config plus explicit ``jax.random`` key threading — there is no
+hidden global RNG state on TPU; every stochastic op takes a key derived via
+``fold_in`` from (seed, iteration, layer-id).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import threading
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+class Phase(enum.Enum):
+    """Network phase (ref: caffe.proto ``enum Phase { TRAIN = 0; TEST = 1; }``)."""
+
+    TRAIN = 0
+    TEST = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    """Framework-wide numeric / device configuration.
+
+    ``compute_dtype`` is the activation dtype inside jitted programs; on TPU
+    bfloat16 keeps matmuls/convs on the MXU at full rate.  Params and
+    optimizer state stay in ``param_dtype`` (f32) — the mixed-precision
+    scheme XLA fuses casts for.  Tests run f32/f32 on CPU for exact
+    numerical gradient checks.
+    """
+
+    seed: int = 1  # ref: common.cpp set_random_seed
+    compute_dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    # Default mesh axis names: data parallelism over 'data', within-layer
+    # (tensor) sharding over 'model'.
+    data_axis: str = "data"
+    model_axis: str = "model"
+
+
+_lock = threading.Lock()
+_config = Config()
+
+
+def get_config() -> Config:
+    return _config
+
+
+def set_config(**overrides) -> Config:
+    """Replace fields of the global config; returns the new config."""
+    global _config
+    with _lock:
+        _config = dataclasses.replace(_config, **overrides)
+    return _config
+
+
+def root_key(seed: int | None = None) -> jax.Array:
+    """The root PRNG key for a run (ref: common.cpp:set_random_seed)."""
+    cfg = get_config()
+    return jax.random.key(cfg.seed if seed is None else seed)
+
+
+def step_key(key: jax.Array, step: jax.Array | int) -> jax.Array:
+    """Derive the per-iteration key — jit-safe (``step`` may be traced)."""
+    return jax.random.fold_in(key, step)
+
+
+def layer_key(key: jax.Array, layer_index: int) -> jax.Array:
+    """Derive a per-layer key from a step key (static layer index)."""
+    return jax.random.fold_in(key, layer_index)

@@ -1,0 +1,1 @@
+from sparknet_tpu.compiler.graph import Network, NetVars, filter_phase  # noqa: F401

@@ -1,0 +1,306 @@
+"""NetParameter -> jit-compilable network.
+
+TPU-native replacement for Caffe's Net DAG compiler/executor
+(ref: caffe/src/caffe/net.cpp: Init topological wiring :40-540,
+ForwardFromTo :565-583, BackwardFromTo :635-646).  Differences by design:
+
+- The "executor" is a pure function ``apply(variables, feeds)`` traced once
+  under ``jax.jit``; XLA does scheduling/fusion, so there is no layer loop
+  at runtime and no Forward/Backward ranges.
+- Backward is ``jax.grad`` of the scalar loss; Caffe's InsertSplits diff
+  accumulation (net.cpp:54) is what autodiff does natively, so no split
+  layers are materialized.
+- Blobs are dict entries during tracing; in-place prototxt tops (top ==
+  bottom) are plain rebinds, and XLA's buffer aliasing recovers the memory
+  sharing Caffe engineered by hand.
+
+Phase filtering follows NetStateRule semantics (net.cpp:287 FilterNet +
+StateMeetsRule: phase / min_level / max_level / stage / not_stage).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from sparknet_tpu.common import Phase, layer_key
+from sparknet_tpu.ops import create_layer
+from sparknet_tpu.ops.base import Layer, ParamSpec
+from sparknet_tpu.ops.data_layers import InputLayer
+from sparknet_tpu.proto.text_format import Message
+
+Params = dict[str, list[jax.Array]]
+State = dict[str, dict[str, jax.Array]]
+
+
+@dataclasses.dataclass
+class NetVars:
+    """All network variables: learnable params + mutable state (BN stats).
+
+    Registered as a pytree so it can cross jit boundaries directly."""
+
+    params: Params
+    state: State
+
+    def tree_flatten(self):
+        return (self.params, self.state), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+jax.tree_util.register_pytree_node(
+    NetVars, NetVars.tree_flatten, NetVars.tree_unflatten
+)
+
+
+def _rule_matches(rule: Message, phase: Phase, level: int, stages: set[str]) -> bool:
+    """ref: Net::StateMeetsRule (net.cpp:287+)."""
+    if rule.has("phase") and rule.get_str("phase") != phase.name:
+        return False
+    if rule.has("min_level") and level < rule.get_int("min_level"):
+        return False
+    if rule.has("max_level") and level > rule.get_int("max_level"):
+        return False
+    for s in rule.get_all("stage"):
+        if str(s) not in stages:
+            return False
+    for s in rule.get_all("not_stage"):
+        if str(s) in stages:
+            return False
+    return True
+
+
+def filter_phase(
+    net_param: Message,
+    phase: Phase,
+    level: int = 0,
+    stages: set[str] | None = None,
+) -> list[Message]:
+    """Select the layers active in ``phase`` (ref: Net::FilterNet)."""
+    stages = stages or set()
+    out = []
+    for lp in net_param.get_all("layer") or net_param.get_all("layers"):
+        includes = lp.get_all("include")
+        excludes = lp.get_all("exclude")
+        keep = True
+        if includes:
+            keep = any(_rule_matches(r, phase, level, stages) for r in includes)
+        elif excludes:
+            keep = not any(_rule_matches(r, phase, level, stages) for r in excludes)
+        if keep:
+            out.append(lp)
+    return out
+
+
+@dataclasses.dataclass
+class BlobInfo:
+    shape: tuple[int, ...]
+    dtype: Any
+
+
+class Network:
+    """A phase-specific compiled view of a NetParameter.
+
+    ``init(key, feed_shapes)`` -> NetVars;
+    ``apply(vars, feeds, rng)`` -> (blobs, new_state, total_loss).
+    Both are pure and jit-safe; ``apply`` is what pjit shards over the mesh.
+    """
+
+    def __init__(
+        self,
+        net_param: Message,
+        phase: Phase = Phase.TRAIN,
+        batch_override: int | None = None,
+    ):
+        self.net_param = net_param
+        self.phase = phase
+        self.name = net_param.get_str("name", "net")
+        self.batch_override = batch_override
+        self.layers: list[Layer] = [
+            create_layer(lp, phase) for lp in filter_phase(net_param, phase)
+        ]
+        seen: dict[str, int] = {}
+        for l in self.layers:
+            if l.name in seen:  # same-name layers across phases already filtered
+                raise ValueError(f"duplicate layer name {l.name!r} in phase {phase}")
+            seen[l.name] = 1
+        self.input_layers = [l for l in self.layers if isinstance(l, InputLayer)]
+        # External feed blobs: tops of input layers that aren't self-feeding.
+        self.feed_blobs: list[str] = []
+        for l in self.input_layers:
+            if not getattr(l, "SELF_FEEDING", False):
+                self.feed_blobs.extend(l.tops)
+        # net-level legacy inputs: `input: "data"` + input_shape/input_dim
+        self.net_inputs = self._net_level_inputs()
+        self.feed_blobs.extend(n for n, _ in self.net_inputs)
+        self._blob_info: dict[str, BlobInfo] | None = None
+
+    # -- legacy net-level inputs (ref: net.cpp AppendTop "deprecated 4D input
+    # dimensions" / input_shape) ------------------------------------------
+    def _net_level_inputs(self) -> list[tuple[str, tuple[int, ...] | None]]:
+        names = [str(s) for s in self.net_param.get_all("input")]
+        shapes: list[tuple[int, ...] | None] = []
+        shape_msgs = self.net_param.get_all("input_shape")
+        dims_flat = [int(d) for d in self.net_param.get_all("input_dim")]
+        for i, _ in enumerate(names):
+            if i < len(shape_msgs):
+                shapes.append(tuple(int(d) for d in shape_msgs[i].get_all("dim")))
+            elif dims_flat:
+                shapes.append(tuple(dims_flat[4 * i : 4 * i + 4]))
+            else:
+                shapes.append(None)
+        return list(zip(names, shapes))
+
+    # ------------------------------------------------------------------
+    def feed_shapes(self) -> dict[str, tuple[int, ...]]:
+        """Declared shapes for feed blobs (from layer params), where known."""
+        out: dict[str, tuple[int, ...]] = {}
+        for l in self.input_layers:
+            if getattr(l, "SELF_FEEDING", False):
+                continue
+            shapes = l.blob_shapes(self.batch_override)
+            if shapes:
+                for top, shape in zip(l.tops, shapes):
+                    out[top] = shape
+        for name, shape in self.net_inputs:
+            if shape:
+                out[name] = shape
+        return out
+
+    # ------------------------------------------------------------------
+    def init(
+        self,
+        key: jax.Array,
+        feed_shapes: dict[str, tuple[int, ...]] | None = None,
+        feed_dtypes: dict[str, Any] | None = None,
+    ) -> NetVars:
+        """Initialize params/state, propagating shapes layer by layer with
+        abstract evaluation (no FLOPs, no device memory)."""
+        shapes = dict(self.feed_shapes())
+        if feed_shapes:
+            shapes.update(feed_shapes)
+        dtypes = dict(feed_dtypes or {})
+        blob: dict[str, jax.ShapeDtypeStruct] = {}
+        for name in self.feed_blobs:
+            if name not in shapes:
+                raise ValueError(
+                    f"no shape known for input blob {name!r}; pass feed_shapes"
+                )
+            blob[name] = jax.ShapeDtypeStruct(shapes[name], dtypes.get(name, jnp.float32))
+        params: Params = {}
+        state: State = {}
+        for idx, layer in enumerate(self.layers):
+            sub = layer_key(key, idx)
+            if isinstance(layer, InputLayer):
+                if getattr(layer, "SELF_FEEDING", False):
+                    for top, val in zip(layer.tops, layer.constant_values()):
+                        blob[top] = jax.ShapeDtypeStruct(val.shape, val.dtype)
+                continue
+            in_shapes = [blob[b].shape for b in layer.bottoms]
+            p, s = layer.init(sub, in_shapes)
+            if p:
+                params[layer.name] = p
+            if s:
+                state[layer.name] = s
+            outs = self._abstract_apply(layer, p, s, [blob[b] for b in layer.bottoms])
+            for top, o in zip(layer.tops, outs):
+                blob[top] = jax.ShapeDtypeStruct(o.shape, o.dtype)
+        self._blob_info = {k: BlobInfo(v.shape, v.dtype) for k, v in blob.items()}
+        return NetVars(params=params, state=state)
+
+    def _abstract_apply(self, layer, p, s, in_structs):
+        train = self.phase == Phase.TRAIN
+
+        def f(p_, s_, xs):
+            return layer.apply(p_, s_, xs, train=train, rng=jax.random.key(0)).outputs
+
+        return jax.eval_shape(f, p, s, list(in_structs))
+
+    def blob_info(self) -> dict[str, BlobInfo]:
+        if self._blob_info is None:
+            raise RuntimeError("call init() first")
+        return self._blob_info
+
+    # ------------------------------------------------------------------
+    def apply(
+        self,
+        variables: NetVars,
+        feeds: dict[str, jax.Array],
+        rng: jax.Array | None = None,
+        *,
+        train: bool | None = None,
+    ) -> tuple[dict[str, jax.Array], State, jax.Array]:
+        """Forward pass. Returns (all blobs, updated state, total weighted loss).
+
+        ref: Net::ForwardFromTo (net.cpp:565-583) + loss accumulation
+        (layer.hpp Forward loss() * loss_weight)."""
+        train = (self.phase == Phase.TRAIN) if train is None else train
+        blob: dict[str, jax.Array] = {}
+        for name in self.feed_blobs:
+            if name not in feeds:
+                raise ValueError(f"missing feed for input blob {name!r}")
+            blob[name] = feeds[name]
+        new_state: State = {}
+        total_loss = jnp.zeros((), jnp.float32)
+        for idx, layer in enumerate(self.layers):
+            sub = layer_key(rng, idx) if rng is not None else None
+            if isinstance(layer, InputLayer):
+                if getattr(layer, "SELF_FEEDING", False):
+                    for top, val in zip(layer.tops, layer.constant_values()):
+                        blob[top] = val
+                continue
+            p = variables.params.get(layer.name, [])
+            s = variables.state.get(layer.name, {})
+            out = layer.apply(
+                p, s, [blob[b] for b in layer.bottoms], train=train, rng=sub
+            )
+            if out.state:
+                new_state[layer.name] = out.state
+            for top, o in zip(layer.tops, out.outputs):
+                blob[top] = o
+            for w, o in zip(layer.loss_weights(), out.outputs):
+                if w != 0.0:
+                    total_loss = total_loss + w * jnp.sum(o).astype(jnp.float32)
+        # carry forward unmodified state so the pytree structure is stable
+        for lname, s in variables.state.items():
+            new_state.setdefault(lname, s)
+        return blob, new_state, total_loss
+
+    # ------------------------------------------------------------------
+    def param_specs_for(self, variables: NetVars) -> dict[str, list[ParamSpec]]:
+        """lr_mult/decay_mult per blob per layer, for the solver
+        (ref: net.cpp:470+ AppendParam; params_lr_/params_weight_decay_)."""
+        return {
+            lname: next(l for l in self.layers if l.name == lname).param_specs(len(plist))
+            for lname, plist in variables.params.items()
+        }
+
+    def output_blobs(self) -> list[str]:
+        """Tops never consumed as a bottom — the net's outputs
+        (ref: net.cpp AppendTop/available_blobs bookkeeping; for a test net
+        these are what TestAndStoreResult accumulates, solver.cpp:414-444)."""
+        consumed = set()
+        for l in self.layers:
+            for b in l.bottoms:
+                if b not in l.tops:  # in-place use doesn't consume
+                    consumed.add(b)
+        outs: list[str] = []
+        for l in self.layers:
+            for t in l.tops:
+                if t not in consumed and t not in outs:
+                    outs.append(t)
+        return outs
+
+    def layer_by_name(self, name: str) -> Layer:
+        for l in self.layers:
+            if l.name == name:
+                return l
+        raise KeyError(name)
+
+    def __repr__(self):
+        return f"<Network {self.name!r} phase={self.phase.name} layers={len(self.layers)}>"

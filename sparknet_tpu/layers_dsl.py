@@ -1,0 +1,161 @@
+"""Programmatic model-definition DSL.
+
+Equivalent of the Scala layer constructors (ref:
+src/main/scala/libs/Layers.scala:18-137 — RDDLayer, ConvolutionLayer,
+PoolingLayer, InnerProductLayer, ReLULayer, SoftmaxWithLoss, NetParam) and
+of the README's LeNet example (ref: README.md:115-128).  Builders return
+``Message`` objects identical to parsed prototxt, so DSL-built and
+file-loaded models flow through the same compiler.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from sparknet_tpu.proto.text_format import Message
+
+
+def _layer(name: str, type_: str, bottoms: Sequence[str] = (), tops: Sequence[str] | None = None) -> Message:
+    m = Message()
+    m.set("name", name).set("type", type_)
+    for b in bottoms:
+        m.add("bottom", b)
+    for t in tops if tops is not None else [name]:
+        m.add("top", t)
+    return m
+
+
+def _filler(type_: str = "xavier", value: float | None = None, std: float | None = None) -> Message:
+    f = Message().set("type", type_)
+    if value is not None:
+        f.set("value", value)
+    if std is not None:
+        f.set("std", std)
+    return f
+
+
+def RDDLayer(name: str, shape: Sequence[int]) -> Message:
+    """Named input fed by the host data plane (the JavaData/RDD-callback
+    analog, ref: Layers.scala:18-40)."""
+    m = _layer(name, "JavaData", [], [name])
+    p = Message()
+    s = Message()
+    for d in shape:
+        s.add("dim", int(d))
+    p.add("shape", s)
+    m.set("java_data_param", p)
+    return m
+
+
+def MemoryDataLayer(name: str, batch: int, channels: int, height: int, width: int, tops=("data", "label")) -> Message:
+    m = _layer(name, "MemoryData", [], list(tops))
+    p = Message()
+    p.set("batch_size", batch).set("channels", channels).set("height", height).set("width", width)
+    m.set("memory_data_param", p)
+    return m
+
+
+def ConvolutionLayer(
+    name: str,
+    bottoms: Sequence[str],
+    kernel: tuple[int, int],
+    num_output: int,
+    stride: tuple[int, int] = (1, 1),
+    pad: tuple[int, int] = (0, 0),
+    group: int = 1,
+    weight_filler: Message | None = None,
+    bias_filler: Message | None = None,
+) -> Message:
+    """ref: Layers.scala:42-63."""
+    m = _layer(name, "Convolution", bottoms)
+    p = Message()
+    p.set("num_output", num_output)
+    p.set("kernel_h", kernel[0]).set("kernel_w", kernel[1])
+    p.set("stride_h", stride[0]).set("stride_w", stride[1])
+    p.set("pad_h", pad[0]).set("pad_w", pad[1])
+    if group != 1:
+        p.set("group", group)
+    p.set("weight_filler", weight_filler or _filler("xavier"))
+    p.set("bias_filler", bias_filler or _filler("constant", value=0.0))
+    m.set("convolution_param", p)
+    return m
+
+
+class Pooling:
+    Max = "MAX"
+    Ave = "AVE"
+
+
+def PoolingLayer(
+    name: str,
+    bottoms: Sequence[str],
+    pooling: str = Pooling.Max,
+    kernel: tuple[int, int] = (2, 2),
+    stride: tuple[int, int] = (2, 2),
+    pad: tuple[int, int] = (0, 0),
+) -> Message:
+    """ref: Layers.scala:65-86."""
+    m = _layer(name, "Pooling", bottoms)
+    p = Message()
+    p.set("pool", pooling)
+    p.set("kernel_h", kernel[0]).set("kernel_w", kernel[1])
+    p.set("stride_h", stride[0]).set("stride_w", stride[1])
+    if pad != (0, 0):
+        p.set("pad_h", pad[0]).set("pad_w", pad[1])
+    m.set("pooling_param", p)
+    return m
+
+
+def InnerProductLayer(
+    name: str,
+    bottoms: Sequence[str],
+    num_output: int,
+    weight_filler: Message | None = None,
+    bias_filler: Message | None = None,
+) -> Message:
+    """ref: Layers.scala:88-100."""
+    m = _layer(name, "InnerProduct", bottoms)
+    p = Message()
+    p.set("num_output", num_output)
+    p.set("weight_filler", weight_filler or _filler("xavier"))
+    p.set("bias_filler", bias_filler or _filler("constant", value=0.0))
+    m.set("inner_product_param", p)
+    return m
+
+
+def ReLULayer(name: str, bottoms: Sequence[str]) -> Message:
+    """ref: Layers.scala:102-113."""
+    return _layer(name, "ReLU", bottoms)
+
+
+def DropoutLayer(name: str, bottoms: Sequence[str], ratio: float = 0.5) -> Message:
+    m = _layer(name, "Dropout", bottoms)
+    m.set("dropout_param", Message().set("dropout_ratio", ratio))
+    return m
+
+
+def LRNLayer(name: str, bottoms: Sequence[str], local_size: int = 5, alpha: float = 1e-4, beta: float = 0.75) -> Message:
+    m = _layer(name, "LRN", bottoms)
+    p = Message().set("local_size", local_size).set("alpha", alpha).set("beta", beta)
+    m.set("lrn_param", p)
+    return m
+
+
+def SoftmaxWithLoss(name: str, bottoms: Sequence[str]) -> Message:
+    """ref: Layers.scala:115-128 (bottoms = [scores, label])."""
+    return _layer(name, "SoftmaxWithLoss", bottoms)
+
+
+def AccuracyLayer(name: str, bottoms: Sequence[str], top_k: int = 1) -> Message:
+    m = _layer(name, "Accuracy", bottoms)
+    if top_k != 1:
+        m.set("accuracy_param", Message().set("top_k", top_k))
+    return m
+
+
+def NetParam(name: str, *layers: Message) -> Message:
+    """Aggregate layers into a NetParameter (ref: Layers.scala:130-137)."""
+    net = Message().set("name", name)
+    for l in layers:
+        net.add("layer", l)
+    return net

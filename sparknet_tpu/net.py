@@ -1,0 +1,185 @@
+"""The framework's net-handle API.
+
+Parity surface for ``trait Net`` (ref: src/main/scala/libs/Net.scala:49-65:
+setTrainData/setTestData/train/test/forward/backward/setWeights/getWeights)
+and for ``WeightCollection`` (ref: Net.scala:14-47).
+
+TPU-native differences worth noting:
+- get/setWeights exchange whole device arrays (zero host work) instead of
+  the reference's float-by-float JNA Pointer loop — its measured hot spot
+  (ref: Net.scala:131-171, WeightCollectionSpec.scala:20-32).
+- ``forward``/``backward`` are views over one fused jitted program; there
+  is no separately schedulable backward pass on TPU, so ``backward()``
+  exposes the gradient pytree instead.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparknet_tpu.common import Phase
+from sparknet_tpu.compiler.graph import Network, NetVars
+from sparknet_tpu.proto.text_format import Message
+from sparknet_tpu.solvers.solver import Solver, SolverConfig
+
+
+class WeightCollection:
+    """Serializable {layer -> [arrays]} weight container — the object the
+    reference broadcasts/reduces between driver and workers
+    (ref: Net.scala:14-47).  Includes non-learnable state blobs (BatchNorm
+    stats) exactly as Caffe's blobs_ do."""
+
+    def __init__(self, weights: dict[str, list[np.ndarray]]):
+        self.weights = weights
+
+    def scalar_divide(self, v: float) -> "WeightCollection":
+        """ref: Net.scala:17-25 (in-place in the reference; pure here)."""
+        return WeightCollection(
+            {k: [a / v for a in arrs] for k, arrs in self.weights.items()}
+        )
+
+    def add(self, other: "WeightCollection") -> "WeightCollection":
+        """Structural-equality-checked elementwise add (ref: Net.scala:27-46)."""
+        assert set(self.weights) == set(other.weights), "layer sets differ"
+        out = {}
+        for k, arrs in self.weights.items():
+            assert len(arrs) == len(other.weights[k]), f"blob count differs at {k}"
+            out[k] = [a + b for a, b in zip(arrs, other.weights[k])]
+        return WeightCollection(out)
+
+    def __getitem__(self, layer: str) -> list[np.ndarray]:
+        return self.weights[layer]
+
+    def layers(self) -> list[str]:
+        return list(self.weights)
+
+
+def variables_to_collection(variables: NetVars) -> WeightCollection:
+    out: dict[str, list[np.ndarray]] = {}
+    for lname, plist in variables.params.items():
+        out[lname] = [np.asarray(p) for p in plist]
+    for lname, s in variables.state.items():
+        out.setdefault(lname, []).extend(np.asarray(v) for v in s.values())
+    return WeightCollection(out)
+
+
+def collection_to_variables(wc: WeightCollection, template: NetVars) -> NetVars:
+    params: dict[str, list] = {}
+    state: dict[str, dict] = {}
+    for lname, plist in template.params.items():
+        arrs = wc[lname]
+        params[lname] = [
+            jnp.asarray(a, p.dtype).reshape(p.shape) for a, p in zip(arrs, plist)
+        ]
+    for lname, s in template.state.items():
+        n_params = len(template.params.get(lname, []))
+        arrs = wc[lname][n_params:]
+        state[lname] = {
+            k: jnp.asarray(a, v.dtype).reshape(v.shape)
+            for (k, v), a in zip(s.items(), arrs)
+        }
+    return NetVars(params=params, state=state)
+
+
+class TPUNet:
+    """The CaffeNet-equivalent handle (ref: Net.scala:67-250): owns the
+    compiled train/test programs, the solver state, and the data hookups."""
+
+    def __init__(
+        self,
+        solver_param: Message | SolverConfig,
+        net_param: Message,
+        feed_shapes: dict[str, tuple] | None = None,
+        feed_dtypes: dict[str, Any] | None = None,
+    ):
+        self.solver = Solver(solver_param, net_param, feed_shapes, feed_dtypes)
+        self.train_net = self.solver.train_net
+        self.test_net = self.solver.test_net
+        self._train_iter: Iterator[dict] | None = None
+        self._test_iter: Iterator[dict] | None = None
+        self._test_len = 0
+        self._forward_fn = jax.jit(
+            lambda variables, feeds: self.test_net.apply(variables, feeds, rng=None, train=False)[0]
+        )
+
+    # -- data hookup (ref: Net.scala setTrainData/setTestData :78-100) ----
+    def set_train_data(self, batches: Iterator[dict] | Callable[[int], dict]):
+        """``batches``: iterator of feed dicts, or fn(iteration)->feed dict."""
+        self._train_iter = batches
+
+    def set_test_data(self, batches: Iterator[dict], length: int):
+        self._test_iter = batches
+        self._test_len = length
+
+    # -- training/eval (ref: Net.scala train :102-105, test :107-119) -----
+    def train(self, num_steps: int) -> float:
+        assert self._train_iter is not None, "call set_train_data first"
+        src = self._train_iter
+        if callable(src):
+            data_fn = src
+        else:
+            data_fn = lambda it: next(src)
+        return self.solver.step(num_steps, data_fn)
+
+    def test(self) -> dict[str, float]:
+        assert self._test_iter is not None, "call set_test_data first"
+        src = self._test_iter
+        data_fn = src if callable(src) else (lambda it: next(src))
+        return self.solver.test(self._test_len, data_fn)
+
+    # -- inference (ref: Net.scala forward :121-123 + getData :173-191) ---
+    def forward(self, feeds: dict[str, Any]) -> dict[str, jax.Array]:
+        """Forward on the TEST-phase graph; returns ALL blobs (the getData
+        dump the Featurizer uses, ref: FeaturizerApp.scala:88-102)."""
+        feeds = {k: jnp.asarray(v) for k, v in feeds.items()}
+        return self._forward_fn(self.solver.variables, feeds)
+
+    def backward(self, feeds: dict[str, Any]) -> dict[str, list[jax.Array]]:
+        """Gradient of the total loss wrt every param blob. On TPU the
+        forward+backward is one fused XLA program; this exposes the
+        gradient pytree (ref: Net.scala backward :125-127)."""
+        net = self.train_net
+        variables = self.solver.variables
+
+        def loss_fn(params):
+            _, _, loss = net.apply(
+                NetVars(params=params, state=variables.state),
+                {k: jnp.asarray(v) for k, v in feeds.items()},
+                rng=jax.random.key(0),
+            )
+            return loss
+
+        return jax.grad(loss_fn)(variables.params)
+
+    # -- weight exchange (ref: Net.scala:131-171) --------------------------
+    def get_weights(self) -> WeightCollection:
+        return variables_to_collection(self.solver.variables)
+
+    def set_weights(self, wc: WeightCollection) -> None:
+        self.solver.variables = collection_to_variables(wc, self.solver.variables)
+
+    # -- persistence (ref: Net.scala:234-240) ------------------------------
+    def save_weights_to_file(self, path: str) -> None:
+        flat = {}
+        for lname, arrs in self.get_weights().weights.items():
+            for i, a in enumerate(arrs):
+                flat[f"{lname}/{i}"] = a
+        np.savez(path if path.endswith(".npz") else path + ".npz", **flat)
+
+    def load_weights_from_file(self, path: str) -> None:
+        if not path.endswith(".npz"):
+            path = path + ".npz"
+        data = np.load(path)
+        weights: dict[str, list] = {}
+        order: dict[str, list[int]] = {}
+        for key in data.files:
+            lname, i = key.rsplit("/", 1)
+            weights.setdefault(lname, []).append(data[key])
+            order.setdefault(lname, []).append(int(i))
+        for lname in weights:
+            weights[lname] = [a for _, a in sorted(zip(order[lname], weights[lname]))]
+        self.set_weights(WeightCollection(weights))

@@ -1,0 +1,443 @@
+"""Common layers (ref: caffe/include/caffe/common_layers.hpp + layer impls).
+
+InnerProduct lands on the MXU as a single GEMM; shaping/routing layers
+(Concat/Slice/Flatten/Reshape/...) are free reshapes under XLA.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparknet_tpu.common import get_config
+from sparknet_tpu.ops import fillers
+from sparknet_tpu.ops.base import Layer, LayerOutput
+from sparknet_tpu.ops.registry import register
+
+
+def _canon_axis(axis: int, ndim: int) -> int:
+    return axis + ndim if axis < 0 else axis
+
+
+@register
+class InnerProduct(Layer):
+    """Fully connected (ref: inner_product_layer.cpp).  Flattens from
+    ``axis`` (default 1, i.e. C*H*W in NCHW order — this ordering is what
+    makes .caffemodel FC weights line up).  W blob: (num_output, dim)."""
+
+    TYPE = "InnerProduct"
+
+    def _conf(self):
+        p = self.lp.get_msg("inner_product_param")
+        return (
+            p.get_int("num_output"),
+            p.get_int("axis", 1),
+            p.get_bool("bias_term", True),
+            p.get_msg("weight_filler"),
+            p.get_msg("bias_filler"),
+        )
+
+    def init(self, key, in_shapes):
+        n_out, axis, bias, wf, bf = self._conf()
+        axis = _canon_axis(axis, len(in_shapes[0]))
+        dim = int(np.prod(in_shapes[0][axis:]))
+        kw, kb = jax.random.split(key)
+        dtype = get_config().param_dtype
+        params = [fillers.fill(wf, kw, (n_out, dim), dtype)]
+        if bias:
+            params.append(fillers.fill(bf, kb, (n_out,), dtype))
+        return params, {}
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        n_out, axis, bias, _, _ = self._conf()
+        x = inputs[0]
+        axis = _canon_axis(axis, x.ndim)
+        lead = x.shape[:axis]
+        flat = x.reshape((-1, int(np.prod(x.shape[axis:]))))
+        y = flat @ params[0].astype(x.dtype).T
+        if bias:
+            y = y + params[1].astype(x.dtype)
+        return LayerOutput([y.reshape(lead + (n_out,))])
+
+
+@register
+class BatchNorm(Layer):
+    """ref: batch_norm_layer.cpp (2015 Caffe: no learnable scale/shift —
+    pair with a Scale layer).  Mutable blobs [mean_sum, var_sum, scale_factor]
+    live in *state* but are exported in the weight collection for
+    .caffemodel parity; Caffe forces their lr_mult to 0 the same way."""
+
+    TYPE = "BatchNorm"
+
+    def init(self, key, in_shapes):
+        ch = in_shapes[0][1] if len(in_shapes[0]) > 1 else 1
+        dtype = get_config().param_dtype
+        state = {
+            "mean": jnp.zeros((ch,), dtype),
+            "variance": jnp.zeros((ch,), dtype),
+            "scale_factor": jnp.zeros((1,), dtype),
+        }
+        return [], state
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        p = self.lp.get_msg("batch_norm_param")
+        eps = p.get_float("eps", 1e-5)
+        frac = p.get_float("moving_average_fraction", 0.999)
+        use_global = p.get_bool("use_global_stats", not train)
+        x = inputs[0]
+        axes = (0,) + tuple(range(2, x.ndim))
+        if use_global:
+            scale = jnp.where(state["scale_factor"][0] == 0, 1.0, 1.0 / jnp.maximum(state["scale_factor"][0], 1e-30))
+            mean = state["mean"] * scale
+            var = state["variance"] * scale
+            new_state = state
+        else:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.mean(jnp.square(x), axis=axes) - jnp.square(mean)  # biased, E[x^2]-E[x]^2 as Caffe
+            new_state = {
+                "mean": state["mean"] * frac + mean.astype(state["mean"].dtype),
+                "variance": state["variance"] * frac + var.astype(state["variance"].dtype),
+                "scale_factor": state["scale_factor"] * frac + 1.0,
+            }
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        y = (x - mean.astype(x.dtype).reshape(shape)) / jnp.sqrt(var.astype(x.dtype).reshape(shape) + eps)
+        return LayerOutput([y], new_state)
+
+
+@register
+class Scale(Layer):
+    """Channel-wise scale (+ optional bias); companion of BatchNorm in
+    later zoo prototxts.  axis/num_axes control the broadcast shape."""
+
+    TYPE = "Scale"
+
+    def _shape(self, in_shapes):
+        p = self.lp.get_msg("scale_param")
+        axis = _canon_axis(p.get_int("axis", 1), len(in_shapes[0]))
+        num_axes = p.get_int("num_axes", 1)
+        if len(in_shapes) > 1:
+            return None, axis  # scale comes from second bottom
+        if num_axes == -1:
+            return tuple(in_shapes[0][axis:]), axis
+        return tuple(in_shapes[0][axis : axis + num_axes]), axis
+
+    def init(self, key, in_shapes):
+        p = self.lp.get_msg("scale_param")
+        shape, _ = self._shape(in_shapes)
+        dtype = get_config().param_dtype
+        params = []
+        if shape is None:
+            # scale arrives via the second bottom; a learnable bias (shaped
+            # like the bottom-supplied scale) may still be declared
+            if p.get_bool("bias_term", False):
+                bshape = tuple(in_shapes[1])
+                params.append(fillers.fill(p.get_msg("bias_filler"), key, bshape, dtype))
+            return params, {}
+        filler = p.get_msg("filler")
+        if not filler.has("type"):
+            filler = filler.copy()
+            filler.set("type", "constant").set("value", 1.0)
+        params.append(fillers.fill(filler, key, shape, dtype))
+        if p.get_bool("bias_term", False):
+            params.append(fillers.fill(p.get_msg("bias_filler"), key, shape, dtype))
+        return params, {}
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        x = inputs[0]
+        shape, axis = self._shape([i.shape for i in inputs])
+        if len(inputs) > 1:
+            scale, bias = inputs[1], (params[0] if params else None)
+        else:
+            scale, bias = params[0], (params[1] if len(params) > 1 else None)
+        bshape = (1,) * axis + tuple(scale.shape) + (1,) * (x.ndim - axis - scale.ndim)
+        y = x * scale.astype(x.dtype).reshape(bshape)
+        if bias is not None:
+            y = y + bias.astype(x.dtype).reshape(bshape)
+        return LayerOutput([y])
+
+
+@register
+class Bias(Layer):
+    """Channel-wise additive bias layer."""
+
+    TYPE = "Bias"
+
+    def init(self, key, in_shapes):
+        if len(in_shapes) > 1:
+            return [], {}
+        p = self.lp.get_msg("bias_param")
+        axis = _canon_axis(p.get_int("axis", 1), len(in_shapes[0]))
+        num_axes = p.get_int("num_axes", 1)
+        shape = tuple(in_shapes[0][axis:]) if num_axes == -1 else tuple(in_shapes[0][axis : axis + num_axes])
+        return [fillers.fill(p.get_msg("filler"), key, shape, get_config().param_dtype)], {}
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        x = inputs[0]
+        p = self.lp.get_msg("bias_param")
+        axis = _canon_axis(p.get_int("axis", 1), x.ndim)
+        b = inputs[1] if len(inputs) > 1 else params[0]
+        bshape = (1,) * axis + tuple(b.shape) + (1,) * (x.ndim - axis - b.ndim)
+        return LayerOutput([x + b.astype(x.dtype).reshape(bshape)])
+
+
+@register
+class Embed(Layer):
+    """Embedding lookup (ref: embed_layer.cpp): W blob (input_dim, num_output),
+    output shape = input shape + (num_output,)."""
+
+    TYPE = "Embed"
+
+    def init(self, key, in_shapes):
+        p = self.lp.get_msg("embed_param")
+        shape = (p.get_int("input_dim"), p.get_int("num_output"))
+        kw, kb = jax.random.split(key)
+        dtype = get_config().param_dtype
+        params = [fillers.fill(p.get_msg("weight_filler"), kw, shape, dtype)]
+        if p.get_bool("bias_term", True):
+            params.append(fillers.fill(p.get_msg("bias_filler"), kb, (shape[1],), dtype))
+        return params, {}
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        idx = inputs[0].astype(jnp.int32)
+        y = jnp.take(params[0], idx, axis=0)
+        if len(params) > 1:
+            y = y + params[1]
+        return LayerOutput([y])
+
+
+@register
+class Eltwise(Layer):
+    """PROD / SUM (with coeffs) / MAX over N bottoms (ref: eltwise_layer.cpp)."""
+
+    TYPE = "Eltwise"
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        p = self.lp.get_msg("eltwise_param")
+        op = p.get_str("operation", "SUM")
+        if op == "PROD":
+            y = inputs[0]
+            for x in inputs[1:]:
+                y = y * x
+        elif op == "MAX":
+            y = inputs[0]
+            for x in inputs[1:]:
+                y = jnp.maximum(y, x)
+        else:  # SUM
+            coeffs = [float(c) for c in p.get_all("coeff")] or [1.0] * len(inputs)
+            if len(coeffs) != len(inputs):
+                raise ValueError(
+                    f"Eltwise {self.name}: {len(coeffs)} coeffs for {len(inputs)} bottoms"
+                )
+            y = coeffs[0] * inputs[0]
+            for c, x in zip(coeffs[1:], inputs[1:]):
+                y = y + c * x
+        return LayerOutput([y])
+
+
+@register
+class Concat(Layer):
+    """ref: concat_layer.cpp (axis, legacy concat_dim)."""
+
+    TYPE = "Concat"
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        p = self.lp.get_msg("concat_param")
+        axis = p.get_int("axis", p.get_int("concat_dim", 1))
+        return LayerOutput([jnp.concatenate(inputs, axis=_canon_axis(axis, inputs[0].ndim))])
+
+
+@register
+class Slice(Layer):
+    """ref: slice_layer.cpp — slice_point list or equal split into #tops."""
+
+    TYPE = "Slice"
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        p = self.lp.get_msg("slice_param")
+        axis = _canon_axis(p.get_int("axis", p.get_int("slice_dim", 1)), inputs[0].ndim)
+        points = [int(s) for s in p.get_all("slice_point")]
+        x = inputs[0]
+        n_tops = len(self.tops)
+        if not points:
+            size = x.shape[axis] // n_tops
+            points = [size * i for i in range(1, n_tops)]
+        return LayerOutput(jnp.split(x, points, axis=axis))
+
+
+@register
+class Split(Layer):
+    """Identity fan-out (ref: split_layer.cpp).  Under autodiff the diff
+    accumulation Caffe inserts split layers for is automatic."""
+
+    TYPE = "Split"
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        return LayerOutput([inputs[0] for _ in self.tops])
+
+
+@register
+class Flatten(Layer):
+    """Flatten axis..end_axis (ref: flatten_layer.cpp)."""
+
+    TYPE = "Flatten"
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        p = self.lp.get_msg("flatten_param")
+        x = inputs[0]
+        axis = _canon_axis(p.get_int("axis", 1), x.ndim)
+        end = _canon_axis(p.get_int("end_axis", -1), x.ndim)
+        mid = int(np.prod(x.shape[axis : end + 1]))
+        return LayerOutput([x.reshape(x.shape[:axis] + (mid,) + x.shape[end + 1 :])])
+
+
+@register
+class Reshape(Layer):
+    """ref: reshape_layer.cpp — dims 0 (copy) and -1 (infer), axis/num_axes."""
+
+    TYPE = "Reshape"
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        p = self.lp.get_msg("reshape_param")
+        shape_msg = p.get_msg("shape")
+        dims = [int(d) for d in shape_msg.get_all("dim")]
+        x = inputs[0]
+        axis = _canon_axis(p.get_int("axis", 0), x.ndim)
+        num_axes = p.get_int("num_axes", -1)
+        end = x.ndim if num_axes == -1 else axis + num_axes
+        head, mid_in, tail = x.shape[:axis], x.shape[axis:end], x.shape[end:]
+        out_mid = []
+        for i, d in enumerate(dims):
+            if d == 0:
+                out_mid.append(mid_in[i])
+            else:
+                out_mid.append(d)
+        if -1 in out_mid:
+            known = int(np.prod([d for d in out_mid if d != -1]))
+            total = int(np.prod(mid_in)) if mid_in else 1
+            out_mid[out_mid.index(-1)] = total // max(known, 1)
+        return LayerOutput([x.reshape(head + tuple(out_mid) + tail)])
+
+
+@register
+class Tile(Layer):
+    """ref: tile_layer.cpp."""
+
+    TYPE = "Tile"
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        p = self.lp.get_msg("tile_param")
+        x = inputs[0]
+        axis = _canon_axis(p.get_int("axis", 1), x.ndim)
+        tiles = p.get_int("tiles")
+        reps = [1] * x.ndim
+        reps[axis] = tiles
+        return LayerOutput([jnp.tile(x, reps)])
+
+
+@register
+class ArgMax(Layer):
+    """ref: argmax_layer.cpp — per-sample top_k over flattened non-batch
+    dims; output (N, 1, top_k) or (N, 2, top_k) with out_max_val."""
+
+    TYPE = "ArgMax"
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        p = self.lp.get_msg("argmax_param")
+        top_k = p.get_int("top_k", 1)
+        out_max_val = p.get_bool("out_max_val", False)
+        x = inputs[0]
+        flat = x.reshape(x.shape[0], -1)
+        vals, idxs = jax.lax.top_k(flat, top_k)
+        idxs = idxs.astype(x.dtype)
+        if out_max_val:
+            y = jnp.stack([idxs, vals], axis=1)  # (N, 2, top_k)
+        else:
+            y = idxs[:, None, :]  # (N, 1, top_k)
+        return LayerOutput([y])
+
+
+@register
+class BatchReindex(Layer):
+    """output = x[permutation] (ref: batch_reindex_layer.cpp)."""
+
+    TYPE = "BatchReindex"
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        return LayerOutput([jnp.take(inputs[0], inputs[1].astype(jnp.int32), axis=0)])
+
+
+@register
+class Reduction(Layer):
+    """SUM/ASUM/SUMSQ/MEAN over tail dims from ``axis`` (ref: reduction_layer.cpp)."""
+
+    TYPE = "Reduction"
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        p = self.lp.get_msg("reduction_param")
+        op = p.get_str("operation", "SUM")
+        coeff = p.get_float("coeff", 1.0)
+        x = inputs[0]
+        axis = _canon_axis(p.get_int("axis", 0), x.ndim)
+        flat = x.reshape(x.shape[:axis] + (-1,)) if axis < x.ndim else x[..., None]
+        if op == "ASUM":
+            y = jnp.sum(jnp.abs(flat), axis=-1)
+        elif op == "SUMSQ":
+            y = jnp.sum(flat * flat, axis=-1)
+        elif op == "MEAN":
+            y = jnp.mean(flat, axis=-1)
+        else:
+            y = jnp.sum(flat, axis=-1)
+        return LayerOutput([coeff * y])
+
+
+@register
+class MVN(Layer):
+    """Mean-variance normalization per sample (ref: mvn_layer.cpp)."""
+
+    TYPE = "MVN"
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        p = self.lp.get_msg("mvn_param")
+        across = p.get_bool("across_channels", False)
+        norm_var = p.get_bool("normalize_variance", True)
+        eps = p.get_float("eps", 1e-9)
+        x = inputs[0]
+        axes = tuple(range(1, x.ndim)) if across else tuple(range(2, x.ndim))
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        y = x - mean
+        if norm_var:
+            std = jnp.sqrt(jnp.mean(jnp.square(y), axis=axes, keepdims=True))
+            y = y / (std + eps)
+        return LayerOutput([y])
+
+
+@register
+class Silence(Layer):
+    """Consumes bottoms, produces nothing (ref: silence_layer.cpp)."""
+
+    TYPE = "Silence"
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        return LayerOutput([])
+
+
+@register
+class Filter(Layer):
+    """ref: filter_layer.cpp — select items where the selector is nonzero.
+    Output batch size is data-dependent; jit requires static shapes, so in
+    compiled graphs this masks (zeroes) filtered items instead of dropping
+    them, and the eager path performs a true gather."""
+
+    TYPE = "Filter"
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        *data, selector = inputs
+        sel = selector.reshape(selector.shape[0])
+        if isinstance(sel, jax.core.Tracer):
+            mask = (sel != 0).astype(data[0].dtype)
+            outs = [x * mask.reshape((-1,) + (1,) * (x.ndim - 1)) for x in data]
+        else:
+            idx = jnp.nonzero(sel)[0]
+            outs = [jnp.take(x, idx, axis=0) for x in data]
+        return LayerOutput(outs)

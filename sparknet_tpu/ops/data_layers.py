@@ -1,0 +1,199 @@
+"""Data layers — graph *inputs*, not ops.
+
+In the reference these run the whole feed machinery (LMDB cursors, prefetch
+threads, the JVM-callback JavaDataLayer — ref:
+caffe/src/caffe/layers/java_data_layer.cpp:37-44, base_data_layer.cpp).
+TPU-native design: under jit, data layers declare named input blobs; the
+host data plane (sparknet_tpu.data) produces the arrays and the trainer
+feeds them as function arguments.  This removes the reference's #1 measured
+bottleneck, the per-minibatch FFI callback (~1.2 s/256-image batch, ref:
+src/test/scala/apps/CallbackBenchmarkSpec.scala:3-17).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from sparknet_tpu.ops.base import Layer, LayerOutput
+from sparknet_tpu.ops.registry import register
+
+
+class InputLayer(Layer):
+    """Base for all source layers: tops are fed externally."""
+
+    IS_INPUT = True
+
+    def blob_shapes(self, batch_override: int | None = None) -> list[tuple[int, ...]] | None:
+        """Static top shapes if declared in the prototxt, else None (shapes
+        come from the feed dict at trace time)."""
+        return None
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        # inputs arrive pre-bound from the feed dict, one per top
+        return LayerOutput(list(inputs))
+
+
+def _transform_shape(lp, base_shape):
+    """Apply transform_param crop to a declared (C,H,W)."""
+    crop = lp.get_msg("transform_param").get_int("crop_size", 0)
+    if crop and len(base_shape) == 3:
+        return (base_shape[0], crop, crop)
+    return base_shape
+
+
+@register
+class Data(InputLayer):
+    """LMDB/LevelDB-backed source in the reference (ref: data_layer.cpp);
+    here a named input whose batch size comes from data_param."""
+
+    TYPE = "Data"
+
+    def batch_size(self) -> int:
+        return self.lp.get_msg("data_param").get_int("batch_size", 0)
+
+
+@register
+class JavaData(InputLayer):
+    """SparkNet's RDD-callback layer (ref: java_data_layer.cpp;
+    proto JavaDataParameter caffe.proto:991-993).  Shapes are declared
+    inline: shape { dim: ... } repeated per top."""
+
+    TYPE = "JavaData"
+
+    def batch_size(self) -> int:
+        shapes = self.lp.get_msg("java_data_param").get_all("shape")
+        if shapes:
+            dims = [int(d) for d in shapes[0].get_all("dim")]
+            if dims:
+                return dims[0]
+        return 0
+
+    def blob_shapes(self, batch_override=None):
+        shapes = []
+        for s in self.lp.get_msg("java_data_param").get_all("shape"):
+            dims = tuple(int(d) for d in s.get_all("dim"))
+            if batch_override and dims:
+                dims = (batch_override,) + dims[1:]
+            shapes.append(dims)
+        return shapes or None
+
+
+@register
+class MemoryData(InputLayer):
+    """ref: memory_data_layer.cpp — declares (batch, C, H, W) + labels."""
+
+    TYPE = "MemoryData"
+
+    def batch_size(self) -> int:
+        return self.lp.get_msg("memory_data_param").get_int("batch_size", 0)
+
+    def blob_shapes(self, batch_override=None):
+        p = self.lp.get_msg("memory_data_param")
+        n = batch_override or p.get_int("batch_size")
+        c, h, w = p.get_int("channels"), p.get_int("height"), p.get_int("width")
+        return [(n, c, h, w), (n,)]
+
+
+@register
+class DummyData(InputLayer):
+    """Constant/filler-generated blobs (ref: dummy_data_layer.cpp).  Unlike
+    the other sources these are materialized at init and need no feeding."""
+
+    TYPE = "DummyData"
+
+    SELF_FEEDING = True
+
+    def blob_shapes(self, batch_override=None):
+        p = self.lp.get_msg("dummy_data_param")
+        shapes = []
+        shape_msgs = p.get_all("shape")
+        if shape_msgs:
+            for s in shape_msgs:
+                shapes.append(tuple(int(d) for d in s.get_all("dim")))
+        else:  # legacy num/channels/height/width (last value repeats)
+            nums = p.get_all("num")
+            chans = p.get_all("channels") or [1]
+            heights = p.get_all("height") or [1]
+            widths = p.get_all("width") or [1]
+            pick = lambda lst, i: int(lst[min(i, len(lst) - 1)])
+            for i in range(len(nums)):
+                shapes.append((int(nums[i]), pick(chans, i), pick(heights, i), pick(widths, i)))
+        # replicate last shape to cover all tops
+        while len(shapes) < len(self.tops):
+            shapes.append(shapes[-1])
+        return shapes
+
+    def constant_values(self):
+        from sparknet_tpu.ops import fillers
+        import jax
+
+        p = self.lp.get_msg("dummy_data_param")
+        fill_msgs = p.get_all("data_filler")
+        shapes = self.blob_shapes()
+        outs = []
+        key = jax.random.key(0)
+        for i, shape in enumerate(shapes[: len(self.tops)]):
+            f = fill_msgs[min(i, len(fill_msgs) - 1)] if fill_msgs else None
+            if f is None:
+                outs.append(jnp.zeros(shape, jnp.float32))
+            else:
+                key, sub = jax.random.split(key)
+                outs.append(fillers.fill(f, sub, shape))
+        return outs
+
+
+@register
+class ImageData(InputLayer):
+    """File-list image source (ref: image_data_layer.cpp) — feed-backed."""
+
+    TYPE = "ImageData"
+
+    def batch_size(self) -> int:
+        return self.lp.get_msg("image_data_param").get_int("batch_size", 0)
+
+
+@register
+class HDF5Data(InputLayer):
+    """ref: hdf5_data_layer.cpp — feed-backed."""
+
+    TYPE = "HDF5Data"
+
+    def batch_size(self) -> int:
+        return self.lp.get_msg("hdf5_data_param").get_int("batch_size", 0)
+
+
+@register
+class WindowData(InputLayer):
+    """ref: window_data_layer.cpp — feed-backed."""
+
+    TYPE = "WindowData"
+
+    def batch_size(self) -> int:
+        return self.lp.get_msg("window_data_param").get_int("batch_size", 0)
+
+
+@register
+class Input(InputLayer):
+    """Modern Caffe `Input` layer with input_param { shape {...} }."""
+
+    TYPE = "Input"
+
+    def blob_shapes(self, batch_override=None):
+        shapes = []
+        for s in self.lp.get_msg("input_param").get_all("shape"):
+            dims = tuple(int(d) for d in s.get_all("dim"))
+            if batch_override and dims:
+                dims = (batch_override,) + dims[1:]
+            shapes.append(dims)
+        return shapes or None
+
+
+@register
+class HDF5Output(Layer):
+    """ref: hdf5_output_layer.cpp — a sink; in-graph it's a no-op (the
+    trainer can fetch any blob by name instead of writing HDF5 mid-step)."""
+
+    TYPE = "HDF5Output"
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        return LayerOutput([])

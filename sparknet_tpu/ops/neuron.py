@@ -1,0 +1,179 @@
+"""Neuron (elementwise) layers (ref: caffe/src/caffe/layers/*_layer.cpp,
+decls caffe/include/caffe/neuron_layers.hpp).  All are single-op XLA
+elementwise kernels that fuse into neighboring matmuls/convs on TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from sparknet_tpu.common import get_config
+from sparknet_tpu.ops import fillers
+from sparknet_tpu.ops.base import Layer, LayerOutput
+from sparknet_tpu.ops.registry import register
+
+
+@register
+class ReLU(Layer):
+    """ref: relu_layer.cpp — supports leaky slope via ``negative_slope``."""
+
+    TYPE = "ReLU"
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        slope = self.lp.get_msg("relu_param").get_float("negative_slope", 0.0)
+        x = inputs[0]
+        y = jnp.maximum(x, 0) + slope * jnp.minimum(x, 0) if slope else jnp.maximum(x, 0)
+        return LayerOutput([y])
+
+
+@register
+class PReLU(Layer):
+    """ref: prelu_layer.cpp — learnable per-channel (or shared) slope.
+    Blob: (channels,) or (1,) if channel_shared. Default filler: constant 0.25."""
+
+    TYPE = "PReLU"
+
+    def init(self, key, in_shapes):
+        p = self.lp.get_msg("prelu_param")
+        shared = p.get_bool("channel_shared", False)
+        shape = (1,) if shared else (in_shapes[0][1],)
+        filler = p.get_msg("filler")
+        if not filler.has("type"):
+            filler = filler.copy()
+            filler.set("type", "constant").set("value", 0.25)
+        return [fillers.fill(filler, key, shape, get_config().param_dtype)], {}
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        x = inputs[0]
+        a = params[0].astype(x.dtype)
+        a = a.reshape((1, -1) + (1,) * (x.ndim - 2))
+        return LayerOutput([jnp.maximum(x, 0) + a * jnp.minimum(x, 0)])
+
+
+@register
+class Sigmoid(Layer):
+    TYPE = "Sigmoid"
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        return LayerOutput([jax.nn.sigmoid(inputs[0])])
+
+
+@register
+class TanH(Layer):
+    TYPE = "TanH"
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        return LayerOutput([jnp.tanh(inputs[0])])
+
+
+@register
+class AbsVal(Layer):
+    TYPE = "AbsVal"
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        return LayerOutput([jnp.abs(inputs[0])])
+
+
+@register
+class BNLL(Layer):
+    """y = log(1 + exp(x)), computed stably (ref: bnll_layer.cpp)."""
+
+    TYPE = "BNLL"
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        x = inputs[0]
+        return LayerOutput([jnp.maximum(x, 0) + jnp.log1p(jnp.exp(-jnp.abs(x)))])
+
+
+@register
+class Dropout(Layer):
+    """Inverted dropout: train-time scale by 1/(1-ratio), test = identity
+    (ref: dropout_layer.cpp:28-47)."""
+
+    TYPE = "Dropout"
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        ratio = self.lp.get_msg("dropout_param").get_float("dropout_ratio", 0.5)
+        x = inputs[0]
+        if not train or ratio == 0.0:
+            return LayerOutput([x])
+        assert rng is not None, f"Dropout layer {self.name} needs an rng in train mode"
+        keep = 1.0 - ratio
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return LayerOutput([jnp.where(mask, x / keep, 0).astype(x.dtype)])
+
+
+@register
+class Exp(Layer):
+    """y = base^(scale*x + shift) (ref: exp_layer.cpp)."""
+
+    TYPE = "Exp"
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        p = self.lp.get_msg("exp_param")
+        base = p.get_float("base", -1.0)
+        scale = p.get_float("scale", 1.0)
+        shift = p.get_float("shift", 0.0)
+        x = scale * inputs[0] + shift
+        y = jnp.exp(x) if base == -1.0 else jnp.power(base, x)
+        return LayerOutput([y])
+
+
+@register
+class Log(Layer):
+    """y = log_base(scale*x + shift) (ref: log_layer.cpp)."""
+
+    TYPE = "Log"
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        p = self.lp.get_msg("log_param")
+        base = p.get_float("base", -1.0)
+        scale = p.get_float("scale", 1.0)
+        shift = p.get_float("shift", 0.0)
+        y = jnp.log(scale * inputs[0] + shift)
+        if base != -1.0:
+            y = y / jnp.log(base)
+        return LayerOutput([y])
+
+
+@register
+class Power(Layer):
+    """y = (shift + scale*x)^power (ref: power_layer.cpp)."""
+
+    TYPE = "Power"
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        p = self.lp.get_msg("power_param")
+        power = p.get_float("power", 1.0)
+        scale = p.get_float("scale", 1.0)
+        shift = p.get_float("shift", 0.0)
+        y = shift + scale * inputs[0]
+        if power != 1.0:
+            y = jnp.power(y, power)
+        return LayerOutput([y])
+
+
+@register
+class Threshold(Layer):
+    """y = (x > threshold) (ref: threshold_layer.cpp)."""
+
+    TYPE = "Threshold"
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        t = self.lp.get_msg("threshold_param").get_float("threshold", 0.0)
+        x = inputs[0]
+        return LayerOutput([(x > t).astype(x.dtype)])
+
+
+@register
+class ELU(Layer):
+    """y = x if x>0 else alpha*(exp(x)-1). Not in the 2015 reference layer
+    set but kept for zoo compatibility with later prototxts."""
+
+    TYPE = "ELU"
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        alpha = self.lp.get_msg("elu_param").get_float("alpha", 1.0)
+        x = inputs[0]
+        return LayerOutput([jnp.where(x > 0, x, alpha * jnp.expm1(x))])

@@ -1,0 +1,84 @@
+"""Layer registry keyed by prototxt ``type`` string.
+
+Analog of Caffe's ``LayerRegistry``/``REGISTER_LAYER_CREATOR`` (ref:
+caffe/src/caffe/layer_factory.cpp:41-214).  On TPU there is no
+cuDNN-vs-native engine choice to make — XLA owns kernel selection — so the
+registry is a flat name->class map.  Legacy V1 ALL_CAPS type names (from
+pre-2015 prototxts) are aliased to their modern names, playing the role of
+``upgrade_proto.cpp``'s V1->V2 layer-type migration.
+"""
+
+from __future__ import annotations
+
+from sparknet_tpu.common import Phase
+from sparknet_tpu.ops.base import Layer
+from sparknet_tpu.proto.text_format import Message
+
+_REGISTRY: dict[str, type[Layer]] = {}
+
+# ref: caffe/src/caffe/util/upgrade_proto.cpp UpgradeV1LayerType
+_V1_ALIASES = {
+    "CONVOLUTION": "Convolution",
+    "DECONVOLUTION": "Deconvolution",
+    "POOLING": "Pooling",
+    "LRN": "LRN",
+    "RELU": "ReLU",
+    "PRELU": "PReLU",
+    "SIGMOID": "Sigmoid",
+    "TANH": "TanH",
+    "ABSVAL": "AbsVal",
+    "BNLL": "BNLL",
+    "DROPOUT": "Dropout",
+    "EXP": "Exp",
+    "POWER": "Power",
+    "THRESHOLD": "Threshold",
+    "INNER_PRODUCT": "InnerProduct",
+    "CONCAT": "Concat",
+    "SLICE": "Slice",
+    "SPLIT": "Split",
+    "FLATTEN": "Flatten",
+    "RESHAPE": "Reshape",
+    "ELTWISE": "Eltwise",
+    "ARGMAX": "ArgMax",
+    "MVN": "MVN",
+    "SILENCE": "Silence",
+    "ACCURACY": "Accuracy",
+    "SOFTMAX": "Softmax",
+    "SOFTMAX_LOSS": "SoftmaxWithLoss",
+    "EUCLIDEAN_LOSS": "EuclideanLoss",
+    "HINGE_LOSS": "HingeLoss",
+    "INFOGAIN_LOSS": "InfogainLoss",
+    "CONTRASTIVE_LOSS": "ContrastiveLoss",
+    "MULTINOMIAL_LOGISTIC_LOSS": "MultinomialLogisticLoss",
+    "SIGMOID_CROSS_ENTROPY_LOSS": "SigmoidCrossEntropyLoss",
+    "DATA": "Data",
+    "IMAGE_DATA": "ImageData",
+    "HDF5_DATA": "HDF5Data",
+    "HDF5_OUTPUT": "HDF5Output",
+    "MEMORY_DATA": "MemoryData",
+    "WINDOW_DATA": "WindowData",
+    "DUMMY_DATA": "DummyData",
+}
+
+
+def register(cls: type[Layer]) -> type[Layer]:
+    assert cls.TYPE, f"{cls} missing TYPE"
+    _REGISTRY[cls.TYPE] = cls
+    return cls
+
+
+def get_layer_class(type_name: str) -> type[Layer]:
+    type_name = _V1_ALIASES.get(type_name, type_name)
+    if type_name not in _REGISTRY:
+        raise KeyError(
+            f"Unknown layer type {type_name!r}. Registered: {sorted(_REGISTRY)}"
+        )
+    return _REGISTRY[type_name]
+
+
+def create_layer(lp: Message, phase: Phase) -> Layer:
+    return get_layer_class(lp.get_str("type"))(lp, phase)
+
+
+def registered_types() -> list[str]:
+    return sorted(_REGISTRY)

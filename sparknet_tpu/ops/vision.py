@@ -1,0 +1,322 @@
+"""Vision layers: Convolution, Deconvolution, Pooling, LRN, Im2col, SPP.
+
+TPU mapping: where the reference lowers conv via im2col+GEMM or cuDNN
+(ref: caffe/src/caffe/layers/base_conv_layer.cpp, util/im2col.cu), we emit a
+single ``lax.conv_general_dilated`` and let XLA:TPU tile it onto the MXU.
+Blob layout is logical NCHW (OIHW weights) for Caffe weight-format parity;
+XLA chooses physical layouts.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparknet_tpu.common import get_config
+from sparknet_tpu.ops import fillers
+from sparknet_tpu.ops.base import (
+    Layer,
+    LayerOutput,
+    conv_out_dim,
+    hw_param,
+    pool_out_dim,
+)
+from sparknet_tpu.ops.registry import register
+
+_DIMNUMS = ("NCHW", "OIHW", "NCHW")
+
+
+@register
+class Convolution(Layer):
+    """ref: caffe/src/caffe/layers/conv_layer.cpp + base_conv_layer.cpp.
+
+    Supports kernel/stride/pad (square or _h/_w), group, dilation, bias_term.
+    Weight blob OIHW = (num_output, in_channels/group, kh, kw); bias (num_output,).
+    """
+
+    TYPE = "Convolution"
+
+    def _conf(self):
+        p = self.lp.get_msg("convolution_param")
+        kh, kw = hw_param(p, "kernel")
+        sh, sw = hw_param(p, "stride", default=1)
+        ph, pw = hw_param(p, "pad", default=0)
+        return dict(
+            num_output=p.get_int("num_output"),
+            group=p.get_int("group", 1),
+            dilation=p.get_int("dilation", 1),
+            bias=p.get_bool("bias_term", True),
+            kernel=(kh, kw),
+            stride=(sh, sw),
+            pad=(ph, pw),
+            weight_filler=p.get_msg("weight_filler"),
+            bias_filler=p.get_msg("bias_filler"),
+        )
+
+    def init(self, key, in_shapes):
+        c = self._conf()
+        n, ch = in_shapes[0][0], in_shapes[0][1]
+        assert ch % c["group"] == 0, f"{self.name}: channels {ch} % group {c['group']}"
+        wshape = (c["num_output"], ch // c["group"], *c["kernel"])
+        kw, kb = jax.random.split(key)
+        dtype = get_config().param_dtype
+        params = [fillers.fill(c["weight_filler"], kw, wshape, dtype)]
+        if c["bias"]:
+            params.append(fillers.fill(c["bias_filler"], kb, (c["num_output"],), dtype))
+        return params, {}
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        c = self._conf()
+        x = inputs[0]
+        w = params[0].astype(x.dtype)
+        d = c["dilation"]
+        y = jax.lax.conv_general_dilated(
+            x,
+            w,
+            window_strides=c["stride"],
+            padding=[(c["pad"][0], c["pad"][0]), (c["pad"][1], c["pad"][1])],
+            rhs_dilation=(d, d),
+            dimension_numbers=_DIMNUMS,
+            feature_group_count=c["group"],
+        )
+        if c["bias"]:
+            y = y + params[1].astype(x.dtype)[None, :, None, None]
+        return LayerOutput([y])
+
+
+@register
+class Deconvolution(Convolution):
+    """Transposed convolution (ref: caffe/src/caffe/layers/deconv_layer.cpp).
+
+    Caffe weight blob shape is (in_channels, num_output/group, kh, kw); the
+    forward pass is conv-backward-data: out = stride*(in-1) + dil*(k-1)+1 - 2*pad.
+    """
+
+    TYPE = "Deconvolution"
+
+    def init(self, key, in_shapes):
+        c = self._conf()
+        ch = in_shapes[0][1]
+        wshape = (ch, c["num_output"] // c["group"], *c["kernel"])
+        kw, kb = jax.random.split(key)
+        dtype = get_config().param_dtype
+        params = [fillers.fill(c["weight_filler"], kw, wshape, dtype)]
+        if c["bias"]:
+            params.append(fillers.fill(c["bias_filler"], kb, (c["num_output"],), dtype))
+        return params, {}
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        c = self._conf()
+        x = inputs[0]
+        g = c["group"]
+        d = c["dilation"]
+        w = params[0].astype(x.dtype)  # (Cin, Cout/g, kh, kw)
+        cin = w.shape[0]
+        # Regroup to OIHW for the equivalent forward conv: for each group,
+        # transpose (Cin/g, Cout/g) -> (Cout/g, Cin/g) and flip spatial dims.
+        wg = w.reshape(g, cin // g, w.shape[1], *c["kernel"])
+        wg = jnp.flip(wg, axis=(-2, -1)).transpose(0, 2, 1, 3, 4)
+        w_oihw = wg.reshape(g * w.shape[1], cin // g, *c["kernel"])
+        ke_h = d * (c["kernel"][0] - 1) + 1
+        ke_w = d * (c["kernel"][1] - 1) + 1
+        y = jax.lax.conv_general_dilated(
+            x,
+            w_oihw,
+            window_strides=(1, 1),
+            padding=[
+                (ke_h - 1 - c["pad"][0], ke_h - 1 - c["pad"][0]),
+                (ke_w - 1 - c["pad"][1], ke_w - 1 - c["pad"][1]),
+            ],
+            lhs_dilation=c["stride"],
+            rhs_dilation=(d, d),
+            dimension_numbers=_DIMNUMS,
+            feature_group_count=g,
+        )
+        if c["bias"]:
+            y = y + params[1].astype(x.dtype)[None, :, None, None]
+        return LayerOutput([y])
+
+
+@functools.lru_cache(maxsize=64)
+def _ave_pool_divisor(h: int, w: int, kh: int, kw: int, sh: int, sw: int, ph: int, pw: int):
+    """Caffe AVE-pool divisor: window size measured in *padded* coordinates,
+    clipped at (H+pad, W+pad) — includes padding on the leading edge
+    (ref: pooling_layer.cpp Forward_cpu AVE branch)."""
+    oh = pool_out_dim(h, kh, ph, sh)
+    ow = pool_out_dim(w, kw, pw, sw)
+    hs = np.arange(oh) * sh - ph
+    ws = np.arange(ow) * sw - pw
+    hlen = np.minimum(hs + kh, h + ph) - hs
+    wlen = np.minimum(ws + kw, w + pw) - ws
+    return np.outer(hlen, wlen).astype(np.float32)
+
+
+def caffe_avg_pool(x, kernel, stride, pad):
+    """Average pooling with Caffe's ceil shapes and padded-divisor rule."""
+    h, w = x.shape[2], x.shape[3]
+    kh, kw = kernel
+    sh, sw = stride
+    ph, pw = pad
+    oh = pool_out_dim(h, kh, ph, sh)
+    ow = pool_out_dim(w, kw, pw, sw)
+    # Pad enough on the trailing edge for ceil-mode windows.
+    extra_h = max(0, (oh - 1) * sh + kh - h - ph)
+    extra_w = max(0, (ow - 1) * sw + kw - w - pw)
+    # NB: init must be a Python scalar, not an Array — an Array init value
+    # breaks reverse-mode linearization under jit (jax 0.9).
+    summed = jax.lax.reduce_window(
+        x,
+        0.0 if jnp.issubdtype(x.dtype, jnp.floating) else 0,
+        jax.lax.add,
+        window_dimensions=(1, 1, kh, kw),
+        window_strides=(1, 1, sh, sw),
+        padding=((0, 0), (0, 0), (ph, extra_h), (pw, extra_w)),
+    )
+    div = jnp.asarray(_ave_pool_divisor(h, w, kh, kw, sh, sw, ph, pw), x.dtype)
+    return summed / div[None, None]
+
+
+def caffe_max_pool(x, kernel, stride, pad):
+    h, w = x.shape[2], x.shape[3]
+    kh, kw = kernel
+    sh, sw = stride
+    ph, pw = pad
+    oh = pool_out_dim(h, kh, ph, sh)
+    ow = pool_out_dim(w, kw, pw, sw)
+    extra_h = max(0, (oh - 1) * sh + kh - h - ph)
+    extra_w = max(0, (ow - 1) * sw + kw - w - pw)
+    neg_inf = float("-inf") if jnp.issubdtype(x.dtype, jnp.floating) else int(jnp.iinfo(x.dtype).min)
+    return jax.lax.reduce_window(
+        x,
+        neg_inf,
+        jax.lax.max,
+        window_dimensions=(1, 1, kh, kw),
+        window_strides=(1, 1, sh, sw),
+        padding=((0, 0), (0, 0), (ph, extra_h), (pw, extra_w)),
+    )
+
+
+@register
+class Pooling(Layer):
+    """MAX / AVE pooling with Caffe ceil-mode shapes; ``global_pooling``
+    collapses the spatial dims (ref: caffe/src/caffe/layers/pooling_layer.cpp).
+    STOCHASTIC pooling falls back to MAX (ref trains the zoo nets without it).
+    """
+
+    TYPE = "Pooling"
+
+    def _conf(self, in_shape):
+        p = self.lp.get_msg("pooling_param")
+        if p.get_bool("global_pooling", False):
+            kernel = (in_shape[2], in_shape[3])
+            stride, pad = (1, 1), (0, 0)
+        else:
+            kernel = hw_param(p, "kernel")
+            stride = hw_param(p, "stride", default=1)
+            pad = hw_param(p, "pad", default=0)
+        return p.get_str("pool", "MAX"), kernel, stride, pad
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        x = inputs[0]
+        method, kernel, stride, pad = self._conf(x.shape)
+        if method == "AVE":
+            y = caffe_avg_pool(x, kernel, stride, pad)
+        else:  # MAX (and STOCHASTIC fallback)
+            y = caffe_max_pool(x, kernel, stride, pad)
+        return LayerOutput([y])
+
+
+@register
+class LRN(Layer):
+    """Local response normalization (ref: caffe/src/caffe/layers/lrn_layer.cpp).
+
+    ACROSS_CHANNELS: y = x / (k + alpha/n * sum_{window n} x^2)^beta
+    WITHIN_CHANNEL:  y = x * (1 + alpha * avepool_{n x n}(x^2))^(-beta)
+    (the within-channel form composes Caffe's Power/AVE-Pool/Eltwise stack,
+    where the AVE pool uses pad=(n-1)/2 and the Caffe padded divisor).
+    """
+
+    TYPE = "LRN"
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        p = self.lp.get_msg("lrn_param")
+        size = p.get_int("local_size", 5)
+        alpha = p.get_float("alpha", 1.0)
+        beta = p.get_float("beta", 0.75)
+        k = p.get_float("k", 1.0)
+        region = p.get_str("norm_region", "ACROSS_CHANNELS")
+        x = inputs[0]
+        if region == "WITHIN_CHANNEL":
+            pre_pad = (size - 1) // 2
+            pooled = caffe_avg_pool(x * x, (size, size), (1, 1), (pre_pad, pre_pad))
+            y = x * jnp.power(1.0 + alpha * pooled, -beta)
+            return LayerOutput([y])
+        # ACROSS_CHANNELS: sliding sum over the channel axis.
+        sq = x * x
+        pad = (size - 1) // 2
+        summed = jax.lax.reduce_window(
+            sq,
+            0.0,
+            jax.lax.add,
+            window_dimensions=(1, size, 1, 1),
+            window_strides=(1, 1, 1, 1),
+            padding=((0, 0), (pad, size - 1 - pad), (0, 0), (0, 0)),
+        )
+        scale = k + (alpha / size) * summed
+        return LayerOutput([x * jnp.power(scale, -beta)])
+
+
+@register
+class Im2col(Layer):
+    """Explicit im2col lowering exposed as a layer for parity
+    (ref: caffe/src/caffe/layers/im2col_layer.cpp).  On TPU this is a
+    patch-extraction reshape; nobody should use it for conv — XLA does."""
+
+    TYPE = "Im2col"
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        p = self.lp.get_msg("convolution_param")
+        kh, kw = hw_param(p, "kernel")
+        sh, sw = hw_param(p, "stride", default=1)
+        ph, pw = hw_param(p, "pad", default=0)
+        x = inputs[0]
+        n, c, h, w = x.shape
+        oh = conv_out_dim(h, kh, ph, sh)
+        ow = conv_out_dim(w, kw, pw, sw)
+        patches = jax.lax.conv_general_dilated_patches(
+            x,
+            filter_shape=(kh, kw),
+            window_strides=(sh, sw),
+            padding=[(ph, ph), (pw, pw)],
+            dimension_numbers=_DIMNUMS,
+        )  # (N, C*kh*kw, OH, OW)
+        return LayerOutput([patches.reshape(n, c * kh * kw, oh, ow)])
+
+
+@register
+class SPP(Layer):
+    """Spatial pyramid pooling (ref: caffe/src/caffe/layers/spp_layer.cpp):
+    pyramid of {MAX,AVE} poolings at 2^0..2^(h-1) bins, flattened + concat."""
+
+    TYPE = "SPP"
+
+    def apply(self, params, state, inputs, *, train, rng=None):
+        p = self.lp.get_msg("spp_param")
+        levels = p.get_int("pyramid_height", 3)
+        method = p.get_str("pool", "MAX")
+        x = inputs[0]
+        n, c, h, w = x.shape
+        outs = []
+        for level in range(levels):
+            bins = 2**level
+            kh, kw = int(np.ceil(h / bins)), int(np.ceil(w / bins))
+            sh, sw = kh, kw
+            ph = (kh * bins - h + 1) // 2
+            pw = (kw * bins - w + 1) // 2
+            pool = caffe_avg_pool if method == "AVE" else caffe_max_pool
+            y = pool(x, (kh, kw), (sh, sw), (ph, pw))
+            outs.append(y.reshape(n, -1))
+        return LayerOutput([jnp.concatenate(outs, axis=1)])

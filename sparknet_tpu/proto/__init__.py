@@ -1,0 +1,1 @@
+from sparknet_tpu.proto.text_format import Message, parse, parse_file, serialize  # noqa: F401

@@ -1,0 +1,73 @@
+"""Prototxt loading utilities.
+
+Equivalent of ProtoLoader (ref: src/main/scala/libs/ProtoLoader.scala:8-58),
+minus the absurd round trip the reference needed (parse prototxt in C++,
+serialize to bytes, re-parse in the JVM) — here parsing is native.
+"""
+
+from __future__ import annotations
+
+from sparknet_tpu.proto.text_format import Message, parse_file
+from sparknet_tpu.layers_dsl import RDDLayer
+
+_DATA_LAYER_TYPES = {
+    "Data",
+    "ImageData",
+    "HDF5Data",
+    "MemoryData",
+    "WindowData",
+    "DummyData",
+    "JavaData",
+    "Input",
+}
+
+
+def load_net_prototxt(path: str) -> Message:
+    """ref: ProtoLoader.loadNetPrototxt (:9-16)."""
+    return parse_file(path)
+
+
+def load_solver_prototxt_with_net(path: str, net_param: Message) -> Message:
+    """Parse a solver prototxt and embed the given net as ``net_param``
+    (ref: ProtoLoader.loadSolverPrototxtWithNet :31-43)."""
+    solver = parse_file(path)
+    solver.fields.pop("net", None)
+    solver.fields.pop("train_net", None)
+    solver.set("net_param", net_param)
+    return solver
+
+
+def replace_data_layers(
+    net_param: Message,
+    train_batch_size: int,
+    test_batch_size: int,
+    channels: int,
+    height: int,
+    width: int,
+) -> Message:
+    """Swap the net's data layers for host-fed input layers with the given
+    batch geometry (ref: ProtoLoader.replaceDataLayers :50-57 — the surgery
+    SparkNet applies to zoo prototxts before training from RDDs)."""
+    out = Message()
+    for k, vals in net_param.fields.items():
+        if k in ("layer", "layers", "input", "input_shape", "input_dim"):
+            continue
+        for v in vals:
+            out.add(k, v.copy() if isinstance(v, Message) else v)
+
+    def input_pair(batch: int, phase: str) -> list[Message]:
+        data = RDDLayer("data", [batch, channels, height, width])
+        data.set("name", f"data_{phase.lower()}")
+        data.add("include", Message().set("phase", phase))
+        label = RDDLayer("label", [batch])
+        label.set("name", f"label_{phase.lower()}")
+        label.add("include", Message().set("phase", phase))
+        return [data, label]
+
+    for l in input_pair(train_batch_size, "TRAIN") + input_pair(test_batch_size, "TEST"):
+        out.add("layer", l)
+    for lp in net_param.get_all("layer") or net_param.get_all("layers"):
+        if lp.get_str("type") in _DATA_LAYER_TYPES:
+            continue
+        out.add("layer", lp.copy())
+    return out

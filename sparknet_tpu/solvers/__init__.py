@@ -1,0 +1,3 @@
+from sparknet_tpu.solvers.lr_policy import learning_rate  # noqa: F401
+from sparknet_tpu.solvers.solver import Solver, SolverConfig  # noqa: F401
+from sparknet_tpu.solvers.updates import OPTIMIZERS, init_slots, apply_update  # noqa: F401

@@ -1,0 +1,34 @@
+"""Learning-rate policies (ref: caffe/src/caffe/solvers/sgd_solver.cpp:27-66
+GetLearningRate).  All are jit-safe functions of a traced iteration so the
+whole solver update stays inside one XLA program.
+
+Policies: fixed, step, exp, inv, multistep, poly, sigmoid.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def learning_rate(cfg, it) -> jnp.ndarray:
+    """cfg is a SolverConfig; ``it`` may be a traced int array."""
+    it = jnp.asarray(it, jnp.float32)
+    base = cfg.base_lr
+    policy = cfg.lr_policy
+    if policy == "fixed":
+        return jnp.asarray(base, jnp.float32)
+    if policy == "step":
+        return base * jnp.power(cfg.gamma, jnp.floor(it / cfg.stepsize))
+    if policy == "exp":
+        return base * jnp.power(cfg.gamma, it)
+    if policy == "inv":
+        return base * jnp.power(1.0 + cfg.gamma * it, -cfg.power)
+    if policy == "multistep":
+        steps = jnp.asarray(cfg.stepvalue, jnp.float32)
+        current = jnp.sum((it[None] >= steps).astype(jnp.float32)) if steps.size else 0.0
+        return base * jnp.power(cfg.gamma, current)
+    if policy == "poly":
+        return base * jnp.power(1.0 - it / float(cfg.max_iter), cfg.power)
+    if policy == "sigmoid":
+        return base * (1.0 / (1.0 + jnp.exp(-cfg.gamma * (it - cfg.stepsize))))
+    raise ValueError(f"unknown lr_policy {policy!r}")

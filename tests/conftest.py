@@ -1,0 +1,30 @@
+"""Test harness config: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's in-process multi-device testing trick
+(ref: caffe/src/caffe/test/test_gradient_based_solver.cpp:197-208 simulates
+multi-GPU P2PSync without a cluster): we fake an 8-way TPU pod with XLA's
+host-platform device-count flag so sharding/collective paths are exercised
+in CI without hardware.  Must run before jax initializes a backend.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+# A site hook may pin JAX_PLATFORMS to a hardware plugin before conftest runs;
+# the config route wins over the env var, so force CPU here too.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(1234)

@@ -1,0 +1,169 @@
+"""Graph-compiler tests: the reference model zoo must compile, shape-infer,
+and run forward (parity target: Net::Init over the same prototxts,
+ref: caffe/src/caffe/net.cpp:40-540; LayerSpec.scala:10-51)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparknet_tpu.common import Phase
+from sparknet_tpu.compiler import Network
+from sparknet_tpu.proto import parse_file
+
+REF = "/root/reference/caffe"
+needs_ref = pytest.mark.skipif(not os.path.isdir(REF), reason="no reference tree")
+
+
+def _feeds_for(net, shapes=None, seed=0, num_classes=10):
+    rng = np.random.RandomState(seed)
+    merged = dict(net.feed_shapes())
+    merged.update(shapes or {})
+    feeds = {}
+    for name, shape in merged.items():
+        if name == "label":
+            feeds[name] = jnp.asarray(rng.randint(0, num_classes, size=shape), jnp.int32)
+        else:
+            feeds[name] = jnp.asarray(rng.randn(*shape), jnp.float32)
+    return feeds
+
+CIFAR_SHAPES = {"data": (100, 3, 32, 32), "label": (100,)}
+
+
+@needs_ref
+def test_cifar10_full_train_compiles_and_runs():
+    npz = parse_file(f"{REF}/examples/cifar10/cifar10_full_train_test.prototxt")
+    net = Network(npz, Phase.TRAIN)
+    variables = net.init(jax.random.key(0), feed_shapes=CIFAR_SHAPES)
+    # conv1 32x3x5x5 weights + bias
+    assert variables.params["conv1"][0].shape == (32, 3, 5, 5)
+    assert variables.params["conv1"][1].shape == (32,)
+    assert variables.params["ip1"][0].shape == (10, 64 * 4 * 4)
+    blobs, _, loss = net.apply(variables, _feeds_for(net, CIFAR_SHAPES), rng=jax.random.key(1))
+    assert blobs["ip1"].shape == (100, 10)
+    assert np.isfinite(float(loss))
+    # untrained loss should be near ln(10)
+    assert abs(float(loss) - np.log(10)) < 0.5
+
+
+@needs_ref
+def test_cifar10_full_test_phase_has_accuracy():
+    npz = parse_file(f"{REF}/examples/cifar10/cifar10_full_train_test.prototxt")
+    net = Network(npz, Phase.TEST)
+    variables = net.init(jax.random.key(0), feed_shapes=CIFAR_SHAPES)
+    blobs, _, _ = net.apply(variables, _feeds_for(net, CIFAR_SHAPES), rng=None)
+    assert blobs["accuracy"].shape == ()
+    assert 0.0 <= float(blobs["accuracy"]) <= 1.0
+
+
+@needs_ref
+def test_alexnet_shapes():
+    npz = parse_file(f"{REF}/models/bvlc_alexnet/train_val.prototxt")
+    net = Network(npz, Phase.TRAIN, batch_override=4)
+    # Data layer has no declared shape; AlexNet feeds 227x227 crops
+    variables = net.init(
+        jax.random.key(0), feed_shapes={"data": (4, 3, 227, 227), "label": (4,)}
+    )
+    info = net.blob_info()
+    # canonical AlexNet activations (ref: bvlc_alexnet/train_val.prototxt)
+    assert info["conv1"].shape == (4, 96, 55, 55)
+    assert info["pool1"].shape == (4, 96, 27, 27)
+    assert info["conv2"].shape == (4, 256, 27, 27)  # group=2, pad=2
+    assert info["pool5"].shape == (4, 256, 6, 6)
+    assert info["fc6"].shape == (4, 4096)
+    assert variables.params["fc6"][0].shape == (4096, 9216)
+    assert info["fc8"].shape == (4, 1000)
+
+
+@needs_ref
+def test_googlenet_compiles():
+    """166-layer multi-tower prototxt — the compiler stress test
+    (SURVEY.md 'hard parts' (e))."""
+    npz = parse_file(f"{REF}/models/bvlc_googlenet/train_val.prototxt")
+    net = Network(npz, Phase.TRAIN, batch_override=2)
+    variables = net.init(
+        jax.random.key(0), feed_shapes={"data": (2, 3, 224, 224), "label": (2,)}
+    )
+    info = net.blob_info()
+    assert info["inception_3a/output"].shape == (2, 256, 28, 28)
+    assert info["pool5/7x7_s1"].shape == (2, 1024, 1, 1)
+    assert info["loss3/classifier"].shape == (2, 1000)
+    # 3 weighted losses (two aux at 0.3)
+    feeds = _feeds_for(net, {"data": (2, 3, 224, 224), "label": (2,)}, num_classes=1000)
+    blobs, _, loss = net.apply(variables, feeds, rng=jax.random.key(1))
+    expected = float(blobs["loss3/loss3"] + 0.3 * blobs["loss1/loss1"] + 0.3 * blobs["loss2/loss1"])
+    assert abs(float(loss) - expected) < 1e-4
+
+
+@needs_ref
+def test_lenet_deploy_net_level_inputs():
+    npz = parse_file(f"{REF}/examples/mnist/lenet.prototxt")
+    net = Network(npz, Phase.TEST)
+    variables = net.init(jax.random.key(0))
+    blobs, _, _ = net.apply(
+        variables, {"data": jnp.zeros((64, 1, 28, 28))}, rng=None
+    )
+    assert blobs["prob"].shape == (64, 10)
+    assert np.allclose(np.sum(np.asarray(blobs["prob"]), axis=1), 1.0, atol=1e-5)
+
+
+def test_phase_filtering_rules():
+    from sparknet_tpu.proto import parse
+    from sparknet_tpu.compiler import filter_phase
+
+    npz = parse(
+        """
+        layer { name: "a" type: "ReLU" include { phase: TRAIN } }
+        layer { name: "b" type: "ReLU" exclude { phase: TRAIN } }
+        layer { name: "c" type: "ReLU" }
+        layer { name: "d" type: "ReLU" include { min_level: 2 } }
+        layer { name: "e" type: "ReLU" include { stage: "deploy" } }
+        """
+    )
+    names = [l.get_str("name") for l in filter_phase(npz, Phase.TRAIN)]
+    assert names == ["a", "c"]
+    names = [l.get_str("name") for l in filter_phase(npz, Phase.TEST)]
+    assert names == ["b", "c"]
+    names = [l.get_str("name") for l in filter_phase(npz, Phase.TRAIN, level=3, stages={"deploy"})]
+    assert names == ["a", "c", "d", "e"]
+
+
+def test_jit_apply_and_grad():
+    """The whole net must trace under jit and differentiate."""
+    from sparknet_tpu.proto import parse
+
+    npz = parse(
+        """
+        name: "tiny"
+        layer { name: "data" type: "MemoryData" top: "data" top: "label"
+                memory_data_param { batch_size: 8 channels: 3 height: 8 width: 8 } }
+        layer { name: "conv" type: "Convolution" bottom: "data" top: "conv"
+                convolution_param { num_output: 4 kernel_size: 3 pad: 1
+                  weight_filler { type: "xavier" } } }
+        layer { name: "relu" type: "ReLU" bottom: "conv" top: "conv" }
+        layer { name: "pool" type: "Pooling" bottom: "conv" top: "pool"
+                pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
+        layer { name: "ip" type: "InnerProduct" bottom: "pool" top: "ip"
+                inner_product_param { num_output: 10 weight_filler { type: "xavier" } } }
+        layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" top: "loss" }
+        """
+    )
+    net = Network(npz, Phase.TRAIN)
+    variables = net.init(jax.random.key(0))
+    feeds = {
+        "data": jnp.ones((8, 3, 8, 8)),
+        "label": jnp.zeros((8,), jnp.int32),
+    }
+
+    @jax.jit
+    def loss_fn(params, state, feeds):
+        _, new_state, loss = net.apply(
+            type(variables)(params=params, state=state), feeds, rng=jax.random.key(0)
+        )
+        return loss
+
+    g = jax.grad(loss_fn)(variables.params, variables.state, feeds)
+    assert g["conv"][0].shape == (4, 3, 3, 3)
+    assert float(jnp.sum(jnp.abs(g["conv"][0]))) > 0

@@ -1,0 +1,161 @@
+"""End-to-end slice: LeNet built with the DSL trains on one device.
+
+Mirrors the reference's statistical sanity tests (ref:
+src/test/scala/libs/CifarSpec.scala:10-94 — untrained accuracy ~ chance,
+then training works) and the README LeNet example (README.md:115-128).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparknet_tpu.layers_dsl import (
+    AccuracyLayer,
+    ConvolutionLayer,
+    InnerProductLayer,
+    NetParam,
+    Pooling,
+    PoolingLayer,
+    RDDLayer,
+    ReLULayer,
+    SoftmaxWithLoss,
+)
+from sparknet_tpu.net import TPUNet, WeightCollection
+from sparknet_tpu.proto_loader import replace_data_layers
+from sparknet_tpu.proto import parse
+from sparknet_tpu.solvers import SolverConfig
+
+BATCH = 32
+
+
+def lenet(batch=BATCH):
+    """The README's LeNet, built with the DSL (ref: README.md:115-128)."""
+    return NetParam(
+        "LeNet",
+        RDDLayer("data", shape=[batch, 1, 28, 28]),
+        RDDLayer("label", shape=[batch]),
+        ConvolutionLayer("conv1", ["data"], kernel=(5, 5), num_output=20),
+        PoolingLayer("pool1", ["conv1"], Pooling.Max, kernel=(2, 2), stride=(2, 2)),
+        ConvolutionLayer("conv2", ["pool1"], kernel=(5, 5), num_output=50),
+        PoolingLayer("pool2", ["conv2"], Pooling.Max, kernel=(2, 2), stride=(2, 2)),
+        InnerProductLayer("ip1", ["pool2"], num_output=500),
+        ReLULayer("relu1", ["ip1"]),
+        InnerProductLayer("ip2", ["relu1"], num_output=10),
+        SoftmaxWithLoss("loss", ["ip2", "label"]),
+        AccuracyLayer("accuracy", ["ip2", "label"]),
+    )
+
+
+def synth_digits(n, seed=0):
+    """Learnable synthetic 'digits': class k = bright 7x7 block at position
+    k on a 28x28 canvas + noise.  Chance = 10%."""
+    rs = np.random.RandomState(seed)
+    labels = rs.randint(0, 10, n)
+    imgs = rs.randn(n, 1, 28, 28).astype(np.float32) * 0.3
+    for i, k in enumerate(labels):
+        r, c = divmod(int(k), 4)
+        imgs[i, 0, 2 + r * 9 : 9 + r * 9, 2 + c * 6 : 9 + c * 6] += 2.0
+    return imgs, labels.astype(np.int32)
+
+
+def batches(imgs, labels, batch, seed=1):
+    rs = np.random.RandomState(seed)
+    n = len(imgs)
+    while True:
+        idx = rs.randint(0, n, batch)
+        yield {"data": jnp.asarray(imgs[idx]), "label": jnp.asarray(labels[idx])}
+
+
+@pytest.fixture(scope="module")
+def trained():
+    cfg = SolverConfig(base_lr=0.01, momentum=0.9, solver_type="SGD", display=0)
+    net = TPUNet(cfg, lenet())
+    imgs, labels = synth_digits(2000)
+    test_imgs, test_labels = synth_digits(640, seed=42)
+    test_stream = batches(test_imgs, test_labels, BATCH, seed=2)
+    net.set_train_data(batches(imgs, labels, BATCH))
+    net.set_test_data(test_stream, length=10)
+    return net
+
+
+def test_untrained_accuracy_is_chance(trained):
+    """ref: CifarSpec.scala:92 asserts 7-13% for 10 classes."""
+    fresh = TPUNet(SolverConfig(), lenet())
+    test_imgs, test_labels = synth_digits(640, seed=43)
+    fresh.set_test_data(batches(test_imgs, test_labels, BATCH, seed=3), length=20)
+    scores = fresh.test()
+    assert 0.02 <= scores["accuracy"] <= 0.25, scores
+
+
+def test_training_learns(trained):
+    loss0 = trained.solver.smoothed_loss
+    trained.train(60)
+    scores = trained.test()
+    assert scores["accuracy"] > 0.5, scores
+    assert trained.solver.smoothed_loss < 1.0
+
+
+def test_weight_roundtrip(trained):
+    wc = trained.get_weights()
+    assert set(wc.layers()) == {"conv1", "conv2", "ip1", "ip2"}
+    assert wc["conv1"][0].shape == (20, 1, 5, 5)
+    # averaging two copies == identity (the SparkNet sync path algebra,
+    # ref: CifarApp.scala:132-134)
+    averaged = wc.add(wc).scalar_divide(2.0)
+    trained.set_weights(averaged)
+    got = trained.get_weights()
+    np.testing.assert_allclose(got["ip2"][0], wc["ip2"][0], rtol=1e-6)
+
+
+def test_forward_featurization(trained):
+    """ref: FeaturizerApp.scala:88-102 — forward once, read a mid blob."""
+    imgs, _ = synth_digits(BATCH, seed=7)
+    blobs = trained.forward({"data": imgs, "label": np.zeros(BATCH, np.int32)})
+    assert blobs["ip1"].shape == (BATCH, 500)
+    assert blobs["pool2"].shape == (BATCH, 50, 4, 4)
+
+
+def test_backward_returns_grads(trained):
+    imgs, labels = synth_digits(BATCH, seed=8)
+    grads = trained.backward({"data": imgs, "label": labels})
+    assert grads["conv1"][0].shape == (20, 1, 5, 5)
+    assert float(jnp.sum(jnp.abs(grads["ip2"][0]))) > 0
+
+
+def test_save_load_weights(trained, tmp_path):
+    p = str(tmp_path / "lenet_weights")
+    trained.save_weights_to_file(p)
+    w0 = trained.get_weights()["ip2"][0].copy()
+    # perturb then reload
+    wc = trained.get_weights()
+    wc.weights["ip2"][0] = wc.weights["ip2"][0] * 0 + 5.0
+    trained.set_weights(wc)
+    trained.load_weights_from_file(p)
+    np.testing.assert_allclose(trained.get_weights()["ip2"][0], w0, rtol=1e-6)
+
+
+def test_replace_data_layers():
+    """ref: ProtoLoader.replaceDataLayers surgery on a zoo prototxt."""
+    npz = parse(
+        """
+        name: "z"
+        layer { name: "d" type: "Data" top: "data" top: "label"
+                data_param { batch_size: 256 } include { phase: TRAIN } }
+        layer { name: "d" type: "Data" top: "data" top: "label"
+                data_param { batch_size: 50 } include { phase: TEST } }
+        layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+                inner_product_param { num_output: 10 weight_filler { type: "xavier" } } }
+        layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label" top: "loss" }
+        """
+    )
+    surgered = replace_data_layers(npz, 32, 16, 3, 8, 8)
+    from sparknet_tpu.common import Phase
+    from sparknet_tpu.compiler import Network
+
+    train = Network(surgered, Phase.TRAIN)
+    assert train.feed_shapes()["data"] == (32, 3, 8, 8)
+    test = Network(surgered, Phase.TEST)
+    assert test.feed_shapes()["data"] == (16, 3, 8, 8)
+    variables = train.init(jax.random.key(0))
+    assert variables.params["ip"][0].shape == (10, 3 * 8 * 8)

@@ -1,0 +1,243 @@
+"""Solver tests: analytic-update verification, mirroring the reference's
+methodology of recomputing the expected update by hand and comparing
+(ref: caffe/src/caffe/test/test_gradient_based_solver.cpp:197-208 — there
+via a 2-param least-squares net; here directly on the update rules plus an
+end-to-end convergence check)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparknet_tpu.common import Phase
+from sparknet_tpu.ops.base import ParamSpec
+from sparknet_tpu.proto import parse, parse_file
+from sparknet_tpu.solvers import Solver, SolverConfig, apply_update, init_slots
+from sparknet_tpu.solvers.lr_policy import learning_rate
+
+REF = "/root/reference/caffe"
+
+
+# ---------------------------------------------------------------------------
+# LR policies (ref: sgd_solver.cpp:27-66)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize(
+    "cfg_kw,it,expected",
+    [
+        (dict(lr_policy="fixed", base_lr=0.01), 500, 0.01),
+        (dict(lr_policy="step", base_lr=0.01, gamma=0.1, stepsize=100), 250, 0.01 * 0.1**2),
+        (dict(lr_policy="exp", base_lr=0.01, gamma=0.99), 10, 0.01 * 0.99**10),
+        (dict(lr_policy="inv", base_lr=0.01, gamma=0.0001, power=0.75), 1000, 0.01 * (1 + 0.0001 * 1000) ** -0.75),
+        (dict(lr_policy="multistep", base_lr=0.01, gamma=0.5, stepvalue=(10, 20, 30)), 25, 0.01 * 0.5**2),
+        (dict(lr_policy="poly", base_lr=0.01, power=2.0, max_iter=100), 50, 0.01 * 0.25),
+        (dict(lr_policy="sigmoid", base_lr=0.01, gamma=-0.1, stepsize=50), 50, 0.005),
+    ],
+)
+def test_lr_policies(cfg_kw, it, expected):
+    cfg = SolverConfig(**cfg_kw)
+    assert float(learning_rate(cfg, it)) == pytest.approx(expected, rel=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Analytic update checks
+# ---------------------------------------------------------------------------
+def _one_step(cfg, w, g, slots=None, specs=None, it=0):
+    params = {"l": [jnp.asarray(w, jnp.float32)]}
+    grads = {"l": [jnp.asarray(g, jnp.float32)]}
+    slots = slots if slots is not None else init_slots(cfg.solver_type, params)
+    specs = specs or {"l": [ParamSpec()]}
+    new_p, new_s = apply_update(cfg, params, grads, slots, specs, learning_rate(cfg, it), jnp.asarray(it))
+    return np.asarray(new_p["l"][0]), new_s
+
+
+def test_sgd_momentum_two_steps():
+    """V = mu*V + lr*g; W -= V (ref: sgd_solver.cpp ComputeUpdateValue)."""
+    cfg = SolverConfig(base_lr=0.1, momentum=0.9, solver_type="SGD")
+    w, g = np.array([1.0, -2.0]), np.array([0.5, 0.25])
+    w1, s = _one_step(cfg, w, g)
+    v1 = 0.1 * g
+    np.testing.assert_allclose(w1, w - v1, rtol=1e-6)
+    w2, _ = _one_step(cfg, w1, g, slots=s)
+    v2 = 0.9 * v1 + 0.1 * g
+    np.testing.assert_allclose(w2, w1 - v2, rtol=1e-6)
+
+
+def test_sgd_weight_decay_and_multipliers():
+    """local_rate = lr*lr_mult; decay = wd*decay_mult applied to the grad."""
+    cfg = SolverConfig(base_lr=0.1, momentum=0.0, weight_decay=0.01, solver_type="SGD")
+    specs = {"l": [ParamSpec(lr_mult=2.0, decay_mult=0.5)]}
+    w, g = np.array([1.0]), np.array([0.2])
+    w1, _ = _one_step(cfg, w, g, specs=specs)
+    expected = w - 0.1 * 2.0 * (g + 0.01 * 0.5 * w)
+    np.testing.assert_allclose(w1, expected, rtol=1e-6)
+
+
+def test_l1_regularization():
+    cfg = SolverConfig(base_lr=0.1, weight_decay=0.01, regularization_type="L1")
+    w, g = np.array([1.0, -3.0]), np.array([0.0, 0.0])
+    w1, _ = _one_step(cfg, w, g)
+    np.testing.assert_allclose(w1, w - 0.1 * 0.01 * np.sign(w), rtol=1e-6)
+
+
+def test_clip_gradients_global_norm():
+    cfg = SolverConfig(base_lr=1.0, clip_gradients=1.0)
+    w, g = np.array([0.0, 0.0]), np.array([3.0, 4.0])  # norm 5
+    w1, _ = _one_step(cfg, w, g)
+    np.testing.assert_allclose(w1, -np.array([0.6, 0.8]), rtol=1e-5)
+
+
+def test_nesterov_update():
+    cfg = SolverConfig(base_lr=0.1, momentum=0.9, solver_type="Nesterov")
+    w, g = np.array([1.0]), np.array([0.5])
+    w1, s = _one_step(cfg, w, g)
+    h1 = 0.1 * 0.5
+    np.testing.assert_allclose(w1, w - ((1 + 0.9) * h1 - 0.9 * 0.0), rtol=1e-6)
+    w2, _ = _one_step(cfg, w1, g, slots=s)
+    h2 = 0.9 * h1 + 0.1 * 0.5
+    np.testing.assert_allclose(w2, w1 - ((1 + 0.9) * h2 - 0.9 * h1), rtol=1e-6)
+
+
+def test_adagrad_update():
+    cfg = SolverConfig(base_lr=0.1, delta=1e-8, solver_type="AdaGrad")
+    w, g = np.array([1.0]), np.array([0.5])
+    w1, s = _one_step(cfg, w, g)
+    np.testing.assert_allclose(w1, w - 0.1 * 0.5 / (np.sqrt(0.25) + 1e-8), rtol=1e-5)
+    w2, _ = _one_step(cfg, w1, g, slots=s)
+    np.testing.assert_allclose(w2, w1 - 0.1 * 0.5 / (np.sqrt(0.5) + 1e-8), rtol=1e-5)
+
+
+def test_rmsprop_update():
+    cfg = SolverConfig(base_lr=0.1, rms_decay=0.9, delta=1e-8, solver_type="RMSProp")
+    w, g = np.array([1.0]), np.array([0.5])
+    w1, _ = _one_step(cfg, w, g)
+    h = 0.1 * 0.25
+    np.testing.assert_allclose(w1, w - 0.1 * 0.5 / (np.sqrt(h) + 1e-8), rtol=1e-5)
+
+
+def test_adadelta_update():
+    cfg = SolverConfig(base_lr=1.0, momentum=0.95, delta=1e-6, solver_type="AdaDelta")
+    w, g = np.array([1.0]), np.array([0.5])
+    w1, _ = _one_step(cfg, w, g)
+    h = 0.05 * 0.25
+    val = 0.5 * np.sqrt((0 + 1e-6) / (h + 1e-6))
+    np.testing.assert_allclose(w1, w - val, rtol=1e-4)
+
+
+def test_adam_update():
+    cfg = SolverConfig(base_lr=0.001, momentum=0.9, momentum2=0.999, delta=1e-8, solver_type="Adam")
+    w, g = np.array([1.0]), np.array([0.5])
+    w1, _ = _one_step(cfg, w, g, it=0)
+    m = 0.1 * 0.5
+    v = 0.001 * 0.25
+    corr = np.sqrt(1 - 0.999) / (1 - 0.9)
+    np.testing.assert_allclose(w1, w - 0.001 * corr * m / (np.sqrt(v) + 1e-8), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: tiny net converges; snapshot/restore reproduces trajectory
+# ---------------------------------------------------------------------------
+TINY_NET = """
+name: "linreg"
+layer { name: "data" type: "MemoryData" top: "data" top: "target"
+        memory_data_param { batch_size: 16 channels: 4 height: 1 width: 1 } }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "pred"
+        inner_product_param { num_output: 1 weight_filler { type: "gaussian" std: 0.1 } } }
+layer { name: "loss" type: "EuclideanLoss" bottom: "pred" bottom: "target" top: "loss" }
+"""
+
+
+def _linreg_data_fn(seed=0):
+    rs = np.random.RandomState(seed)
+    true_w = np.array([[1.0, -2.0, 3.0, 0.5]], np.float32)
+
+    def data_fn(it):
+        rs2 = np.random.RandomState(seed + it)
+        x = rs2.randn(16, 4, 1, 1).astype(np.float32)
+        y = x.reshape(16, 4) @ true_w.T
+        return {"data": jnp.asarray(x), "target": jnp.asarray(y)}
+
+    return data_fn, true_w
+
+
+def _make_solver(cfg):
+    """MemoryData declares (16,) for its 2nd top; this net's target is (16,1)."""
+    return Solver(cfg, parse(TINY_NET), feed_shapes={"target": (16, 1)})
+
+
+@pytest.mark.parametrize("stype", ["SGD", "Nesterov", "Adam"])
+def test_solver_converges(stype):
+    lr = 0.02 if stype != "Adam" else 0.05
+    cfg = SolverConfig(base_lr=lr, momentum=0.9, solver_type=stype)
+    solver = _make_solver(cfg)
+    data_fn, true_w = _linreg_data_fn()
+    loss = solver.step(200, data_fn)
+    assert loss < 0.05, f"{stype} failed to converge: {loss}"
+    got = np.asarray(solver.variables.params["ip"][0])
+    np.testing.assert_allclose(got, true_w, atol=0.15)
+
+
+def test_snapshot_restore_reproduces_trajectory(tmp_path):
+    cfg = SolverConfig(base_lr=0.02, momentum=0.9, solver_type="SGD")
+    data_fn, _ = _linreg_data_fn()
+
+    make = lambda: _make_solver(cfg)
+
+    a = make()
+    a.step(5, data_fn)
+    ckpt = a.save(str(tmp_path / "snap"))
+    a.step(5, data_fn)
+    final_direct = np.asarray(a.variables.params["ip"][0])
+
+    b = make()
+    b.restore(ckpt)
+    assert b.iter == 5
+    b.step(5, data_fn)
+    final_restored = np.asarray(b.variables.params["ip"][0])
+    np.testing.assert_allclose(final_direct, final_restored, rtol=1e-6)
+
+
+def test_iter_size_accumulation():
+    """iter_size=2 with two half-batches == one full batch step (SGD)."""
+    cfg1 = SolverConfig(base_lr=0.1, solver_type="SGD", iter_size=1)
+    cfg2 = SolverConfig(base_lr=0.1, solver_type="SGD", iter_size=2)
+    net = parse(TINY_NET)
+    data_fn, _ = _linreg_data_fn()
+    full = data_fn(0)
+
+    def make(cfg):
+        return Solver(cfg, net, feed_shapes={"target": (16, 1)})
+
+    a = make(cfg1)
+    a.step(1, lambda it: full)
+    # same data split into two stacked micro-batches of 8... but EuclideanLoss
+    # divides by batch num, so two half-batches avg = full-batch result * 2.
+    # Use identical micro-batches instead: mean of equal grads == the grad.
+    b = make(cfg2)
+    half = {k: jnp.stack([v, v]) for k, v in full.items()}
+    b.step(1, lambda it: half)
+    np.testing.assert_allclose(
+        np.asarray(a.variables.params["ip"][0]),
+        np.asarray(b.variables.params["ip"][0]),
+        rtol=1e-5,
+    )
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="no reference tree")
+def test_reference_solver_prototxts_parse():
+    for f in [
+        "examples/cifar10/cifar10_full_solver.prototxt",
+        "examples/mnist/lenet_solver_adam.prototxt",
+        "examples/mnist/lenet_solver_rmsprop.prototxt",
+        "examples/mnist/lenet_adadelta_solver.prototxt",
+        "examples/mnist/mnist_autoencoder_solver_nesterov.prototxt",
+        "models/bvlc_alexnet/solver.prototxt",
+        "models/bvlc_googlenet/quick_solver.prototxt",
+    ]:
+        cfg = SolverConfig.from_proto(parse_file(os.path.join(REF, f)))
+        assert cfg.base_lr > 0
+    cfg = SolverConfig.from_proto(parse_file(f"{REF}/examples/mnist/lenet_solver_adam.prototxt"))
+    assert cfg.solver_type == "Adam"
+    cfg = SolverConfig.from_proto(parse_file(f"{REF}/models/bvlc_googlenet/quick_solver.prototxt"))
+    assert cfg.lr_policy == "poly"
