@@ -185,7 +185,11 @@ class ContrastiveLoss(_LossBase):
         if legacy:
             dis = jnp.maximum(margin - d2, 0.0)
         else:
-            dis = jnp.square(jnp.maximum(margin - jnp.sqrt(d2), 0.0))
+            # safe sqrt: grad(sqrt) at 0 is inf, and the outer maximum does
+            # not mask it (margin - 0 > 0 keeps the branch live), so identical
+            # dissimilar-pair embeddings would NaN the whole gradient
+            d = jnp.sqrt(jnp.where(d2 > 0.0, d2, 1.0)) * (d2 > 0.0)
+            dis = jnp.square(jnp.maximum(margin - d, 0.0))
         return LayerOutput([jnp.sum(sim * d2 + (1.0 - sim) * dis) / (2.0 * n)])
 
 

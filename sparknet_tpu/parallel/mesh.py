@@ -1,0 +1,78 @@
+"""Device-mesh construction and multi-host initialization.
+
+TPU-native analog of the reference's cluster plumbing: where SparkNet got its
+worker set from Spark executors (ref: src/main/scala/apps/CifarApp.scala:27-33
+`new SparkContext`; workers pinned via WorkerStore.scala:5-25) and Caffe got
+its GPU set from `--gpu=0,1` (ref: caffe/tools/caffe.cpp:209-211), here the
+"cluster" is a `jax.sharding.Mesh` over the pod slice, and multi-host comes
+from `jax.distributed.initialize` over DCN.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from sparknet_tpu.common import get_config
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Multi-host bring-up (replaces the Spark driver/executor topology;
+    ref: README.md:26 spark-submit deployment).  No-op on a single host
+    with no coordinator configured."""
+    if coordinator_address is None and num_processes is None:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def data_parallel_mesh(num_devices: int | None = None) -> Mesh:
+    """1-D mesh over all (or the first N) devices on the data axis —
+    the direct analog of SparkNet's flat worker set."""
+    cfg = get_config()
+    devices = jax.devices()
+    if num_devices is not None:
+        devices = devices[:num_devices]
+    return Mesh(np.array(devices), axis_names=(cfg.data_axis,))
+
+
+def auto_mesh(
+    num_devices: int | None = None,
+    model_parallel: int = 1,
+) -> Mesh:
+    """2-D (data, model) mesh.  `model_parallel` is the tensor-parallel
+    degree; the rest of the devices go to data parallelism.  On real TPU
+    hardware the default device order already keeps the minor axis on
+    ICI-adjacent chips, so the model axis rides the fastest links."""
+    cfg = get_config()
+    devices = jax.devices()
+    n = num_devices if num_devices is not None else len(devices)
+    devices = devices[:n]
+    if n % model_parallel != 0:
+        raise ValueError(f"{n} devices not divisible by model_parallel={model_parallel}")
+    arr = np.array(devices).reshape(n // model_parallel, model_parallel)
+    return Mesh(arr, axis_names=(cfg.data_axis, cfg.model_axis))
+
+
+def mesh_data_size(mesh: Mesh) -> int:
+    cfg = get_config()
+    return mesh.shape.get(cfg.data_axis, 1)
+
+
+def mesh_model_size(mesh: Mesh) -> int:
+    cfg = get_config()
+    return mesh.shape.get(cfg.model_axis, 1)

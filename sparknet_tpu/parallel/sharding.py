@@ -1,0 +1,97 @@
+"""Sharding rules: how net variables and batches lay out on the mesh.
+
+The reference has no notion of parameter layout — every worker holds a full
+model replica and full batches (ref: SURVEY §2.3; parallel.cpp:69-117 even
+flattens all params into ONE contiguous buffer per GPU).  On TPU layout IS
+the parallelism: we annotate arrays with `NamedSharding`s and GSPMD inserts
+the collectives.
+
+Rules implemented:
+- batch axis -> mesh 'data' axis (data parallelism);
+- optional Megatron-style tensor parallelism: Convolution / InnerProduct /
+  Embed weight blobs shard their output-channel axis (axis 0 in Caffe blob
+  order, ref: base_conv_layer.cpp OIHW, inner_product_layer.cpp (N,D)) over
+  the 'model' axis when divisible; biases shard the same way; everything
+  else replicates.  XLA's sharding propagation then splits the activations
+  and inserts the all-gathers/reduce-scatters on ICI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sparknet_tpu.common import get_config
+from sparknet_tpu.compiler.graph import NetVars, Network
+
+# Layer types that take Megatron-style output-channel sharding.
+_TP_TYPES = {"Convolution", "Deconvolution", "InnerProduct", "Embed"}
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingRules:
+    """Knobs for the layout pass."""
+
+    tensor_parallel: bool = True
+    # don't bother sharding tiny blobs — the all-gather costs more than it saves
+    min_tp_dim: int = 128
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading (batch) axis split over 'data'."""
+    cfg = get_config()
+    return NamedSharding(mesh, P(cfg.data_axis))
+
+
+def _blob_spec(
+    layer_type: str,
+    shape: tuple[int, ...],
+    model_size: int,
+    rules: ShardingRules,
+) -> P:
+    cfg = get_config()
+    if (
+        rules.tensor_parallel
+        and model_size > 1
+        and layer_type in _TP_TYPES
+        and len(shape) >= 1
+        and shape[0] % model_size == 0
+        and shape[0] >= rules.min_tp_dim
+    ):
+        return P(cfg.model_axis)  # axis 0 = num_output; rest replicated
+    return P()
+
+
+def param_shardings(
+    net: Network,
+    variables: NetVars,
+    mesh: Mesh,
+    rules: ShardingRules | None = None,
+) -> NetVars:
+    """A NetVars-shaped pytree of NamedShardings for `variables`."""
+    cfg = get_config()
+    rules = rules or ShardingRules()
+    model_size = mesh.shape.get(cfg.model_axis, 1)
+    params = {}
+    for lname, plist in variables.params.items():
+        ltype = net.layer_by_name(lname).type
+        params[lname] = [
+            NamedSharding(mesh, _blob_spec(ltype, p.shape, model_size, rules))
+            for p in plist
+        ]
+    state = {
+        lname: {k: replicated(mesh) for k in s}
+        for lname, s in variables.state.items()
+    }
+    return NetVars(params=params, state=state)
+
+
+def place(tree, shardings):
+    """Device-put a pytree onto its shardings (host staging -> HBM once)."""
+    return jax.tree_util.tree_map(jax.device_put, tree, shardings)

@@ -265,7 +265,7 @@ def _fmt_scalar(v: Any) -> str:
     if isinstance(v, (int, float)):
         return repr(v)
     s = str(v)
-    if s and (s.isupper() or (s.replace("_", "").isalnum() and s[0].isupper() and s.isidentifier() and s.upper() == s)):
+    if s and s.isidentifier() and s.upper() == s:
         # heuristic: ALL_CAPS identifiers were enums — emit bare
         return s
     if s in ("true", "false"):

@@ -75,6 +75,12 @@ class SolverConfig:
     @classmethod
     def from_proto(cls, m: Message) -> "SolverConfig":
         stype = m.get_str("type", m.get_str("solver_type", "SGD"))
+        if stype not in _TYPE_ALIASES:
+            raise ValueError(
+                f"unknown solver type {stype!r}; expected one of "
+                f"{sorted(set(_TYPE_ALIASES.values()))} "
+                "(ref: SolverRegistry::CreateSolver fails on unknown types)"
+            )
         return cls(
             base_lr=m.get_float("base_lr", 0.01),
             lr_policy=m.get_str("lr_policy", "fixed"),
