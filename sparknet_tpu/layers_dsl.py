@@ -123,22 +123,46 @@ def InnerProductLayer(
     return m
 
 
-def ReLULayer(name: str, bottoms: Sequence[str]) -> Message:
-    """ref: Layers.scala:102-113."""
-    return _layer(name, "ReLU", bottoms)
+def ReLULayer(name: str, bottoms: Sequence[str], in_place: bool = False) -> Message:
+    """ref: Layers.scala:102-113.  ``in_place=True`` reproduces the zoo
+    prototxts' top==bottom wiring (Caffe computes ReLU in the bottom blob's
+    buffer; here it just rebinds the blob name)."""
+    return _layer(name, "ReLU", bottoms, tops=bottoms if in_place else None)
 
 
-def DropoutLayer(name: str, bottoms: Sequence[str], ratio: float = 0.5) -> Message:
-    m = _layer(name, "Dropout", bottoms)
+def DropoutLayer(
+    name: str, bottoms: Sequence[str], ratio: float = 0.5, in_place: bool = False
+) -> Message:
+    m = _layer(name, "Dropout", bottoms, tops=bottoms if in_place else None)
     m.set("dropout_param", Message().set("dropout_ratio", ratio))
     return m
 
 
-def LRNLayer(name: str, bottoms: Sequence[str], local_size: int = 5, alpha: float = 1e-4, beta: float = 0.75) -> Message:
+def LRNLayer(
+    name: str,
+    bottoms: Sequence[str],
+    local_size: int = 5,
+    alpha: float = 1e-4,
+    beta: float = 0.75,
+    norm_region: str | None = None,
+) -> Message:
     m = _layer(name, "LRN", bottoms)
     p = Message().set("local_size", local_size).set("alpha", alpha).set("beta", beta)
+    if norm_region:
+        p.set("norm_region", norm_region)
     m.set("lrn_param", p)
     return m
+
+
+def ConcatLayer(name: str, bottoms: Sequence[str], axis: int = 1) -> Message:
+    m = _layer(name, "Concat", bottoms)
+    if axis != 1:
+        m.set("concat_param", Message().set("axis", axis))
+    return m
+
+
+def SoftmaxLayer(name: str, bottoms: Sequence[str]) -> Message:
+    return _layer(name, "Softmax", bottoms)
 
 
 def SoftmaxWithLoss(name: str, bottoms: Sequence[str]) -> Message:

@@ -1,0 +1,21 @@
+"""Model zoo: the reference's prototxt model family, built with the DSL.
+
+Each builder returns a ``NetParameter`` Message ready for ``Network``/
+``TPUNet``; ``*_solver()`` return the matching ``SolverConfig`` recipes
+(ref: caffe/models/ + caffe/examples/).
+"""
+
+from sparknet_tpu.models.zoo import (  # noqa: F401
+    alexnet,
+    alexnet_solver,
+    caffenet,
+    caffenet_solver,
+    cifar10_full,
+    cifar10_full_solver,
+    cifar10_quick,
+    cifar10_quick_solver,
+    googlenet,
+    googlenet_solver,
+    lenet,
+    lenet_solver,
+)

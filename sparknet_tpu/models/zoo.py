@@ -1,0 +1,363 @@
+"""The reference model zoo, rebuilt with the layer DSL.
+
+TPU-first equivalents of the prototxt model family the reference ships
+(ref: caffe/examples/mnist/lenet_train_test.prototxt,
+caffe/examples/cifar10/cifar10_{quick,full}_train_test.prototxt,
+caffe/models/bvlc_alexnet/train_val.prototxt,
+caffe/models/bvlc_reference_caffenet/train_val.prototxt,
+caffe/models/bvlc_googlenet/train_val.prototxt).  Architectures are the
+published ones; the definitions here are programmatic builders rather than
+checked-in prototxt, because on TPU the model config *is* the program —
+it compiles straight to one XLA computation.
+
+Data enters through RDD layers (the JavaData/RDDLayer path,
+ref: src/main/scala/libs/Layers.scala:18-40) so every model is fed from the
+host input pipeline; batch is a builder argument, not baked into the file.
+"""
+
+from __future__ import annotations
+
+from sparknet_tpu.layers_dsl import (
+    AccuracyLayer,
+    ConcatLayer,
+    ConvolutionLayer,
+    DropoutLayer,
+    InnerProductLayer,
+    LRNLayer,
+    NetParam,
+    Pooling,
+    PoolingLayer,
+    RDDLayer,
+    ReLULayer,
+    SoftmaxWithLoss,
+    _filler,
+)
+from sparknet_tpu.proto.text_format import Message
+from sparknet_tpu.solvers.solver import SolverConfig
+
+
+def _gauss(std: float) -> Message:
+    return _filler("gaussian", std=std)
+
+
+def _const(v: float) -> Message:
+    return _filler("constant", value=v)
+
+
+# ---------------------------------------------------------------------------
+# LeNet (ref: caffe/examples/mnist/lenet_train_test.prototxt; the README's
+# own inline example, README.md:115-128)
+# ---------------------------------------------------------------------------
+def lenet(batch: int = 64, num_classes: int = 10) -> Message:
+    return NetParam(
+        "LeNet",
+        RDDLayer("data", shape=[batch, 1, 28, 28]),
+        RDDLayer("label", shape=[batch]),
+        ConvolutionLayer("conv1", ["data"], kernel=(5, 5), num_output=20),
+        PoolingLayer("pool1", ["conv1"], Pooling.Max, kernel=(2, 2), stride=(2, 2)),
+        ConvolutionLayer("conv2", ["pool1"], kernel=(5, 5), num_output=50),
+        PoolingLayer("pool2", ["conv2"], Pooling.Max, kernel=(2, 2), stride=(2, 2)),
+        InnerProductLayer("ip1", ["pool2"], num_output=500),
+        ReLULayer("relu1", ["ip1"], in_place=True),
+        InnerProductLayer("ip2", ["ip1"], num_output=num_classes),
+        SoftmaxWithLoss("loss", ["ip2", "label"]),
+        AccuracyLayer("accuracy", ["ip2", "label"]),
+    )
+
+
+def lenet_solver() -> SolverConfig:
+    """ref: caffe/examples/mnist/lenet_solver.prototxt."""
+    return SolverConfig(
+        base_lr=0.01, lr_policy="inv", gamma=1e-4, power=0.75,
+        momentum=0.9, weight_decay=5e-4, max_iter=10000,
+        solver_type="SGD", display=100,
+    )
+
+
+# ---------------------------------------------------------------------------
+# CIFAR-10 quick (ref: caffe/examples/cifar10/cifar10_quick_train_test.prototxt)
+# ---------------------------------------------------------------------------
+def cifar10_quick(batch: int = 100, num_classes: int = 10) -> Message:
+    return NetParam(
+        "CIFAR10_quick",
+        RDDLayer("data", shape=[batch, 3, 32, 32]),
+        RDDLayer("label", shape=[batch]),
+        ConvolutionLayer("conv1", ["data"], kernel=(5, 5), num_output=32,
+                         pad=(2, 2), weight_filler=_gauss(1e-4)),
+        PoolingLayer("pool1", ["conv1"], Pooling.Max, kernel=(3, 3), stride=(2, 2)),
+        ReLULayer("relu1", ["pool1"], in_place=True),
+        ConvolutionLayer("conv2", ["pool1"], kernel=(5, 5), num_output=32,
+                         pad=(2, 2), weight_filler=_gauss(0.01)),
+        ReLULayer("relu2", ["conv2"], in_place=True),
+        PoolingLayer("pool2", ["conv2"], Pooling.Ave, kernel=(3, 3), stride=(2, 2)),
+        ConvolutionLayer("conv3", ["pool2"], kernel=(5, 5), num_output=64,
+                         pad=(2, 2), weight_filler=_gauss(0.01)),
+        ReLULayer("relu3", ["conv3"], in_place=True),
+        PoolingLayer("pool3", ["conv3"], Pooling.Ave, kernel=(3, 3), stride=(2, 2)),
+        InnerProductLayer("ip1", ["pool3"], num_output=64,
+                          weight_filler=_gauss(0.1)),
+        InnerProductLayer("ip2", ["ip1"], num_output=num_classes,
+                          weight_filler=_gauss(0.1)),
+        SoftmaxWithLoss("loss", ["ip2", "label"]),
+        AccuracyLayer("accuracy", ["ip2", "label"]),
+    )
+
+
+def cifar10_quick_solver() -> SolverConfig:
+    """ref: caffe/examples/cifar10/cifar10_quick_solver.prototxt."""
+    return SolverConfig(
+        base_lr=1e-3, lr_policy="fixed", momentum=0.9, weight_decay=0.004,
+        max_iter=4000, solver_type="SGD", display=100,
+    )
+
+
+# ---------------------------------------------------------------------------
+# CIFAR-10 full — the CifarApp model (ref:
+# caffe/examples/cifar10/cifar10_full_train_test.prototxt; the _java_ variant
+# swaps in JavaData layers, which RDDLayer plays here —
+# src/main/scala/apps/CifarApp.scala:78-80)
+# ---------------------------------------------------------------------------
+def cifar10_full(batch: int = 100, num_classes: int = 10) -> Message:
+    return NetParam(
+        "CIFAR10_full",
+        RDDLayer("data", shape=[batch, 3, 32, 32]),
+        RDDLayer("label", shape=[batch]),
+        ConvolutionLayer("conv1", ["data"], kernel=(5, 5), num_output=32,
+                         pad=(2, 2), weight_filler=_gauss(1e-4)),
+        PoolingLayer("pool1", ["conv1"], Pooling.Max, kernel=(3, 3), stride=(2, 2)),
+        ReLULayer("relu1", ["pool1"], in_place=True),
+        LRNLayer("norm1", ["pool1"], local_size=3, alpha=5e-5, beta=0.75,
+                 norm_region="WITHIN_CHANNEL"),
+        ConvolutionLayer("conv2", ["norm1"], kernel=(5, 5), num_output=32,
+                         pad=(2, 2), weight_filler=_gauss(0.01)),
+        ReLULayer("relu2", ["conv2"], in_place=True),
+        PoolingLayer("pool2", ["conv2"], Pooling.Ave, kernel=(3, 3), stride=(2, 2)),
+        LRNLayer("norm2", ["pool2"], local_size=3, alpha=5e-5, beta=0.75,
+                 norm_region="WITHIN_CHANNEL"),
+        ConvolutionLayer("conv3", ["norm2"], kernel=(5, 5), num_output=64,
+                         pad=(2, 2), weight_filler=_gauss(0.01)),
+        ReLULayer("relu3", ["conv3"], in_place=True),
+        PoolingLayer("pool3", ["conv3"], Pooling.Ave, kernel=(3, 3), stride=(2, 2)),
+        InnerProductLayer("ip1", ["pool3"], num_output=num_classes,
+                          weight_filler=_gauss(0.01)),
+        SoftmaxWithLoss("loss", ["ip1", "label"]),
+        AccuracyLayer("accuracy", ["ip1", "label"]),
+    )
+
+
+def cifar10_full_solver() -> SolverConfig:
+    """ref: caffe/examples/cifar10/cifar10_full_solver.prototxt (the
+    CifarApp recipe — BASELINE.md CIFAR-10 row)."""
+    return SolverConfig(
+        base_lr=1e-3, lr_policy="fixed", momentum=0.9, weight_decay=0.004,
+        max_iter=60000, solver_type="SGD", display=200,
+    )
+
+
+# ---------------------------------------------------------------------------
+# AlexNet (ref: caffe/models/bvlc_alexnet/train_val.prototxt; order is
+# conv->relu->norm->pool, vs CaffeNet's conv->relu->pool->norm)
+# ---------------------------------------------------------------------------
+def _alex_tail(fc6_bottom: str, num_classes: int) -> list[Message]:
+    return [
+        InnerProductLayer("fc6", [fc6_bottom], num_output=4096,
+                          weight_filler=_gauss(0.005), bias_filler=_const(0.1)),
+        ReLULayer("relu6", ["fc6"], in_place=True),
+        DropoutLayer("drop6", ["fc6"], ratio=0.5, in_place=True),
+        InnerProductLayer("fc7", ["fc6"], num_output=4096,
+                          weight_filler=_gauss(0.005), bias_filler=_const(0.1)),
+        ReLULayer("relu7", ["fc7"], in_place=True),
+        DropoutLayer("drop7", ["fc7"], ratio=0.5, in_place=True),
+        InnerProductLayer("fc8", ["fc7"], num_output=num_classes,
+                          weight_filler=_gauss(0.01)),
+        SoftmaxWithLoss("loss", ["fc8", "label"]),
+        AccuracyLayer("accuracy", ["fc8", "label"]),
+    ]
+
+
+def alexnet(batch: int = 256, num_classes: int = 1000, crop: int = 227) -> Message:
+    return NetParam(
+        "AlexNet",
+        RDDLayer("data", shape=[batch, 3, crop, crop]),
+        RDDLayer("label", shape=[batch]),
+        ConvolutionLayer("conv1", ["data"], kernel=(11, 11), num_output=96,
+                         stride=(4, 4), weight_filler=_gauss(0.01)),
+        ReLULayer("relu1", ["conv1"], in_place=True),
+        LRNLayer("norm1", ["conv1"], local_size=5, alpha=1e-4, beta=0.75),
+        PoolingLayer("pool1", ["norm1"], Pooling.Max, kernel=(3, 3), stride=(2, 2)),
+        ConvolutionLayer("conv2", ["pool1"], kernel=(5, 5), num_output=256,
+                         pad=(2, 2), group=2, weight_filler=_gauss(0.01),
+                         bias_filler=_const(0.1)),
+        ReLULayer("relu2", ["conv2"], in_place=True),
+        LRNLayer("norm2", ["conv2"], local_size=5, alpha=1e-4, beta=0.75),
+        PoolingLayer("pool2", ["norm2"], Pooling.Max, kernel=(3, 3), stride=(2, 2)),
+        ConvolutionLayer("conv3", ["pool2"], kernel=(3, 3), num_output=384,
+                         pad=(1, 1), weight_filler=_gauss(0.01)),
+        ReLULayer("relu3", ["conv3"], in_place=True),
+        ConvolutionLayer("conv4", ["conv3"], kernel=(3, 3), num_output=384,
+                         pad=(1, 1), group=2, weight_filler=_gauss(0.01),
+                         bias_filler=_const(0.1)),
+        ReLULayer("relu4", ["conv4"], in_place=True),
+        ConvolutionLayer("conv5", ["conv4"], kernel=(3, 3), num_output=256,
+                         pad=(1, 1), group=2, weight_filler=_gauss(0.01),
+                         bias_filler=_const(0.1)),
+        ReLULayer("relu5", ["conv5"], in_place=True),
+        PoolingLayer("pool5", ["conv5"], Pooling.Max, kernel=(3, 3), stride=(2, 2)),
+        *_alex_tail("pool5", num_classes),
+    )
+
+
+def alexnet_solver() -> SolverConfig:
+    """ref: caffe/models/bvlc_alexnet/solver.prototxt (the ImageNet recipe —
+    BASELINE.md ImageNet row)."""
+    return SolverConfig(
+        base_lr=0.01, lr_policy="step", gamma=0.1, stepsize=100000,
+        momentum=0.9, weight_decay=5e-4, max_iter=450000,
+        solver_type="SGD", display=20,
+    )
+
+
+# ---------------------------------------------------------------------------
+# CaffeNet — the ImageNetApp model (ref:
+# caffe/models/bvlc_reference_caffenet/train_val.prototxt;
+# src/main/scala/apps/ImageNetApp.scala uses this with RDD data layers)
+# ---------------------------------------------------------------------------
+def caffenet(batch: int = 256, num_classes: int = 1000, crop: int = 227) -> Message:
+    return NetParam(
+        "CaffeNet",
+        RDDLayer("data", shape=[batch, 3, crop, crop]),
+        RDDLayer("label", shape=[batch]),
+        ConvolutionLayer("conv1", ["data"], kernel=(11, 11), num_output=96,
+                         stride=(4, 4), weight_filler=_gauss(0.01)),
+        ReLULayer("relu1", ["conv1"], in_place=True),
+        PoolingLayer("pool1", ["conv1"], Pooling.Max, kernel=(3, 3), stride=(2, 2)),
+        LRNLayer("norm1", ["pool1"], local_size=5, alpha=1e-4, beta=0.75),
+        ConvolutionLayer("conv2", ["norm1"], kernel=(5, 5), num_output=256,
+                         pad=(2, 2), group=2, weight_filler=_gauss(0.01),
+                         bias_filler=_const(1.0)),
+        ReLULayer("relu2", ["conv2"], in_place=True),
+        PoolingLayer("pool2", ["conv2"], Pooling.Max, kernel=(3, 3), stride=(2, 2)),
+        LRNLayer("norm2", ["pool2"], local_size=5, alpha=1e-4, beta=0.75),
+        ConvolutionLayer("conv3", ["norm2"], kernel=(3, 3), num_output=384,
+                         pad=(1, 1), weight_filler=_gauss(0.01)),
+        ReLULayer("relu3", ["conv3"], in_place=True),
+        ConvolutionLayer("conv4", ["conv3"], kernel=(3, 3), num_output=384,
+                         pad=(1, 1), group=2, weight_filler=_gauss(0.01),
+                         bias_filler=_const(1.0)),
+        ReLULayer("relu4", ["conv4"], in_place=True),
+        ConvolutionLayer("conv5", ["conv4"], kernel=(3, 3), num_output=256,
+                         pad=(1, 1), group=2, weight_filler=_gauss(0.01),
+                         bias_filler=_const(1.0)),
+        ReLULayer("relu5", ["conv5"], in_place=True),
+        PoolingLayer("pool5", ["conv5"], Pooling.Max, kernel=(3, 3), stride=(2, 2)),
+        *_alex_tail("pool5", num_classes),
+    )
+
+
+def caffenet_solver() -> SolverConfig:
+    """ref: caffe/models/bvlc_reference_caffenet/solver.prototxt."""
+    return alexnet_solver()
+
+
+# ---------------------------------------------------------------------------
+# GoogLeNet — the compiler stress test: 9 inception modules, multi-tower
+# concat DAG (ref: caffe/models/bvlc_googlenet/train_val.prototxt, 166
+# layers; main tower — the two training-time auxiliary loss heads are
+# omitted, as at inference in the reference)
+# ---------------------------------------------------------------------------
+def _inception(name: str, bottom: str, c1: int, c3r: int, c3: int,
+               c5r: int, c5: int, cp: int) -> list[Message]:
+    """One inception module: 1x1 / 3x3(reduced) / 5x5(reduced) / pool-proj
+    towers concatenated on channels."""
+    w = lambda: _filler("xavier")
+    b = lambda: _const(0.2)
+    n = f"inception_{name}"
+    layers = [
+        ConvolutionLayer(f"{n}/1x1", [bottom], kernel=(1, 1), num_output=c1,
+                         weight_filler=w(), bias_filler=b()),
+        ReLULayer(f"{n}/relu_1x1", [f"{n}/1x1"], in_place=True),
+        ConvolutionLayer(f"{n}/3x3_reduce", [bottom], kernel=(1, 1),
+                         num_output=c3r, weight_filler=w(), bias_filler=b()),
+        ReLULayer(f"{n}/relu_3x3_reduce", [f"{n}/3x3_reduce"], in_place=True),
+        ConvolutionLayer(f"{n}/3x3", [f"{n}/3x3_reduce"], kernel=(3, 3),
+                         num_output=c3, pad=(1, 1), weight_filler=w(),
+                         bias_filler=b()),
+        ReLULayer(f"{n}/relu_3x3", [f"{n}/3x3"], in_place=True),
+        ConvolutionLayer(f"{n}/5x5_reduce", [bottom], kernel=(1, 1),
+                         num_output=c5r, weight_filler=w(), bias_filler=b()),
+        ReLULayer(f"{n}/relu_5x5_reduce", [f"{n}/5x5_reduce"], in_place=True),
+        ConvolutionLayer(f"{n}/5x5", [f"{n}/5x5_reduce"], kernel=(5, 5),
+                         num_output=c5, pad=(2, 2), weight_filler=w(),
+                         bias_filler=b()),
+        ReLULayer(f"{n}/relu_5x5", [f"{n}/5x5"], in_place=True),
+        PoolingLayer(f"{n}/pool", [bottom], Pooling.Max, kernel=(3, 3),
+                     stride=(1, 1), pad=(1, 1)),
+        ConvolutionLayer(f"{n}/pool_proj", [f"{n}/pool"], kernel=(1, 1),
+                         num_output=cp, weight_filler=w(), bias_filler=b()),
+        ReLULayer(f"{n}/relu_pool_proj", [f"{n}/pool_proj"], in_place=True),
+        ConcatLayer(f"{n}/output",
+                    [f"{n}/1x1", f"{n}/3x3", f"{n}/5x5", f"{n}/pool_proj"]),
+    ]
+    return layers
+
+
+def googlenet(batch: int = 32, num_classes: int = 1000, crop: int = 224) -> Message:
+    w = lambda: _filler("xavier")
+    b = lambda: _const(0.2)
+    layers: list[Message] = [
+        RDDLayer("data", shape=[batch, 3, crop, crop]),
+        RDDLayer("label", shape=[batch]),
+        ConvolutionLayer("conv1/7x7_s2", ["data"], kernel=(7, 7), num_output=64,
+                         stride=(2, 2), pad=(3, 3), weight_filler=w(),
+                         bias_filler=b()),
+        ReLULayer("conv1/relu_7x7", ["conv1/7x7_s2"], in_place=True),
+        PoolingLayer("pool1/3x3_s2", ["conv1/7x7_s2"], Pooling.Max,
+                     kernel=(3, 3), stride=(2, 2)),
+        LRNLayer("pool1/norm1", ["pool1/3x3_s2"], local_size=5, alpha=1e-4,
+                 beta=0.75),
+        ConvolutionLayer("conv2/3x3_reduce", ["pool1/norm1"], kernel=(1, 1),
+                         num_output=64, weight_filler=w(), bias_filler=b()),
+        ReLULayer("conv2/relu_3x3_reduce", ["conv2/3x3_reduce"], in_place=True),
+        ConvolutionLayer("conv2/3x3", ["conv2/3x3_reduce"], kernel=(3, 3),
+                         num_output=192, pad=(1, 1), weight_filler=w(),
+                         bias_filler=b()),
+        ReLULayer("conv2/relu_3x3", ["conv2/3x3"], in_place=True),
+        LRNLayer("conv2/norm2", ["conv2/3x3"], local_size=5, alpha=1e-4,
+                 beta=0.75),
+        PoolingLayer("pool2/3x3_s2", ["conv2/norm2"], Pooling.Max,
+                     kernel=(3, 3), stride=(2, 2)),
+    ]
+    layers += _inception("3a", "pool2/3x3_s2", 64, 96, 128, 16, 32, 32)
+    layers += _inception("3b", "inception_3a/output", 128, 128, 192, 32, 96, 64)
+    layers += [PoolingLayer("pool3/3x3_s2", ["inception_3b/output"],
+                            Pooling.Max, kernel=(3, 3), stride=(2, 2))]
+    layers += _inception("4a", "pool3/3x3_s2", 192, 96, 208, 16, 48, 64)
+    layers += _inception("4b", "inception_4a/output", 160, 112, 224, 24, 64, 64)
+    layers += _inception("4c", "inception_4b/output", 128, 128, 256, 24, 64, 64)
+    layers += _inception("4d", "inception_4c/output", 112, 144, 288, 32, 64, 64)
+    layers += _inception("4e", "inception_4d/output", 256, 160, 320, 32, 128, 128)
+    layers += [PoolingLayer("pool4/3x3_s2", ["inception_4e/output"],
+                            Pooling.Max, kernel=(3, 3), stride=(2, 2))]
+    layers += _inception("5a", "pool4/3x3_s2", 256, 160, 320, 32, 128, 128)
+    layers += _inception("5b", "inception_5a/output", 384, 192, 384, 48, 128, 128)
+    layers += [
+        PoolingLayer("pool5/7x7_s1", ["inception_5b/output"], Pooling.Ave,
+                     kernel=(7, 7), stride=(1, 1)),
+        DropoutLayer("pool5/drop_7x7_s1", ["pool5/7x7_s1"], ratio=0.4, in_place=True),
+        InnerProductLayer("loss3/classifier", ["pool5/7x7_s1"],
+                          num_output=num_classes, weight_filler=w(),
+                          bias_filler=_const(0.0)),
+        SoftmaxWithLoss("loss3/loss3", ["loss3/classifier", "label"]),
+        AccuracyLayer("loss3/top-1", ["loss3/classifier", "label"]),
+        AccuracyLayer("loss3/top-5", ["loss3/classifier", "label"], top_k=5),
+    ]
+    return NetParam("GoogleNet", *layers)
+
+
+def googlenet_solver() -> SolverConfig:
+    """ref: caffe/models/bvlc_googlenet/solver.prototxt."""
+    return SolverConfig(
+        base_lr=0.01, lr_policy="step", gamma=0.96, stepsize=320000,
+        momentum=0.9, weight_decay=2e-4, max_iter=2400000,
+        solver_type="SGD", display=40,
+    )

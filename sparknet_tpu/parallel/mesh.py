@@ -10,7 +10,6 @@ from `jax.distributed.initialize` over DCN.
 
 from __future__ import annotations
 
-import math
 
 import jax
 import numpy as np

@@ -25,7 +25,6 @@ ImageNet — ref: CifarApp.scala:119, ImageNetApp.scala:151).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Callable
 
 import jax
@@ -49,7 +48,6 @@ from sparknet_tpu.parallel.sharding import (
     batch_sharding,
     param_shardings,
     place,
-    replicated,
 )
 from sparknet_tpu.solvers.solver import Solver
 

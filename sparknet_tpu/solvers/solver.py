@@ -97,7 +97,7 @@ class SolverConfig:
             regularization_type=m.get_str("regularization_type", "L2"),
             clip_gradients=m.get_float("clip_gradients", -1.0),
             iter_size=m.get_int("iter_size", 1),
-            solver_type=_TYPE_ALIASES.get(stype, "SGD"),
+            solver_type=_TYPE_ALIASES[stype],
             random_seed=m.get_int("random_seed", -1),
             test_iter=tuple(int(v) for v in m.get_all("test_iter")),
             test_interval=m.get_int("test_interval", 0),
