@@ -1,0 +1,75 @@
+"""Headline benchmark: AlexNet-class (CaffeNet-recipe) training throughput.
+
+Mirrors the reference's own benchmark protocol — time 20 solver iterations
+at batch 256 on one chip and report images/sec (ref:
+caffe/docs/performance_hardware.md:17-24: K40 26.5 s/20 iter = 193 img/s,
+cuDNN 19.2 s = 267 img/s).  ``vs_baseline`` is measured against the best
+published single-GPU number (267 img/s, K40 + cuDNN).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparknet_tpu import models
+from sparknet_tpu.solvers.solver import Solver
+
+BASELINE_IMG_S = 267.0  # K40 + cuDNN CaffeNet training (performance_hardware.md:22-24)
+
+
+def main() -> None:
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+    batch = 256 if on_accel else 16
+    iters = 20 if on_accel else 2
+    warmup = 3 if on_accel else 1
+
+    solver = Solver(models.alexnet_solver(), models.alexnet(batch))
+    step = jax.jit(solver._make_train_step(), donate_argnums=(0, 1))
+
+    rs = np.random.RandomState(0)
+    feeds = {
+        "data": jnp.asarray(rs.randn(batch, 3, 227, 227) * 50, jnp.float32),
+        "label": jnp.asarray(rs.randint(0, 1000, batch), jnp.int32),
+    }
+    feeds = jax.device_put(feeds)
+
+    variables, slots = solver.variables, solver.slots
+    for i in range(warmup):
+        variables, slots, loss = step(variables, slots, i, feeds, solver._key)
+    # Fetch the VALUE, not just readiness: remote-relay backends (axon) can
+    # report buffers ready before the chain has executed; pulling the scalar
+    # is the reliable fence.
+    float(loss)
+
+    t0 = time.perf_counter()
+    for i in range(warmup, warmup + iters):
+        variables, slots, loss = step(variables, slots, i, feeds, solver._key)
+    final_loss = float(loss)
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss), final_loss
+
+    img_s = batch * iters / dt
+    print(
+        json.dumps(
+            {
+                "metric": "alexnet_train_images_per_sec_per_chip",
+                "value": round(img_s, 1),
+                "unit": "img/s",
+                "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())
