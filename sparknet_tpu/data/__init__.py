@@ -1,0 +1,26 @@
+"""Host data plane: loaders, augmentation, minibatching, device prefetch.
+
+Replaces the reference's two feed paths with one TPU-native pipeline:
+- Spark-RDD → JNA-callback pull feed (ref:
+  caffe/src/caffe/layers/java_data_layer.cpp + libs/MinibatchSampler.scala),
+  whose measured FFI tax was ~1.2 s per 256-image batch (ref:
+  src/test/scala/apps/CallbackBenchmarkSpec.scala:3-17);
+- Caffe's own LMDB DataReader + prefetch thread (ref:
+  caffe/src/caffe/data_reader.cpp, base_data_layer.cpp).
+
+Here: numpy-vectorized decode/augment on the host, fixed-size minibatch
+packing, and a background double-buffered device prefetcher so the feed
+never sits on the jitted step's critical path.
+"""
+
+from sparknet_tpu.data.cifar import CifarLoader  # noqa: F401
+from sparknet_tpu.data.sampler import MinibatchSampler  # noqa: F401
+from sparknet_tpu.data.transform import DataTransformer, TransformConfig  # noqa: F401
+from sparknet_tpu.data.minibatch import (  # noqa: F401
+    compute_mean,
+    compute_mean_from_minibatches,
+    make_minibatches,
+    make_minibatches_compressed,
+)
+from sparknet_tpu.data.archive import ImageNetLoader, list_archive_samples  # noqa: F401
+from sparknet_tpu.data.prefetch import DevicePrefetcher  # noqa: F401

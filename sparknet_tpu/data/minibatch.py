@@ -1,0 +1,85 @@
+"""Decode/resize + fixed-size minibatch packing + distributed mean.
+
+Equivalents of the reference's preprocessing stage:
+- ``make_minibatches_compressed``: JPEG bytes -> decode -> force-resize ->
+  packed minibatch arrays, dropping undecodable images and the ragged tail
+  (ref: src/main/scala/preprocessing/ScaleAndConvert.scala:16-70).
+- ``make_minibatches``: already-decoded arrays -> packed minibatches
+  (ref: ScaleAndConvert.scala:72-91).
+- ``compute_mean`` / ``compute_mean_from_minibatches``: mean image over the
+  dataset; the reference accumulates Long sums per partition then reduces
+  on the driver (ref: preprocessing/ComputeMean.scala:8-76) — here one
+  float64 accumulator per shard, summed at the end, so multi-process
+  ingest can reduce partial sums the same way.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Iterable, Iterator
+
+import numpy as np
+
+
+def decode_jpeg(data: bytes, height: int, width: int) -> np.ndarray | None:
+    """Decode + force-resize to (3, height, width) uint8; None if broken
+    (the reference drops undecodable images, ScaleAndConvert.scala:19-26)."""
+    try:
+        from PIL import Image
+
+        img = Image.open(io.BytesIO(data)).convert("RGB")
+        img = img.resize((width, height))  # force-resize, no aspect keep
+        return np.asarray(img, np.uint8).transpose(2, 0, 1)
+    except Exception:
+        return None
+
+
+def make_minibatches_compressed(
+    samples: Iterable[tuple[bytes, int]],
+    batch_size: int,
+    height: int,
+    width: int,
+) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    """(jpeg_bytes, label) stream -> (images NCHW uint8, labels) minibatches;
+    ragged tail dropped (ref: ScaleAndConvert.scala:45-70)."""
+    imgs, labels = [], []
+    for data, label in samples:
+        arr = decode_jpeg(data, height, width)
+        if arr is None:
+            continue
+        imgs.append(arr)
+        labels.append(label)
+        if len(imgs) == batch_size:
+            yield np.stack(imgs), np.asarray(labels, np.int32)
+            imgs, labels = [], []
+
+
+def make_minibatches(
+    images: np.ndarray, labels: np.ndarray, batch_size: int
+) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    """Packed fixed-size minibatches, ragged tail dropped
+    (ref: ScaleAndConvert.scala:72-91)."""
+    n = (len(labels) // batch_size) * batch_size
+    for lo in range(0, n, batch_size):
+        yield images[lo : lo + batch_size], labels[lo : lo + batch_size]
+
+
+def compute_mean(images: np.ndarray) -> np.ndarray:
+    """Mean image of a decoded array (ref: ComputeMean.scala:8-38)."""
+    return images.astype(np.float64).mean(axis=0).astype(np.float32)
+
+
+def compute_mean_from_minibatches(
+    minibatches: Iterable[tuple[np.ndarray, np.ndarray]],
+    shape: tuple[int, ...],
+) -> np.ndarray:
+    """Streaming mean over minibatches — integer-exact accumulation like the
+    reference's Long accumulators (ref: ComputeMean.scala:40-76)."""
+    acc = np.zeros(shape, np.float64)
+    count = 0
+    for imgs, _ in minibatches:
+        acc += imgs.astype(np.float64).sum(axis=0)
+        count += len(imgs)
+    if count == 0:
+        raise ValueError("no minibatches")
+    return (acc / count).astype(np.float32)

@@ -1,0 +1,81 @@
+"""Per-sample augmentation: scale, mirror, crop, mean subtraction.
+
+Equivalent of Caffe's ``DataTransformer`` (ref:
+caffe/src/caffe/util/data_transformer.cpp:19-119: mean_file/mean_value
+subtract, random crop + mirror in TRAIN, center crop in TEST, scale) and of
+the Scala-side preprocessing closures (ref:
+src/main/scala/apps/ImageNetApp.scala:124-138 center crop, :162-176
+mean-subtract + random crop inside the JNA callback).
+
+Whole-batch vectorized numpy with a seeded RNG — the reference transforms
+one sample at a time in C++ or per-callback in Scala; the measured callback
+tax (~1.2 s / 256-image batch, CallbackBenchmarkSpec.scala:3-17) is the
+design lesson: this path must stay off the step's critical path, so it is
+batched here and typically wrapped in the DevicePrefetcher's worker thread.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class TransformConfig:
+    """ref: TransformationParameter (caffe.proto:399-426)."""
+
+    scale: float = 1.0
+    mirror: bool = False
+    crop_size: int = 0
+    mean_value: tuple[float, ...] = ()  # per-channel
+    mean_image: np.ndarray | None = None  # full mean image (C,H,W)
+    seed: int | None = None
+
+
+class DataTransformer:
+    def __init__(self, config: TransformConfig):
+        self.config = config
+        self._rs = np.random.RandomState(config.seed)
+        if config.mean_image is not None and config.mean_value:
+            raise ValueError("specify mean_image or mean_value, not both")
+
+    # ------------------------------------------------------------------
+    def __call__(self, images: np.ndarray, train: bool) -> np.ndarray:
+        """images: (N, C, H, W) uint8/float -> float32 transformed batch."""
+        cfg = self.config
+        x = images.astype(np.float32, copy=True)
+        if cfg.mean_image is not None:
+            x -= cfg.mean_image[None]
+        elif cfg.mean_value:
+            mv = np.asarray(cfg.mean_value, np.float32)
+            x -= mv.reshape(1, -1, 1, 1)
+        if cfg.crop_size:
+            x = self._crop(x, train)
+        if train and cfg.mirror:
+            flip = self._rs.randint(0, 2, len(x)).astype(bool)
+            x[flip] = x[flip, :, :, ::-1]
+        if cfg.scale != 1.0:
+            x *= cfg.scale
+        return x
+
+    # ------------------------------------------------------------------
+    def _crop(self, x: np.ndarray, train: bool) -> np.ndarray:
+        """TRAIN: per-sample random crop; TEST: center crop (ref:
+        data_transformer.cpp:49,83)."""
+        c = self.config.crop_size
+        n, ch, h, w = x.shape
+        if h < c or w < c:
+            raise ValueError(f"crop {c} larger than image {h}x{w}")
+        if not train:
+            ho, wo = (h - c) // 2, (w - c) // 2
+            return x[:, :, ho : ho + c, wo : wo + c]
+        hos = self._rs.randint(0, h - c + 1, n)
+        wos = self._rs.randint(0, w - c + 1, n)
+        # gather per-sample windows via advanced indexing (no python loop)
+        rows = hos[:, None] + np.arange(c)[None]  # (N, c)
+        cols = wos[:, None] + np.arange(c)[None]
+        return x[np.arange(n)[:, None, None, None],
+                 np.arange(ch)[None, :, None, None],
+                 rows[:, None, :, None],
+                 cols[:, None, None, :]]
