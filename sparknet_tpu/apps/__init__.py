@@ -1,0 +1,12 @@
+"""Driver apps: the reference's ``apps.*`` entry points, TPU-native.
+
+Each reference app is a Spark driver ``main()`` wiring loaders →
+preprocessing → per-worker CaffeNet → the broadcast/train(τ)/collect loop
+(ref: src/main/scala/apps/).  Here each app wires loaders → transformer →
+``ParallelTrainer`` over the device mesh; the sync loop is one jitted
+program per outer round.
+"""
+
+from sparknet_tpu.apps.cifar_app import CifarApp  # noqa: F401
+from sparknet_tpu.apps.imagenet_app import ImageNetApp  # noqa: F401
+from sparknet_tpu.apps.featurizer import FeaturizerApp  # noqa: F401

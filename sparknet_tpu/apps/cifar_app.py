@@ -1,0 +1,117 @@
+"""CIFAR-10 distributed training app.
+
+The canonical training driver (ref: src/main/scala/apps/CifarApp.scala:
+14-140): load CIFAR → shard per worker → outer loop {broadcast weights,
+τ=10 local steps per worker, collect+average, test every 10 rounds}.
+
+TPU-native shape of the same program: the data is sharded per mesh worker
+up front; each outer round is ONE jitted tau-round (local scans + pmean);
+eval uses the reference's sum-then-normalize score semantics; every phase
+is stamped into the EventLogger exactly like the reference's
+training_log_<ts>.txt.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from sparknet_tpu import models
+from sparknet_tpu.data import CifarLoader, DataTransformer, TransformConfig
+from sparknet_tpu.parallel.trainer import ParallelTrainer
+from sparknet_tpu.solvers.solver import Solver
+from sparknet_tpu.utils import EventLogger, SignalHandler, SolverAction
+
+TAU = 10  # ref: CifarApp.scala:119 (syncInterval)
+TEST_EVERY = 10  # ref: CifarApp.scala:101
+BATCH = 100
+
+
+class CifarApp:
+    def __init__(
+        self,
+        data_dir: str,
+        mesh=None,
+        tau: int = TAU,
+        batch: int = BATCH,
+        log_dir: str = ".",
+        seed: int = 0,
+    ):
+        self.log = EventLogger(log_dir, prefix="cifar_training_log")
+        self.log("loading CIFAR data")
+        loader = CifarLoader(data_dir, seed=seed)
+        self.transform = DataTransformer(
+            TransformConfig(mean_image=loader.mean_image, seed=seed)
+        )
+        self.train_images, self.train_labels = loader.train_images, loader.train_labels
+        self.test_images, self.test_labels = loader.test_images, loader.test_labels
+        self.batch = batch
+        self.tau = tau
+        self._rs = np.random.RandomState(seed)
+
+        self.log("building solver + trainer")
+        per_worker_batch = batch
+        solver = Solver(
+            models.cifar10_full_solver(), models.cifar10_full(per_worker_batch)
+        )
+        self.trainer = ParallelTrainer(solver, mesh=mesh, tau=tau)
+        self.num_workers = self.trainer.num_workers
+        self.global_batch = batch * self.num_workers
+        self.log(f"mesh: {self.num_workers} workers, tau={tau}")
+
+    # ------------------------------------------------------------------
+    def _train_feeds(self, it: int) -> dict[str, np.ndarray]:
+        """[tau, B_global, ...] feeds: each worker's shard gets its own
+        contiguous window (the zipPartitions closure, CifarApp.scala:118-130)."""
+        n = len(self.train_labels)
+        need = self.tau * self.global_batch
+        if need > n:
+            raise ValueError(
+                f"train set holds {n} samples; tau={self.tau} x global batch "
+                f"{self.global_batch} needs {need} — reduce tau/batch/workers"
+            )
+        start = self._rs.randint(0, n - need + 1)
+        sl = slice(start, start + need)
+        data = self.transform(self.train_images[sl], train=True)
+        labels = self.train_labels[sl].astype(np.int32)
+        shape = (self.tau, self.global_batch)
+        return {
+            "data": data.reshape(shape + data.shape[1:]),
+            "label": labels.reshape(shape),
+        }
+
+    def _test_feeds(self, b: int) -> dict[str, np.ndarray]:
+        lo = (b * self.global_batch) % max(len(self.test_labels) - self.global_batch, 1)
+        sl = slice(lo, lo + self.global_batch)
+        return {
+            "data": self.transform(self.test_images[sl], train=False),
+            "label": self.test_labels[sl].astype(np.int32),
+        }
+
+    # ------------------------------------------------------------------
+    def run(self, num_outer: int = 50, num_test_batches: int = 10) -> dict[str, float]:
+        """The outer sync loop (ref: CifarApp.scala:95-136)."""
+        scores: dict[str, float] = {}
+        with SignalHandler() as sig:
+            for outer in range(num_outer):
+                if outer % TEST_EVERY == 0:
+                    self.log("testing", i=outer)
+                    scores = self.trainer.test(num_test_batches, self._test_feeds)
+                    self.log(f"scores: {scores}", i=outer)
+                self.log("training", i=outer)
+                loss = self.trainer.train_round(self._train_feeds)
+                self.log(f"loss: {loss:.5f}", i=outer)
+                action = sig.check()
+                if action is SolverAction.SNAPSHOT:
+                    self.snapshot(f"cifar_iter_{self.trainer.iter}")
+                elif action is SolverAction.STOP:
+                    self.log("stop requested", i=outer)
+                    break
+        scores = self.trainer.test(num_test_batches, self._test_feeds)
+        self.log(f"final scores: {scores}")
+        return scores
+
+    def snapshot(self, prefix: str) -> str:
+        self.trainer.sync_to_solver()
+        path = self.trainer.solver.save(prefix)
+        self.log(f"snapshot -> {path}")
+        return path

@@ -1,0 +1,38 @@
+"""Feature extraction app.
+
+ref: src/main/scala/apps/FeaturizerApp.scala:14-107 — set the weights once,
+``forward()`` each minibatch, read an intermediate blob ("ip1") from
+``getData``.  Here ``TPUNet.forward`` returns all blobs of the jitted
+forward program; extraction over a dataset is a jit-compiled map.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator
+
+import numpy as np
+
+from sparknet_tpu.net import TPUNet, WeightCollection
+
+
+class FeaturizerApp:
+    def __init__(self, net: TPUNet, feature_blob: str = "ip1"):
+        self.net = net
+        self.feature_blob = feature_blob
+
+    def set_weights(self, wc: WeightCollection) -> None:
+        self.net.set_weights(wc)
+
+    def featurize(
+        self, minibatches: Iterable[dict[str, np.ndarray]]
+    ) -> Iterator[np.ndarray]:
+        """Yield the feature blob per minibatch (ref:
+        FeaturizerApp.scala:88-102 forward + getData)."""
+        for feeds in minibatches:
+            blobs = self.net.forward(feeds)
+            if self.feature_blob not in blobs:
+                raise KeyError(
+                    f"blob {self.feature_blob!r} not in net; have "
+                    f"{sorted(blobs)}"
+                )
+            yield np.asarray(blobs[self.feature_blob])

@@ -1,0 +1,285 @@
+"""``tpunet`` — the framework CLI.
+
+Equivalent of the ``caffe`` brew tool (ref: caffe/tools/caffe.cpp:153-380:
+train/test/time/device_query subcommands wired through gflags).  argparse
+subcommands; model/solver configs are prototxt paths (parsed by the
+framework's own text-format parser) or zoo names (``zoo:alexnet``).
+
+Data sources (the reference's in-net LMDB layers are host-plane inputs
+here): ``--data cifar:<dir>`` reads real CIFAR-10 binaries;
+``--data synthetic`` generates pixel-scale random batches (enough for
+``time``/smoke runs, like ``caffe time``'s dummy forward/backward).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def _build_net_and_solver(args):
+    from sparknet_tpu import models
+    from sparknet_tpu.proto.text_format import parse_file
+    from sparknet_tpu.solvers.solver import SolverConfig, load_solver_net
+
+    if not args.solver:
+        raise SystemExit("--solver is required (prototxt path or zoo:<name>)")
+    if args.solver.startswith("zoo:"):
+        name = args.solver[4:]
+        net_param = getattr(models, name)(args.batch or 100)
+        solver_cfg = getattr(models, f"{name}_solver")()
+        return net_param, solver_cfg
+    solver_msg = parse_file(args.solver)
+    net_param = load_solver_net(solver_msg, root="")
+    return net_param, SolverConfig.from_proto(solver_msg)
+
+
+def _feed_shapes(net):
+    shapes = net.feed_shapes()
+    if not shapes:
+        raise SystemExit("net declares no input shapes; use RDD/Input layers")
+    return shapes
+
+
+def _data_fns(args, net):
+    """(train_fn, test_fn) from --data."""
+    shapes = _feed_shapes(net)
+    data_shape = shapes["data"]
+    batch = data_shape[0]
+
+    if args.data.startswith("cifar:"):
+        from sparknet_tpu.data import CifarLoader, DataTransformer, TransformConfig
+
+        loader = CifarLoader(args.data[6:])
+        xform = DataTransformer(TransformConfig(mean_image=loader.mean_image))
+        xtr, ytr = loader.train_images, loader.train_labels
+        xte, yte = loader.test_images, loader.test_labels
+
+        if batch > len(ytr) or batch > len(yte):
+            raise SystemExit(
+                f"--batch {batch} exceeds dataset size {min(len(ytr), len(yte))}")
+
+        def train_fn(it):
+            lo = (it * batch) % (len(ytr) - batch + 1)
+            return {
+                "data": xform(xtr[lo : lo + batch], True),
+                "label": ytr[lo : lo + batch].astype(np.int32),
+            }
+
+        def test_fn(b):
+            lo = (b * batch) % (len(yte) - batch + 1)
+            return {
+                "data": xform(xte[lo : lo + batch], False),
+                "label": yte[lo : lo + batch].astype(np.int32),
+            }
+
+        return train_fn, test_fn
+
+    if args.data == "synthetic":
+        rs = np.random.RandomState(0)
+        num_classes = 10
+
+        def synth(it):
+            return {
+                "data": (rs.randn(*data_shape) * 50).astype(np.float32),
+                "label": rs.randint(0, num_classes, batch).astype(np.int32),
+            }
+
+        return synth, synth
+
+    raise SystemExit(f"unknown --data source {args.data!r}")
+
+
+# ---------------------------------------------------------------------------
+def cmd_train(args) -> int:
+    """ref: caffe.cpp:153-218 train()."""
+    from sparknet_tpu.parallel.trainer import ParallelTrainer
+    from sparknet_tpu.solvers.solver import Solver
+    from sparknet_tpu.utils import EventLogger, SignalHandler, SolverAction
+
+    net_param, solver_cfg = _build_net_and_solver(args)
+    solver = Solver(solver_cfg, net_param)
+    if args.snapshot:
+        solver.restore(args.snapshot)
+    log = EventLogger(".", prefix="tpunet_train")
+    train_fn, test_fn = _data_fns(args, solver.train_net)
+
+    iters = args.iterations or solver_cfg.max_iter
+    if args.tau > 1 or args.distributed:
+        trainer = ParallelTrainer(solver, tau=args.tau)
+        outer = iters // max(args.tau, 1)
+        with SignalHandler() as sig:
+            for o in range(outer):
+                if args.tau > 1:
+                    tau_fn = _stack_tau(
+                        train_fn, args.tau, trainer.num_workers, trainer.iter
+                    )
+                    loss = trainer.train_round(tau_fn)
+                else:
+                    loss = trainer.train_round(
+                        _widen_batch(train_fn, trainer.num_workers)
+                    )
+                log(f"loss: {loss:.5f}", i=trainer.iter)
+                action = sig.check()
+                if action is SolverAction.SNAPSHOT:
+                    trainer.sync_to_solver()
+                    solver.save(f"tpunet_iter_{trainer.iter}")
+                elif action is SolverAction.STOP:
+                    break
+        trainer.sync_to_solver()
+    else:
+        with SignalHandler() as sig:
+            def hook(it, loss):
+                action = sig.check()
+                if action is SolverAction.SNAPSHOT:
+                    solver.save(f"tpunet_iter_{it}")
+                elif action is SolverAction.STOP:
+                    raise KeyboardInterrupt
+
+            try:
+                solver.step(iters, train_fn, callback=hook)
+            except KeyboardInterrupt:
+                log("stopped by signal", i=solver.iter)
+    if args.test_iters:
+        scores = solver.test(args.test_iters, test_fn)
+        log(f"scores: {scores}")
+    out = solver.save(args.output or "tpunet_final")
+    log(f"saved {out}")
+    return 0
+
+
+def _stack_tau(train_fn, tau, num_workers, base_it):
+    """[tau, B*workers, ...] feeds: the net batch is per-worker; each tau
+    slot concatenates one batch per worker (the global minibatch)."""
+
+    def fn(it):
+        slots = []
+        k = 0
+        for _ in range(tau):
+            parts = [train_fn(base_it + (k := k + 1)) for _ in range(num_workers)]
+            slots.append({key: np.concatenate([p[key] for p in parts]) for key in parts[0]})
+        return {key: np.stack([s[key] for s in slots]) for key in slots[0]}
+
+    return fn
+
+
+def _widen_batch(train_fn, num_workers):
+    """tau=1 global batch: concatenate one per-worker batch per worker."""
+    if num_workers == 1:
+        return train_fn
+
+    def fn(it):
+        parts = [train_fn(it * num_workers + w) for w in range(num_workers)]
+        return {key: np.concatenate([p[key] for p in parts]) for key in parts[0]}
+
+    return fn
+
+
+def cmd_test(args) -> int:
+    """ref: caffe.cpp:222-287 test()."""
+    from sparknet_tpu.solvers.solver import Solver
+
+    net_param, solver_cfg = _build_net_and_solver(args)
+    solver = Solver(solver_cfg, net_param)
+    if args.snapshot:
+        solver.restore(args.snapshot)
+    _, test_fn = _data_fns(args, solver.test_net)
+    scores = solver.test(args.iterations or 10, test_fn)
+    print(json.dumps(scores))
+    return 0
+
+
+def cmd_time(args) -> int:
+    """Per-layer forward/backward breakdown (ref: caffe.cpp:290-380)."""
+    from sparknet_tpu.common import Phase
+    from sparknet_tpu.compiler.graph import Network
+    from sparknet_tpu.utils.timing import time_layers
+    import jax
+
+    net_param, _ = _build_net_and_solver(args)
+    net = Network(net_param, Phase.TRAIN)
+    variables = net.init(jax.random.PRNGKey(0))
+    train_fn, _ = _data_fns(args, net)
+    feeds = train_fn(0)
+    rows = time_layers(net, variables, feeds, iterations=args.iterations or 10)
+    w = max(len(r["layer"]) for r in rows) + 2
+    print(f"{'layer':<{w}}{'type':<18}{'forward':>10}  {'backward':>10}")
+    tot_f = tot_b = 0.0
+    for r in rows:
+        b = f"{r['backward_ms']:.3f}" if r["backward_ms"] is not None else "-"
+        print(f"{r['layer']:<{w}}{r['type']:<18}{r['forward_ms']:>9.3f}ms {b:>9}ms")
+        tot_f += r["forward_ms"]
+        tot_b += r["backward_ms"] or 0.0
+    print(f"{'TOTAL':<{w}}{'':<18}{tot_f:>9.3f}ms {tot_b:>9.3f}ms")
+    print("(layers timed in isolation; the fused jit step is faster)")
+    return 0
+
+
+def cmd_device_query(args) -> int:
+    """ref: caffe.cpp:110-150 device_query()."""
+    import jax
+
+    for d in jax.devices():
+        print(
+            json.dumps(
+                {
+                    "id": d.id,
+                    "platform": d.platform,
+                    "device_kind": d.device_kind,
+                    "process_index": d.process_index,
+                }
+            )
+        )
+    return 0
+
+
+# ---------------------------------------------------------------------------
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tpunet", description=__doc__)
+    p.add_argument(
+        "--platform",
+        default="",
+        help="force a jax platform (cpu/tpu); the config route wins over "
+        "JAX_PLATFORMS when a site hook pins it",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def common(sp):
+        sp.add_argument("--solver", help="solver prototxt path or zoo:<name>")
+        sp.add_argument("--data", default="synthetic", help="cifar:<dir> | synthetic")
+        sp.add_argument("--batch", type=int, default=0, help="zoo batch override")
+        sp.add_argument("--iterations", type=int, default=0)
+        sp.add_argument("--snapshot", help=".solverstate.npz to restore")
+
+    sp = sub.add_parser("train", help="train a model")
+    common(sp)
+    sp.add_argument("--tau", type=int, default=1, help="model-averaging interval")
+    sp.add_argument("--distributed", action="store_true", help="use the device mesh")
+    sp.add_argument("--test-iters", type=int, default=0)
+    sp.add_argument("--output", help="snapshot prefix for the final model")
+    sp.set_defaults(fn=cmd_train)
+
+    sp = sub.add_parser("test", help="score a model")
+    common(sp)
+    sp.set_defaults(fn=cmd_test)
+
+    sp = sub.add_parser("time", help="per-layer timing")
+    common(sp)
+    sp.set_defaults(fn=cmd_time)
+
+    sp = sub.add_parser("device_query", help="show devices")
+    sp.set_defaults(fn=cmd_device_query)
+
+    args = p.parse_args(argv)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

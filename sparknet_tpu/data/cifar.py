@@ -14,7 +14,6 @@ import os
 import numpy as np
 
 _RECORD = 1 + 3 * 32 * 32
-_PER_FILE = 10000
 
 
 def _read_batch_file(path: str) -> tuple[np.ndarray, np.ndarray]:

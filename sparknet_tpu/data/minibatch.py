@@ -24,9 +24,9 @@ import numpy as np
 def decode_jpeg(data: bytes, height: int, width: int) -> np.ndarray | None:
     """Decode + force-resize to (3, height, width) uint8; None if broken
     (the reference drops undecodable images, ScaleAndConvert.scala:19-26)."""
-    try:
-        from PIL import Image
+    from PIL import Image  # outside the guard: a missing dep must fail loud
 
+    try:
         img = Image.open(io.BytesIO(data)).convert("RGB")
         img = img.resize((width, height))  # force-resize, no aspect keep
         return np.asarray(img, np.uint8).transpose(2, 0, 1)

@@ -45,6 +45,7 @@ class DevicePrefetcher:
         self._err: BaseException | None = None
         self._start = start_iter
         self._stop = threading.Event()
+        self._finished = False
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
 
@@ -82,12 +83,16 @@ class DevicePrefetcher:
     def close(self) -> None:
         """Stop the worker and release queued device batches."""
         self._stop.set()
+        self._drain()
+        self._thread.join(timeout=5.0)
+        self._drain()  # a racing _put may have landed one item mid-drain
+
+    def _drain(self) -> None:
         while True:
             try:
                 self._q.get_nowait()
             except queue.Empty:
                 break
-        self._thread.join(timeout=5.0)
 
     def __enter__(self):
         return self
@@ -96,9 +101,16 @@ class DevicePrefetcher:
         self.close()
 
     def __iter__(self):
+        if self._finished:
+            # single-use stream: a second iteration would block forever on
+            # the empty queue
+            if self._err is not None:
+                raise self._err
+            return
         while True:
             item = self._q.get()
             if item is _DONE:
+                self._finished = True
                 if self._err is not None:
                     raise self._err
                 return

@@ -1,0 +1,32 @@
+"""Wall-clock event log for training drivers.
+
+Equivalent of the reference apps' driver-side log — every step appends
+"elapsed: message, i=N" lines to ``training_log_<timestamp>.txt`` (ref:
+src/main/scala/apps/CifarApp.scala:36-46 ``log()``).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+class EventLogger:
+    def __init__(self, directory: str = ".", prefix: str = "training_log", echo: bool = True):
+        os.makedirs(directory, exist_ok=True)
+        ts = int(time.time())
+        self.path = os.path.join(directory, f"{prefix}_{ts}.txt")
+        self._t0 = time.time()
+        self._echo = echo
+        with open(self.path, "w") as f:
+            f.write(f"start {ts}\n")
+
+    def log(self, message: str, i: int = -1) -> None:
+        elapsed = time.time() - self._t0
+        line = f"{elapsed:.3f}: {message}" + (f", i = {i}" if i != -1 else "")
+        with open(self.path, "a") as f:
+            f.write(line + "\n")
+        if self._echo:
+            print(line, flush=True)
+
+    __call__ = log

@@ -1,0 +1,61 @@
+"""Graceful stop/snapshot on signals.
+
+Equivalent of Caffe's signal control (ref:
+caffe/src/caffe/util/signal_handler.cpp:16-43 maps SIGINT/SIGHUP to
+``SolverAction::{STOP,SNAPSHOT}``, polled once per iteration in
+Solver::Step, ref: caffe/src/caffe/solver.cpp:267-280).  Async-signal-safe
+by construction: the handler only flips a flag; the training loop polls
+``check()`` between steps.
+"""
+
+from __future__ import annotations
+
+import enum
+import signal
+
+
+class SolverAction(enum.Enum):
+    NONE = 0
+    STOP = 1
+    SNAPSHOT = 2
+
+
+class SignalHandler:
+    """Install with desired actions; poll ``check()`` each iteration."""
+
+    def __init__(
+        self,
+        sigint_action: SolverAction = SolverAction.STOP,
+        sighup_action: SolverAction = SolverAction.SNAPSHOT,
+    ):
+        self._actions = {
+            signal.SIGINT: sigint_action,
+            signal.SIGHUP: sighup_action,
+        }
+        self._pending: SolverAction = SolverAction.NONE
+        self._previous: dict[int, object] = {}
+
+    def _handler(self, signum, frame):
+        self._pending = self._actions.get(signum, SolverAction.NONE)
+
+    def install(self) -> "SignalHandler":
+        for sig, action in self._actions.items():
+            if action is not SolverAction.NONE:
+                self._previous[sig] = signal.signal(sig, self._handler)
+        return self
+
+    def uninstall(self) -> None:
+        for sig, prev in self._previous.items():
+            signal.signal(sig, prev)
+        self._previous.clear()
+
+    def check(self) -> SolverAction:
+        """Pop the pending action (one-shot, like GotSIGINT/GotSIGHUP)."""
+        action, self._pending = self._pending, SolverAction.NONE
+        return action
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()

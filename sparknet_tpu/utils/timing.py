@@ -1,0 +1,103 @@
+"""Timing utilities: device-fenced timer + per-layer cost breakdown.
+
+Equivalents of Caffe's cudaEvent ``Timer`` (ref:
+caffe/src/caffe/util/benchmark.cpp:18-82) and the ``caffe time`` brew's
+per-layer forward/backward timing loop (ref:
+caffe/tools/caffe.cpp:290-380).  On TPU a real training step is ONE fused
+XLA program, so per-layer numbers here are diagnostic (each layer jitted
+and fenced in isolation) — the fused step is strictly faster; use
+``jax.profiler`` traces for the true schedule.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import jax
+import numpy as np
+
+
+class Timer:
+    """start/stop wall timer with a device fence on stop (the cudaEvent
+    synchronize analog)."""
+
+    def __init__(self):
+        self._t0 = None
+        self.elapsed_ms = 0.0
+
+    def start(self) -> "Timer":
+        self._t0 = time.perf_counter()
+        return self
+
+    def stop(self, fence: Any = None) -> float:
+        if fence is not None:
+            jax.block_until_ready(fence)
+        self.elapsed_ms = (time.perf_counter() - self._t0) * 1e3
+        return self.elapsed_ms
+
+
+def time_layers(network, variables, feeds, iterations: int = 10) -> list[dict]:
+    """Per-layer forward+backward timing (the ``caffe time`` table).
+
+    Executes the net layer-by-layer with each layer's apply jitted and
+    fenced separately; returns [{layer, type, forward_ms, backward_ms}].
+    """
+    rng = jax.random.PRNGKey(0)
+    blobs: dict[str, Any] = dict(feeds)
+    rows: list[dict] = []
+    for layer in network.layers:
+        lname = layer.name
+        if not layer.bottoms and all(t in blobs for t in layer.tops):
+            continue  # input layer: its tops are the feeds
+        params = variables.params.get(lname, [])
+        state = variables.state.get(lname, {})
+        inputs = [blobs[b] for b in layer.bottoms]
+
+        def fwd(params, state, inputs):
+            out = layer.apply(params, state, inputs, train=True, rng=rng)
+            return out.outputs
+
+        jfwd = jax.jit(fwd)
+        tops = jfwd(params, state, inputs)  # compile + capture outputs
+        t = Timer().start()
+        for _ in range(iterations):
+            tops = jfwd(params, state, inputs)
+        fwd_ms = t.stop(tops) / iterations
+
+        bwd_ms = float("nan")
+        float_idx = [
+            i for i, x in enumerate(inputs)
+            if np.issubdtype(np.asarray(x).dtype, np.floating)
+        ]
+        if float_idx:
+            # differentiate w.r.t. params + the float inputs only (labels
+            # and other integer bottoms are non-differentiable)
+            def loss_like(params, float_ins):
+                full = list(inputs)
+                for i, x in zip(float_idx, float_ins):
+                    full[i] = x
+                out = layer.apply(params, state, full, train=True, rng=rng)
+                return sum(jax.numpy.sum(t) for t in out.outputs)
+
+            jbwd = jax.jit(jax.grad(loss_like, argnums=(0, 1)))
+            try:
+                g = jbwd(params, [inputs[i] for i in float_idx])
+                t = Timer().start()
+                for _ in range(iterations):
+                    g = jbwd(params, [inputs[i] for i in float_idx])
+                bwd_ms = t.stop(g) / iterations
+            except Exception:
+                pass  # non-differentiable layer (Accuracy, ArgMax, ...)
+
+        for name, top in zip(layer.tops, tops):
+            blobs[name] = top
+        rows.append(
+            {
+                "layer": lname,
+                "type": layer.TYPE,
+                "forward_ms": round(fwd_ms, 3),
+                "backward_ms": None if np.isnan(bwd_ms) else round(bwd_ms, 3),
+            }
+        )
+    return rows

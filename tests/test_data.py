@@ -225,6 +225,12 @@ def test_partition_feed_too_small_raises():
         partition_feed(np.zeros((10, 3, 4, 4)), np.zeros(10), batch_size=4, tau=3)
 
 
+def test_prefetcher_reiteration_returns_immediately():
+    pf = DevicePrefetcher(lambda it: {"x": np.zeros(1)}, num_iters=3)
+    assert len(list(pf)) == 3
+    assert list(pf) == []  # exhausted stream: no deadlock, no items
+
+
 def test_prefetcher_propagates_errors():
     def data_fn(it):
         if it == 2:

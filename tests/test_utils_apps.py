@@ -1,0 +1,204 @@
+"""Utils (event log, signals, timing) + apps + CLI tests."""
+
+import json
+import os
+import signal
+
+import jax
+import numpy as np
+import pytest
+
+from sparknet_tpu import models
+from sparknet_tpu.common import Phase
+from sparknet_tpu.compiler.graph import Network
+from sparknet_tpu.data.cifar import write_synthetic_cifar
+from sparknet_tpu.utils import EventLogger, SignalHandler, SolverAction
+from sparknet_tpu.utils.timing import time_layers
+
+
+# ---------------------------------------------------------------- utils
+def test_event_logger_format(tmp_path):
+    log = EventLogger(str(tmp_path), prefix="t", echo=False)
+    log("hello")
+    log("step", i=7)
+    lines = open(log.path).read().splitlines()
+    assert lines[0].startswith("start ")
+    assert "hello" in lines[1]
+    assert lines[2].endswith("step, i = 7")
+
+
+def test_signal_handler_snapshot_then_stop():
+    with SignalHandler() as sig:
+        assert sig.check() is SolverAction.NONE
+        os.kill(os.getpid(), signal.SIGHUP)
+        assert sig.check() is SolverAction.SNAPSHOT
+        assert sig.check() is SolverAction.NONE  # one-shot
+        os.kill(os.getpid(), signal.SIGINT)
+        assert sig.check() is SolverAction.STOP
+    # uninstalled: default handlers restored
+    assert signal.getsignal(signal.SIGHUP) not in (None,)
+
+
+def test_time_layers_lenet():
+    net = Network(models.lenet(2), Phase.TRAIN)
+    variables = net.init(jax.random.PRNGKey(0))
+    feeds = {
+        "data": np.random.RandomState(0).randn(2, 1, 28, 28).astype(np.float32),
+        "label": np.zeros(2, np.int32),
+    }
+    rows = time_layers(net, variables, feeds, iterations=1)
+    names = [r["layer"] for r in rows]
+    assert "conv1" in names and "loss" in names
+    conv = next(r for r in rows if r["layer"] == "conv1")
+    assert conv["forward_ms"] > 0
+    assert conv["backward_ms"] is not None and conv["backward_ms"] > 0
+    acc = next(r for r in rows if r["layer"] == "accuracy")
+    assert acc["forward_ms"] > 0  # non-differentiable: forward only
+
+
+# ---------------------------------------------------------------- apps
+@pytest.fixture(scope="module")
+def cifar_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("cifar")
+    write_synthetic_cifar(str(d), seed=2)
+    return str(d)
+
+
+def test_cifar_app_runs(cifar_dir, tmp_path):
+    from sparknet_tpu.apps import CifarApp
+
+    app = CifarApp(cifar_dir, tau=2, batch=4, log_dir=str(tmp_path))
+    scores = app.run(num_outer=2, num_test_batches=2)
+    assert "accuracy" in scores and np.isfinite(scores["accuracy"])
+    # event log recorded phases
+    content = open(app.log.path).read()
+    assert "training" in content and "testing" in content
+    # snapshot path works
+    p = app.snapshot(str(tmp_path / "snap"))
+    assert os.path.exists(p)
+
+
+def test_featurizer(cifar_dir):
+    from sparknet_tpu.apps import FeaturizerApp
+    from sparknet_tpu.net import TPUNet
+
+    net = TPUNet(models.lenet_solver(), models.lenet(4))
+    app = FeaturizerApp(net, feature_blob="ip1")
+    feeds = [{
+        "data": np.zeros((4, 1, 28, 28), np.float32),
+        "label": np.zeros(4, np.int32),
+    }]
+    feats = list(app.featurize(feeds))
+    assert feats[0].shape == (4, 500)
+    with pytest.raises(KeyError):
+        list(FeaturizerApp(net, "nope").featurize(feeds))
+
+
+def test_imagenet_app_tau_feeds(tmp_path):
+    """ImageNetApp packs tau x workers minibatches with the crop applied."""
+    import io
+    import tarfile
+    from PIL import Image
+
+    rs = np.random.RandomState(0)
+    labels = {}
+    tar_path = tmp_path / "shard0.tar"
+    with tarfile.open(tar_path, "w") as tf:
+        for i in range(8):
+            name = f"img{i}.jpg"
+            buf = io.BytesIO()
+            Image.fromarray(rs.randint(0, 255, (64, 64, 3)).astype(np.uint8)).save(
+                buf, format="JPEG")
+            data = buf.getvalue()
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+            labels[name] = i % 3
+    (tmp_path / "train.txt").write_text(
+        "".join(f"{n} {l}\n" for n, l in labels.items()))
+
+    from sparknet_tpu.apps.imagenet_app import ImageNetApp
+
+    # tiny: alexnet at batch 2 never compiles here — only feed packing is
+    # exercised, so stub the trainer-heavy ctor pieces via small model
+    app = ImageNetApp.__new__(ImageNetApp)
+    app.loader = __import__("sparknet_tpu.data", fromlist=["ImageNetLoader"]).ImageNetLoader(
+        str(tmp_path), str(tmp_path / "train.txt"))
+    app.batch = 2
+    app.tau = 2
+    app.num_workers = 1
+    from sparknet_tpu.data import DataTransformer, TransformConfig
+    app.transform = DataTransformer(TransformConfig(crop_size=48, mirror=True, seed=0))
+    import sparknet_tpu.apps.imagenet_app as mod
+    mod.RESIZE, old_resize = 64, mod.RESIZE
+    mod.CROP, old_crop = 48, mod.CROP
+    try:
+        streams = [app.minibatch_stream(0)]
+        feeds = app._tau_feeds(streams)
+        assert feeds["data"].shape == (2, 2, 3, 48, 48)
+        assert feeds["label"].shape == (2, 2)
+    finally:
+        mod.RESIZE, mod.CROP = old_resize, old_crop
+
+
+def test_cifar_app_capacity_check(cifar_dir, tmp_path):
+    """tau x global batch beyond the train set raises the clear error, not a
+    numpy reshape failure."""
+    from sparknet_tpu.apps import CifarApp
+
+    app = CifarApp(cifar_dir, tau=2, batch=4, log_dir=str(tmp_path))
+    app.tau = 1000  # force need > n
+    with pytest.raises(ValueError, match="reduce tau"):
+        app._train_feeds(0)
+
+
+# ---------------------------------------------------------------- CLI
+def test_cli_device_query(capsys):
+    from sparknet_tpu.cli import main
+
+    assert main(["device_query"]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == len(jax.devices())
+    assert json.loads(out[0])["platform"] == "cpu"
+
+
+def test_cli_train_and_test_zoo_synthetic(tmp_path, monkeypatch, capsys):
+    from sparknet_tpu.cli import main
+
+    monkeypatch.chdir(tmp_path)
+    rc = main([
+        "train", "--solver", "zoo:lenet", "--batch", "8",
+        "--data", "synthetic", "--iterations", "3",
+        "--test-iters", "2", "--output", "final",
+    ])
+    assert rc == 0
+    assert os.path.exists("final.solverstate.npz")
+    rc = main([
+        "test", "--solver", "zoo:lenet", "--batch", "8",
+        "--data", "synthetic", "--iterations", "2",
+        "--snapshot", "final.solverstate.npz",
+    ])
+    assert rc == 0
+    scores = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "accuracy" in scores
+
+
+def test_cli_train_cifar_tau(cifar_dir, tmp_path, monkeypatch):
+    from sparknet_tpu.cli import main
+
+    monkeypatch.chdir(tmp_path)
+    rc = main([
+        "train", "--solver", "zoo:cifar10_quick", "--batch", "4",
+        "--data", f"cifar:{cifar_dir}", "--iterations", "4", "--tau", "2",
+    ])
+    assert rc == 0
+
+
+def test_cli_time_lenet(capsys):
+    from sparknet_tpu.cli import main
+
+    rc = main(["time", "--solver", "zoo:lenet", "--batch", "2",
+               "--data", "synthetic", "--iterations", "1"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "conv1" in out and "TOTAL" in out
