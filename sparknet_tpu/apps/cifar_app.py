@@ -80,7 +80,12 @@ class CifarApp:
         }
 
     def _test_feeds(self, b: int) -> dict[str, np.ndarray]:
-        lo = (b * self.global_batch) % max(len(self.test_labels) - self.global_batch, 1)
+        if self.global_batch > len(self.test_labels):
+            raise ValueError(
+                f"test set holds {len(self.test_labels)} samples; global "
+                f"batch {self.global_batch} — reduce batch/workers"
+            )
+        lo = (b * self.global_batch) % (len(self.test_labels) - self.global_batch + 1)
         sl = slice(lo, lo + self.global_batch)
         return {
             "data": self.transform(self.test_images[sl], train=False),

@@ -107,7 +107,16 @@ class ImageNetApp:
                 streams = [  # new epoch
                     self.minibatch_stream(w) for w in range(self.num_workers)
                 ]
-                feeds = self._tau_feeds(streams)
+                try:
+                    feeds = self._tau_feeds(streams)
+                except StopIteration:
+                    raise ValueError(
+                        f"dataset too small: tau={self.tau} x batch="
+                        f"{self.batch} x {self.num_workers} workers needs "
+                        f"{self.tau * self.batch * self.num_workers} decoded "
+                        "images per round (and every worker needs >=1 shard) "
+                        "— reduce tau/batch or add shards"
+                    ) from None
             self.log("training", i=outer)
             loss = self.trainer.train_round(lambda it: feeds)
             self.log(f"loss: {loss:.5f}", i=outer)

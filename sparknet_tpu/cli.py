@@ -110,13 +110,11 @@ def cmd_train(args) -> int:
     iters = args.iterations or solver_cfg.max_iter
     if args.tau > 1 or args.distributed:
         trainer = ParallelTrainer(solver, tau=args.tau)
-        outer = iters // max(args.tau, 1)
+        outer = -(-iters // max(args.tau, 1))  # ceil: run >= requested iters
+        tau_fn = _stack_tau(train_fn, args.tau, trainer.num_workers)
         with SignalHandler() as sig:
             for o in range(outer):
                 if args.tau > 1:
-                    tau_fn = _stack_tau(
-                        train_fn, args.tau, trainer.num_workers, trainer.iter
-                    )
                     loss = trainer.train_round(tau_fn)
                 else:
                     loss = trainer.train_round(
@@ -151,15 +149,20 @@ def cmd_train(args) -> int:
     return 0
 
 
-def _stack_tau(train_fn, tau, num_workers, base_it):
+def _stack_tau(train_fn, tau, num_workers):
     """[tau, B*workers, ...] feeds: the net batch is per-worker; each tau
-    slot concatenates one batch per worker (the global minibatch)."""
+    slot concatenates one batch per worker (the global minibatch).  Owns
+    its own batch counter: each round consumes tau*num_workers fresh
+    batches regardless of how the trainer advances its iteration count."""
+    counter = [0]
 
     def fn(it):
         slots = []
-        k = 0
         for _ in range(tau):
-            parts = [train_fn(base_it + (k := k + 1)) for _ in range(num_workers)]
+            parts = []
+            for _ in range(num_workers):
+                parts.append(train_fn(counter[0]))
+                counter[0] += 1
             slots.append({key: np.concatenate([p[key] for p in parts]) for key in parts[0]})
         return {key: np.stack([s[key] for s in slots]) for key in slots[0]}
 

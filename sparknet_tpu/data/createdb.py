@@ -1,0 +1,78 @@
+"""Dataset -> record-DB materialization and DB-backed minibatch reading.
+
+The reference's alternative "Caffe-native data source" path: executors
+write their partition into per-worker LMDB/LevelDBs through the C API
+(ref: src/main/scala/preprocessing/CreateDB.scala:10-52, commit every
+1000 records) and training reads them through Caffe's own DataLayer
+(ref: src/main/scala/apps/CifarDBApp.scala:96-131).  Here: the native
+RecordDB plays LMDB, and ``db_minibatches`` plays the DataLayer cursor.
+
+Record value layout (the Datum role, ref: caffe.proto:30-41 without the
+protobuf dependency): little-endian u32 c,h,w, i32 label, then c*h*w raw
+uint8 pixels.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterable, Iterator
+
+import numpy as np
+
+from sparknet_tpu.native import RecordDB
+
+_HDR = struct.Struct("<IIIi")
+COMMIT_EVERY = 1000  # ref: CreateDB.scala commit_db_txn cadence
+
+
+def encode_datum(image: np.ndarray, label: int) -> bytes:
+    c, h, w = image.shape
+    return _HDR.pack(c, h, w, int(label)) + np.ascontiguousarray(
+        image, np.uint8
+    ).tobytes()
+
+
+def decode_datum(value: bytes) -> tuple[np.ndarray, int]:
+    c, h, w, label = _HDR.unpack_from(value)
+    img = np.frombuffer(value, np.uint8, c * h * w, _HDR.size).reshape(c, h, w)
+    return img, label
+
+
+def create_db(
+    path: str,
+    samples: Iterable[tuple[np.ndarray, int]],
+    commit_every: int = COMMIT_EVERY,
+) -> int:
+    """Write (uint8 CHW image, label) samples; returns the record count."""
+    n = 0
+    with RecordDB(path, "w") as db:
+        for image, label in samples:
+            db.put(f"{n:08d}".encode(), encode_datum(image, label))
+            n += 1
+            if n % commit_every == 0:
+                db.commit()
+        db.commit()
+    return n
+
+
+def db_minibatches(
+    path: str, batch_size: int, loop: bool = False
+) -> Iterator[dict[str, np.ndarray]]:
+    """Fixed-size feed dicts from a record DB (ragged tail dropped, like
+    the packing stage); ``loop=True`` restarts the cursor each epoch (the
+    DataLayer's rewind)."""
+    with RecordDB(path, "r") as db:
+        while True:
+            imgs, labels = [], []
+            for _, value in db:
+                img, label = decode_datum(value)
+                imgs.append(img)
+                labels.append(label)
+                if len(imgs) == batch_size:
+                    yield {
+                        "data": np.stack(imgs).astype(np.float32),
+                        "label": np.asarray(labels, np.int32),
+                    }
+                    imgs, labels = [], []
+            if not loop:
+                return

@@ -31,6 +31,7 @@ class TransformConfig:
     mean_value: tuple[float, ...] = ()  # per-channel
     mean_image: np.ndarray | None = None  # full mean image (C,H,W)
     seed: int | None = None
+    backend: str = "numpy"  # "numpy" | "native" (multithreaded C++)
 
 
 class DataTransformer:
@@ -39,11 +40,42 @@ class DataTransformer:
         self._rs = np.random.RandomState(config.seed)
         if config.mean_image is not None and config.mean_value:
             raise ValueError("specify mean_image or mean_value, not both")
+        self._native_calls = 0
+        # 32-bit base; per-call seeds are spaced 2^32 apart so the C side's
+        # splitmix64(seed + sample_idx) streams never overlap across batches.
+        # seed=None stays nondeterministic (random base), matching numpy.
+        self._native_base = (
+            config.seed
+            if config.seed is not None
+            else int(np.random.SeedSequence().generate_state(1)[0])
+        ) & 0xFFFFFFFF
+        if config.backend == "native":
+            from sparknet_tpu import native  # noqa: F401 — fail fast
+
+            if not native.available():
+                raise RuntimeError(
+                    "native backend requested but libsparknet_native is "
+                    "unavailable (no toolchain?)"
+                )
 
     # ------------------------------------------------------------------
     def __call__(self, images: np.ndarray, train: bool) -> np.ndarray:
         """images: (N, C, H, W) uint8/float -> float32 transformed batch."""
         cfg = self.config
+        if cfg.backend == "native" and np.asarray(images).dtype == np.uint8:
+            from sparknet_tpu.native import transform_batch
+
+            self._native_calls += 1
+            return transform_batch(
+                images,
+                mean=cfg.mean_image,
+                mean_values=cfg.mean_value or None,
+                scale=cfg.scale,
+                crop=cfg.crop_size,
+                mirror=cfg.mirror,
+                train=train,
+                seed=(self._native_calls << 32) | self._native_base,
+            )
         x = images.astype(np.float32, copy=True)
         if cfg.mean_image is not None:
             x -= cfg.mean_image[None]

@@ -15,11 +15,19 @@ class EventLogger:
     def __init__(self, directory: str = ".", prefix: str = "training_log", echo: bool = True):
         os.makedirs(directory, exist_ok=True)
         ts = int(time.time())
-        self.path = os.path.join(directory, f"{prefix}_{ts}.txt")
         self._t0 = time.time()
         self._echo = echo
-        with open(self.path, "w") as f:
-            f.write(f"start {ts}\n")
+        # 'x' + nanosecond suffix on collision: two runs in the same second
+        # must not truncate each other's logs
+        for suffix in (str(ts), f"{ts}_{time.time_ns() % 1_000_000_000}"):
+            self.path = os.path.join(directory, f"{prefix}_{suffix}.txt")
+            try:
+                with open(self.path, "x") as f:
+                    f.write(f"start {ts}\n")
+                return
+            except FileExistsError:
+                continue
+        raise OSError(f"cannot create unique log file under {directory!r}")
 
     def log(self, message: str, i: int = -1) -> None:
         elapsed = time.time() - self._t0
