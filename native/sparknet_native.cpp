@@ -115,10 +115,18 @@ void* sndb_open(const char* path, int writable) {
 int sndb_put(void* handle, const void* key, int klen, const void* val, int vlen) {
   Db* db = static_cast<Db*>(handle);
   if (!db->writable || klen < 0 || vlen < 0) return -1;
+  // Remember the record start so a failed write can rewind — a torn
+  // partial record left in the stream would desync every later record
+  // when load_index parses sequentially.
+  long start = ftell(db->f);
   uint32_t k = static_cast<uint32_t>(klen), v = static_cast<uint32_t>(vlen);
-  if (fwrite(&k, 4, 1, db->f) != 1 || fwrite(&v, 4, 1, db->f) != 1) return -1;
-  if (k && fwrite(key, 1, k, db->f) != k) return -1;
-  if (v && fwrite(val, 1, v, db->f) != v) return -1;
+  bool ok = fwrite(&k, 4, 1, db->f) == 1 && fwrite(&v, 4, 1, db->f) == 1 &&
+            (k == 0 || fwrite(key, 1, k, db->f) == k) &&
+            (v == 0 || fwrite(val, 1, v, db->f) == v);
+  if (!ok) {
+    if (start >= 0) fseek(db->f, start, SEEK_SET);
+    return -1;
+  }
   db->pending++;
   return 0;
 }

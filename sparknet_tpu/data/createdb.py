@@ -62,6 +62,11 @@ def db_minibatches(
     the packing stage); ``loop=True`` restarts the cursor each epoch (the
     DataLayer's rewind)."""
     with RecordDB(path, "r") as db:
+        if len(db) < batch_size:
+            raise ValueError(
+                f"db holds {len(db)} records < batch_size {batch_size}; "
+                "loop=True would spin forever yielding nothing"
+            )
         while True:
             imgs, labels = [], []
             for _, value in db:

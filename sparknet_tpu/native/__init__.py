@@ -171,6 +171,10 @@ def transform_batch(
     lib = _load()
     x = np.ascontiguousarray(images, np.uint8)
     n, c, h, w = x.shape
+    if crop and (crop > h or crop > w):
+        # same contract as DataTransformer._crop — never hand the C side an
+        # out-of-bounds window
+        raise ValueError(f"crop {crop} larger than image {h}x{w}")
     if mean is not None:
         mdata = np.ascontiguousarray(mean, np.float32)
         if mdata.shape != (c, h, w):

@@ -162,8 +162,75 @@ class TPUNet:
     def set_weights(self, wc: WeightCollection) -> None:
         self.solver.variables = collection_to_variables(wc, self.solver.variables)
 
+    # -- zoo interchange (ref: Net::ToProto net.cpp:911 + Snapshot; shim
+    # save/load_weights_to/from_file ccaffe.cpp:261-269) -------------------
+    def save_caffemodel(self, path: str) -> None:
+        """Write params as a wire-compatible binary NetParameter."""
+        from sparknet_tpu.proto.binary import (
+            CaffeModel,
+            CaffeModelLayer,
+            save_caffemodel,
+        )
+
+        layers = []
+        type_by_name = {l.name: l.TYPE for l in self.train_net.layers}
+        for lname, plist in self.solver.variables.params.items():
+            layers.append(
+                CaffeModelLayer(
+                    lname,
+                    type_by_name.get(lname, ""),
+                    [np.asarray(p) for p in plist],
+                )
+            )
+        save_caffemodel(path, CaffeModel(self.train_net.net_param.get_str("name", ""), layers))
+
+    def load_caffemodel(self, path: str, strict_shapes: bool = True) -> list[str]:
+        """Copy params by layer name (CopyTrainedLayersFrom semantics,
+        ref: net.cpp:737-805): source layers absent from this net are
+        ignored; blob-shape mismatch raises.  Returns loaded layer names."""
+        from sparknet_tpu.proto.binary import load_caffemodel
+
+        model = load_caffemodel(path)
+        params = {k: list(v) for k, v in self.solver.variables.params.items()}
+        loaded = []
+        for layer in model.layers:
+            if layer.name not in params or not layer.blobs:
+                continue
+            target = params[layer.name]
+            if len(layer.blobs) != len(target):
+                raise ValueError(
+                    f"layer {layer.name!r}: snapshot has {len(layer.blobs)} "
+                    f"blobs, net expects {len(target)}"
+                )
+            new = []
+            ok = True
+            for src, dst in zip(layer.blobs, target):
+                if tuple(src.shape) != tuple(dst.shape):
+                    if np.prod(src.shape) == np.prod(dst.shape):
+                        # Caffe reshapes legacy 4D fc blobs (1,1,N,K)->(N,K)
+                        src = src.reshape(dst.shape)
+                    elif strict_shapes:
+                        raise ValueError(
+                            f"layer {layer.name!r}: blob shape {src.shape} "
+                            f"!= net {tuple(dst.shape)}"
+                        )
+                    else:  # PERMISSIVE: skip the incompatible layer
+                        ok = False
+                        break
+                new.append(jnp.asarray(src, dst.dtype))
+            if not ok:
+                continue
+            params[layer.name] = new
+            loaded.append(layer.name)
+        self.solver.variables = NetVars(
+            params=params, state=self.solver.variables.state
+        )
+        return loaded
+
     # -- persistence (ref: Net.scala:234-240) ------------------------------
     def save_weights_to_file(self, path: str) -> None:
+        if path.endswith(".caffemodel"):
+            return self.save_caffemodel(path)
         flat = {}
         for lname, arrs in self.get_weights().weights.items():
             for i, a in enumerate(arrs):
@@ -171,6 +238,9 @@ class TPUNet:
         np.savez(path if path.endswith(".npz") else path + ".npz", **flat)
 
     def load_weights_from_file(self, path: str) -> None:
+        if path.endswith(".caffemodel"):
+            self.load_caffemodel(path)
+            return
         if not path.endswith(".npz"):
             path = path + ".npz"
         data = np.load(path)

@@ -1,0 +1,227 @@
+"""``.caffemodel`` binary IO — a clean-room proto2 wire codec.
+
+The reference snapshots models as binary-protobuf ``NetParameter`` files
+(ref: caffe/src/caffe/net.cpp:911 Net::ToProto + solver.cpp:447-519
+Snapshot; libccaffe save_weights_to_file ccaffe.cpp:261-273).  Zoo
+interchange needs wire compatibility, not protobuf-the-library, so this
+module speaks the proto2 wire format directly for the blob-carrying subset
+of the schema (field numbers from caffe.proto: NetParameter.name=1,
+.layer=100, .layers=2 (V1); LayerParameter.name=1,.type=2,.blobs=7;
+V1LayerParameter.name=4,.type=5(enum),.blobs=6; BlobProto.shape=7,
+.data=5,.double_data=8,legacy num/channels/height/width=1-4;
+BlobShape.dim=1 packed).
+
+Load maps by layer name with Caffe's CopyTrainedLayersFrom semantics
+(ref: net.cpp:737-805: unknown target layers ignored, shape mismatch is
+an error).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+
+import numpy as np
+
+# wire types
+_VARINT, _I64, _LEN, _I32 = 0, 1, 2, 5
+
+
+# ---------------------------------------------------------------- reading
+def _read_varint(buf: bytes, pos: int) -> tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 70:
+            raise ValueError("varint overrun")
+
+
+def _scan(buf: bytes):
+    """Yield (field_number, wire_type, payload) over one message's bytes.
+    payload: int for varint/fixed, bytes for length-delimited."""
+    pos = 0
+    end = len(buf)
+    while pos < end:
+        tag, pos = _read_varint(buf, pos)
+        field, wt = tag >> 3, tag & 7
+        if wt == _VARINT:
+            val, pos = _read_varint(buf, pos)
+        elif wt == _I64:
+            val = struct.unpack_from("<q", buf, pos)[0]
+            pos += 8
+        elif wt == _LEN:
+            n, pos = _read_varint(buf, pos)
+            val = buf[pos : pos + n]
+            pos += n
+        elif wt == _I32:
+            val = struct.unpack_from("<i", buf, pos)[0]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wt} (field {field})")
+        yield field, wt, val
+
+
+def _packed_varints(payload: bytes) -> list[int]:
+    out, pos = [], 0
+    while pos < len(payload):
+        v, pos = _read_varint(payload, pos)
+        out.append(v)
+    return out
+
+
+def _decode_blob(buf: bytes) -> np.ndarray:
+    shape: list[int] = []
+    legacy = [0, 0, 0, 0]  # num, channels, height, width
+    # proto2 readers must accept a packed repeated field split over several
+    # chunks AND mixed packed/unpacked encodings — accumulate, never assign.
+    chunks: list[np.ndarray] = []
+    for field, wt, val in _scan(buf):
+        if field == 7 and wt == _LEN:  # BlobShape
+            for f2, w2, v2 in _scan(val):
+                if f2 == 1:
+                    if w2 == _LEN:
+                        shape.extend(_packed_varints(v2))
+                    else:
+                        shape.append(v2)
+        elif field == 5:  # float data
+            if wt == _LEN:
+                chunks.append(np.frombuffer(val, "<f4"))
+            else:  # unpacked element arrives as I32 bits
+                chunks.append(
+                    np.frombuffer(struct.pack("<i", val), "<f4")
+                )
+        elif field == 8 and wt == _LEN:  # double data
+            chunks.append(np.frombuffer(val, "<f8").astype(np.float32))
+        elif field in (1, 2, 3, 4) and wt == _VARINT:
+            legacy[field - 1] = val
+    data = (
+        np.concatenate(chunks) if chunks else np.zeros(0, np.float32)
+    ).astype(np.float32, copy=False)
+    if not shape and any(legacy):
+        shape = [d for d in legacy]
+    if shape:
+        data = data.reshape(shape)
+    return data
+
+
+_V1_TYPE_NAMES = {
+    # V1LayerParameter.LayerType enum values needed to name imported params
+    # (ref: caffe.proto:1051-1092); only param-carrying types matter here.
+    4: "Convolution", 14: "InnerProduct", 39: "Deconvolution",
+    13: "ImageData", 12: "HDF5Data", 5: "Data", 24: "WindowData",
+    18: "Pooling", 15: "LRN", 19: "ReLU", 6: "Dropout",
+    21: "SoftmaxWithLoss", 1: "Accuracy", 3: "Concat", 33: "Slice",
+    36: "Split", 8: "Flatten", 17: "MVN", 25: "Eltwise", 30: "ArgMax",
+    2: "BNLL", 26: "Power", 22: "Sigmoid", 23: "TanH", 35: "AbsVal",
+    7: "EuclideanLoss", 28: "HingeLoss", 29: "MemoryData",
+    9: "InfogainLoss", 10: "Im2col", 16: "MultinomialLogisticLoss",
+    20: "Softmax", 27: "SigmoidCrossEntropyLoss", 31: "Threshold",
+    32: "Window", 34: "TanH", 40: "ContrastiveLoss",
+}
+
+
+@dataclasses.dataclass
+class CaffeModelLayer:
+    name: str
+    type: str
+    blobs: list[np.ndarray]
+
+
+@dataclasses.dataclass
+class CaffeModel:
+    name: str
+    layers: list[CaffeModelLayer]
+
+    def by_name(self) -> dict[str, CaffeModelLayer]:
+        return {l.name: l for l in self.layers}
+
+
+def _decode_layer(buf: bytes, v1: bool) -> CaffeModelLayer:
+    name = ""
+    type_ = ""
+    blobs: list[np.ndarray] = []
+    name_field = 4 if v1 else 1
+    blob_field = 6 if v1 else 7
+    for field, wt, val in _scan(buf):
+        if field == name_field and wt == _LEN:
+            name = val.decode("utf-8", "replace")
+        elif not v1 and field == 2 and wt == _LEN:
+            type_ = val.decode("utf-8", "replace")
+        elif v1 and field == 5 and wt == _VARINT:
+            type_ = _V1_TYPE_NAMES.get(val, f"V1:{val}")
+        elif field == blob_field and wt == _LEN:
+            blobs.append(_decode_blob(val))
+    return CaffeModelLayer(name, type_, blobs)
+
+
+def loads_caffemodel(buf: bytes) -> CaffeModel:
+    name = ""
+    layers: list[CaffeModelLayer] = []
+    for field, wt, val in _scan(buf):
+        if field == 1 and wt == _LEN:
+            name = val.decode("utf-8", "replace")
+        elif field == 100 and wt == _LEN:
+            layers.append(_decode_layer(val, v1=False))
+        elif field == 2 and wt == _LEN:
+            layers.append(_decode_layer(val, v1=True))
+    return CaffeModel(name, layers)
+
+
+def load_caffemodel(path: str) -> CaffeModel:
+    with open(path, "rb") as f:
+        return loads_caffemodel(f.read())
+
+
+# ---------------------------------------------------------------- writing
+def _varint(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wt: int) -> bytes:
+    return _varint((field << 3) | wt)
+
+
+def _len_field(field: int, payload: bytes) -> bytes:
+    return _tag(field, _LEN) + _varint(len(payload)) + payload
+
+
+def _encode_blob(arr: np.ndarray) -> bytes:
+    arr = np.asarray(arr, np.float32)
+    dims = b"".join(_varint(int(d)) for d in arr.shape)
+    shape_msg = _len_field(1, dims)  # BlobShape.dim packed
+    out = _len_field(7, shape_msg)
+    out += _len_field(5, arr.astype("<f4").tobytes())  # packed float data
+    return out
+
+
+def _encode_layer(layer: CaffeModelLayer) -> bytes:
+    out = _len_field(1, layer.name.encode())
+    out += _len_field(2, layer.type.encode())
+    for b in layer.blobs:
+        out += _len_field(7, _encode_blob(b))
+    return out
+
+
+def dumps_caffemodel(model: CaffeModel) -> bytes:
+    out = _len_field(1, model.name.encode())
+    for layer in model.layers:
+        out += _len_field(100, _encode_layer(layer))
+    return out
+
+
+def save_caffemodel(path: str, model: CaffeModel) -> None:
+    with open(path, "wb") as f:
+        f.write(dumps_caffemodel(model))

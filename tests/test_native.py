@@ -161,3 +161,16 @@ def test_datatransformer_native_backend():
     ref = DataTransformer(TransformConfig(crop_size=8, mean_image=mean))(x, train=False)
     np.testing.assert_allclose(out, ref, atol=1e-4)
     assert t._native_calls == 1
+
+
+def test_augmenter_rejects_oversize_crop():
+    x = np.zeros((2, 3, 8, 8), np.uint8)
+    with pytest.raises(ValueError, match="crop"):
+        transform_batch(x, crop=16, train=True)
+
+
+def test_db_minibatches_too_small_loop_raises(tmp_path):
+    p = str(tmp_path / "tiny.sndb")
+    create_db(p, [(np.zeros((1, 2, 2), np.uint8), 0)])
+    with pytest.raises(ValueError, match="spin forever"):
+        next(db_minibatches(p, 8, loop=True))
