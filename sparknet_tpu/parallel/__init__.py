@@ -20,6 +20,7 @@ microseconds of ICI all-reduce.
 
 from sparknet_tpu.parallel.mesh import (  # noqa: F401
     auto_mesh,
+    shard_map,
     data_parallel_mesh,
     initialize_distributed,
     local_device_count,

@@ -1,0 +1,125 @@
+"""Ring attention: sequence/context parallelism over the device mesh.
+
+The reference has no sequence dimension at all (CNNs only — SURVEY §5
+"long-context: absent"; RNNs were future work, ref: ROADMAP.md:12).  A
+TPU-native framework must treat long-context as first-class, so this
+module provides the canonical ICI-friendly primitive: **blockwise ring
+attention** (Liu et al., "Ring Attention with Blockwise Transformers",
+2023 — see PAPERS.md).
+
+Design: Q/K/V are sharded over a ``seq`` mesh axis; each device computes
+attention of its query block against every K/V block while K/V shards
+rotate around the ring via ``lax.ppermute`` (one neighbor hop per step —
+pure ICI traffic, no all-gather memory blowup).  Softmax is accumulated
+online (flash-attention style running max/denominator), so the full
+[S, S] score matrix never materializes: memory is O(S_local^2) per step
+and sequence length scales linearly with the ring size.
+
+``ring_attention`` is the inside-shard_map collective; ``ring_self_attention``
+wraps it over a mesh for [B, H, S, D] arrays sharded on S.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sparknet_tpu.parallel.mesh import shard_map as _shard_map
+
+_NEG = -1e30  # additive mask value; avoids -inf NaN propagation in exp
+
+
+def _block_attend(q, k, v, o, m, l, mask):
+    """One online-softmax accumulation step.
+
+    q [B,H,Sq,D]; k,v [B,H,Sk,D]; o running output; m running max
+    [B,H,Sq]; l running denominator [B,H,Sq]; mask [Sq,Sk] additive."""
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], q.dtype))
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale + mask
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    p = jnp.exp(scores - m_new[..., None])
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + p.sum(axis=-1)
+    o_new = o * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return o_new, m_new, l_new
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False):
+    """Attention over a ring of sequence shards — call inside shard_map.
+
+    q, k, v: [B, H, S_local, D] (this device's sequence block).
+    Rotates K/V shards ``ring_size`` times via ppermute; each step
+    accumulates the local Q block against the visiting K/V block with the
+    correct *global* causal mask derived from block origins.
+    """
+    n = jax.lax.psum(1, axis_name)  # ring size (static under shard_map)
+    idx = jax.lax.axis_index(axis_name)
+    S = q.shape[2]
+    q_pos = idx * S + jnp.arange(S)  # global positions of local queries
+
+    o = jnp.zeros_like(q)
+    # derive from q so the carries are device-varying from step 0 (the new
+    # shard_map vma tracking rejects invariant->varying carry promotion)
+    m = jnp.full_like(q[..., 0], _NEG)
+    l = jnp.zeros_like(q[..., 0])
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def mask_for(src):
+        if not causal:
+            return jnp.zeros((S, S), q.dtype)
+        k_pos = src * S + jnp.arange(S)
+        return jnp.where(q_pos[:, None] >= k_pos[None, :], 0.0, _NEG)
+
+    # local block first (src == idx), then n-1 rotate+attend steps — no
+    # trailing dead ppermute pair
+    o, m, l = _block_attend(q, k, v, o, m, l, mask_for(idx))
+
+    def step(carry, s):
+        o, m, l, k_cur, v_cur = carry
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        # after s hops the shard resident here originated at (idx - s) % n
+        o, m, l = _block_attend(q, k_cur, v_cur, o, m, l, mask_for((idx - s) % n))
+        return (o, m, l, k_cur, v_cur), None
+
+    (o, m, l, _, _), _ = jax.lax.scan(step, (o, m, l, k, v), jnp.arange(1, n))
+    return o / l[..., None]
+
+
+def reference_attention(q, k, v, causal: bool = False):
+    """Unsharded full-sequence attention (the correctness oracle)."""
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], q.dtype))
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        S = q.shape[2]
+        mask = jnp.where(
+            jnp.arange(S)[:, None] >= jnp.arange(S)[None, :], 0.0, _NEG
+        )
+        scores = scores + mask
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, axis=-1), v)
+
+
+def ring_self_attention(
+    mesh: Mesh,
+    q,
+    k,
+    v,
+    seq_axis: str = "seq",
+    causal: bool = False,
+):
+    """shard_map wrapper: [B, H, S, D] arrays sharded on S over
+    ``seq_axis``; returns output with the same sharding.  The jitted
+    computation is pure ICI ppermute traffic + local MXU matmuls."""
+    spec = P(None, None, seq_axis, None)
+    fn = _shard_map(
+        partial(ring_attention, axis_name=seq_axis, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    sharding = NamedSharding(mesh, spec)
+    q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
+    return fn(q, k, v)

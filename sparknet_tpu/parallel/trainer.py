@@ -32,17 +32,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-try:  # jax >= 0.4.35 exposes shard_map at top level
-    from jax import shard_map as _shard_map_mod  # type: ignore
-
-    shard_map = _shard_map_mod
-except ImportError:
-    from jax.experimental.shard_map import shard_map  # type: ignore
-
 from sparknet_tpu.common import get_config
 from sparknet_tpu.compiler.graph import NetVars
 from sparknet_tpu.net import WeightCollection, collection_to_variables, variables_to_collection
-from sparknet_tpu.parallel.mesh import data_parallel_mesh
+from sparknet_tpu.parallel.mesh import data_parallel_mesh, shard_map
 from sparknet_tpu.parallel.sharding import (
     ShardingRules,
     batch_sharding,

@@ -1,0 +1,100 @@
+"""Ring attention correctness on the virtual 8-device mesh.
+
+The sharded collective must match unsharded full-sequence attention to
+float tolerance, for causal and bidirectional masks, under jit and grad,
+and on a combined (data, seq) 2-D mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sparknet_tpu.parallel.ring_attention import (
+    reference_attention,
+    ring_attention,
+    ring_self_attention,
+)
+
+from sparknet_tpu.parallel import shard_map
+
+
+def _qkv(B=2, H=2, S=32, D=8, seed=0):
+    rs = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rs.randn(B, H, S, D), jnp.float32)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_reference(causal):
+    q, k, v = _qkv()
+    mesh = Mesh(np.array(jax.devices()[:8]), ("seq",))
+    out = ring_self_attention(mesh, q, k, v, causal=causal)
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_under_jit_and_2d_mesh():
+    """(data=2, seq=4) mesh: batch sharded over data, sequence over seq."""
+    q, k, v = _qkv(B=4, S=16)
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("data", "seq"))
+    spec = P("data", None, "seq", None)
+
+    fn = jax.jit(
+        shard_map(
+            lambda q, k, v: ring_attention(q, k, v, "seq", causal=True),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+        )
+    )
+    sharding = NamedSharding(mesh, spec)
+    args = [jax.device_put(x, sharding) for x in (q, k, v)]
+    out = fn(*args)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_gradients_match_reference():
+    """d(loss)/d(q,k,v) through the ring equals the unsharded gradient —
+    the primitive is trainable, not inference-only."""
+    q, k, v = _qkv(S=16)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+    spec = P(None, None, "seq", None)
+    sharding = NamedSharding(mesh, spec)
+
+    ring_fn = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, "seq", causal=True),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+
+    def ring_loss(q, k, v):
+        return jnp.sum(ring_fn(q, k, v) ** 2)
+
+    def ref_loss(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
+
+    args = [jax.device_put(x, sharding) for x in (q, k, v)]
+    g_ring = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(*args)
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+
+def test_ring_long_sequence_memory_shape():
+    """Each device only ever holds S_local-size score blocks: a sequence 8x
+    the per-device block runs and matches (the linear-scaling property)."""
+    q, k, v = _qkv(B=1, H=1, S=256, D=4, seed=3)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("seq",))
+    out = ring_self_attention(mesh, q, k, v, causal=True)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_reference_attention_softmax_rows_sum_to_one():
+    q, k, v = _qkv(S=8)
+    out = reference_attention(q, k, jnp.ones_like(v), causal=False)
+    np.testing.assert_allclose(np.asarray(out), 1.0, atol=1e-5)
