@@ -1,0 +1,132 @@
+"""Hand-written pallas TPU kernels for ops XLA lowers poorly.
+
+The reference hand-writes CUDA for every layer (ref:
+caffe/src/caffe/layers/*.cu, ~3,500 LoC); on TPU, XLA:TPU covers nearly
+all of it — pallas is reserved for the few ops whose natural lowering
+fights the tiler.  Cross-channel LRN is the canonical case (ref:
+caffe/src/caffe/layers/lrn_layer.cu): a size-5 sliding window over the
+channel axis of NCHW lowers to a reduce_window whose window sits on a
+non-minor axis; the kernel below instead reshapes to put space on the
+128-lane minor axis, keeps the whole channel fiber resident in VMEM, and
+computes the window sum as ``size`` static shifted adds on the VPU with
+the x^2 buffer computed once.
+
+``lrn_across_channels`` defaults to the XLA formulation everywhere; the
+pallas kernel is opt-in via ``SPARKNET_LRN_IMPL=pallas`` (or
+``force='pallas'``) until it has been validated on the target TPU
+generation.  Interpret mode is used by tests to pin equivalence.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas is part of jax, but guard exotic builds
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+# spatial tile on the minor (lane) axis; multiple of 128
+_TILE = 512
+
+
+def _lrn_kernel(size: int, alpha: float, beta: float, k: float, x_ref, o_ref):
+    """One (batch, spatial-tile) block: refs are [1, C, T]."""
+    x = x_ref[0]
+    sq = x * x
+    C = x.shape[0]
+    pad = (size - 1) // 2
+    acc = sq
+    # static shifted adds over the channel axis (size is tiny: 3/5)
+    for off in range(1, pad + 1):
+        zeros = jnp.zeros((off, x.shape[1]), x.dtype)
+        acc = acc + jnp.concatenate([sq[off:], zeros], axis=0)  # c+off
+        acc = acc + jnp.concatenate([zeros, sq[: C - off]], axis=0)  # c-off
+    scale = k + (alpha / size) * acc
+    o_ref[0] = x * jnp.power(scale, -beta)
+
+
+def _lrn_pallas(x: jax.Array, size: int, alpha: float, beta: float, k: float,
+                interpret: bool = False) -> jax.Array:
+    """x: NCHW float32/bf16.  Grid over (batch, spatial tiles); each block
+    holds the full channel fiber so the window never crosses blocks."""
+    B, C, H, W = x.shape
+    S = H * W
+    pad_s = (-S) % _TILE
+    xr = x.reshape(B, C, S)
+    if pad_s:
+        xr = jnp.pad(xr, ((0, 0), (0, 0), (0, pad_s)))
+    Sp = S + pad_s
+    kernel = functools.partial(_lrn_kernel, size, alpha, beta, k)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, C, Sp), x.dtype),
+        grid=(B, Sp // _TILE),
+        in_specs=[
+            pl.BlockSpec((1, C, _TILE), lambda b, s: (b, 0, s)),
+        ],
+        out_specs=pl.BlockSpec((1, C, _TILE), lambda b, s: (b, 0, s)),
+        interpret=interpret,
+    )(xr)
+    return out[:, :, :S].reshape(B, C, H, W)
+
+
+def lrn_across_channels_xla(x, size, alpha, beta, k):
+    """reduce_window fallback (identical math, ref: lrn_layer.cpp)."""
+    sq = x * x
+    pad = (size - 1) // 2
+    summed = jax.lax.reduce_window(
+        sq, 0.0, jax.lax.add,
+        window_dimensions=(1, size, 1, 1),
+        window_strides=(1, 1, 1, 1),
+        padding=((0, 0), (pad, size - 1 - pad), (0, 0), (0, 0)),
+    )
+    return x * jnp.power(k + (alpha / size) * summed, -beta)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
+def _lrn_diff(x, size, alpha, beta, k, interpret):
+    """Differentiable wrapper: pallas forward, XLA-derived backward (the
+    backward recomputes through the reduce_window formulation — same math,
+    and the VJP stays out of the hand-written kernel)."""
+    return _lrn_pallas(x, size, alpha, beta, k, interpret=interpret)
+
+
+def _lrn_diff_fwd(x, size, alpha, beta, k, interpret):
+    return _lrn_pallas(x, size, alpha, beta, k, interpret=interpret), x
+
+
+def _lrn_diff_bwd(size, alpha, beta, k, interpret, x, g):
+    _, vjp = jax.vjp(lambda t: lrn_across_channels_xla(t, size, alpha, beta, k), x)
+    return vjp(g)
+
+
+_lrn_diff.defvjp(_lrn_diff_fwd, _lrn_diff_bwd)
+
+
+def lrn_across_channels(x, size, alpha, beta, k, force: str | None = None):
+    """Cross-channel LRN; ``force`` = 'pallas' | 'interpret' | 'xla' | None.
+
+    None consults ``SPARKNET_LRN_IMPL`` (pallas|xla); the default is the
+    XLA formulation — flip the env var (or pass force='pallas') on TPU
+    after validating the kernel on the target generation.  Differentiable
+    on every path."""
+    import os
+
+    if size % 2 == 0:
+        raise ValueError(f"LRN local_size must be odd, got {size}")
+    if force is None:
+        force = os.environ.get("SPARKNET_LRN_IMPL", "xla")
+    if force == "xla" or not _HAS_PALLAS:
+        return lrn_across_channels_xla(x, size, alpha, beta, k)
+    if force == "interpret":
+        return _lrn_diff(x, size, alpha, beta, k, True)
+    if force == "pallas" and x.ndim == 4:
+        return _lrn_diff(x, size, alpha, beta, k, False)
+    return lrn_across_channels_xla(x, size, alpha, beta, k)

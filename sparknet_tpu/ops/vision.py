@@ -244,6 +244,10 @@ class LRN(Layer):
     def apply(self, params, state, inputs, *, train, rng=None):
         p = self.lp.get_msg("lrn_param")
         size = p.get_int("local_size", 5)
+        if size % 2 == 0:
+            # Caffe CHECKs local_size is odd (lrn_layer.cpp LayerSetUp);
+            # an even window has no symmetric center
+            raise ValueError(f"{self.name}: LRN local_size must be odd, got {size}")
         alpha = p.get_float("alpha", 1.0)
         beta = p.get_float("beta", 0.75)
         k = p.get_float("k", 1.0)
@@ -254,19 +258,12 @@ class LRN(Layer):
             pooled = caffe_avg_pool(x * x, (size, size), (1, 1), (pre_pad, pre_pad))
             y = x * jnp.power(1.0 + alpha * pooled, -beta)
             return LayerOutput([y])
-        # ACROSS_CHANNELS: sliding sum over the channel axis.
-        sq = x * x
-        pad = (size - 1) // 2
-        summed = jax.lax.reduce_window(
-            sq,
-            0.0,
-            jax.lax.add,
-            window_dimensions=(1, size, 1, 1),
-            window_strides=(1, 1, 1, 1),
-            padding=((0, 0), (pad, size - 1 - pad), (0, 0), (0, 0)),
-        )
-        scale = k + (alpha / size) * summed
-        return LayerOutput([x * jnp.power(scale, -beta)])
+        # ACROSS_CHANNELS: sliding sum over the channel axis — XLA
+        # reduce_window by default; SPARKNET_LRN_IMPL=pallas opts into the
+        # hand-written kernel (ops/pallas_kernels.py).
+        from sparknet_tpu.ops.pallas_kernels import lrn_across_channels
+
+        return LayerOutput([lrn_across_channels(x, size, alpha, beta, k)])
 
 
 @register

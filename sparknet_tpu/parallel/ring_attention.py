@@ -82,7 +82,21 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
         k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
         v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
         # after s hops the shard resident here originated at (idx - s) % n
-        o, m, l = _block_attend(q, k_cur, v_cur, o, m, l, mask_for((idx - s) % n))
+        src = (idx - s) % n
+        if causal:
+            # src > idx => every key position follows every query position:
+            # the block is fully masked, so skip both einsums via cond.
+            # (Load is imbalanced — device i attends i+1 blocks; a zigzag
+            # block schedule would balance it, at the cost of a gather —
+            # acceptable here since the ppermute still paces every step.)
+            o, m, l = jax.lax.cond(
+                src <= idx,
+                lambda args: _block_attend(q, k_cur, v_cur, *args, mask_for(src)),
+                lambda args: args,
+                (o, m, l),
+            )
+        else:
+            o, m, l = _block_attend(q, k_cur, v_cur, o, m, l, mask_for(src))
         return (o, m, l, k_cur, v_cur), None
 
     (o, m, l, _, _), _ = jax.lax.scan(step, (o, m, l, k, v), jnp.arange(1, n))

@@ -1,0 +1,70 @@
+"""Pallas LRN kernel: interpret-mode equivalence with the XLA formulation
+(value and gradient), mirroring the reference's per-layer gradient-check
+discipline (ref: caffe/src/caffe/test/test_lrn_layer.cpp)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparknet_tpu.ops.pallas_kernels import (
+    lrn_across_channels,
+    lrn_across_channels_xla,
+)
+
+CASES = [
+    # (shape, size, alpha, beta, k)
+    ((2, 5, 4, 4), 5, 1e-4, 0.75, 1.0),     # AlexNet params, tiny shape
+    ((1, 96, 6, 6), 5, 1e-4, 0.75, 1.0),    # AlexNet conv1 channel count
+    ((2, 8, 3, 7), 3, 5e-5, 0.75, 2.0),     # odd spatial, k != 1
+]
+
+
+@pytest.mark.parametrize("shape,size,alpha,beta,k", CASES)
+def test_pallas_lrn_matches_xla(shape, size, alpha, beta, k):
+    x = jnp.asarray(np.random.RandomState(0).randn(*shape) * 10, jnp.float32)
+    ref = lrn_across_channels_xla(x, size, alpha, beta, k)
+    out = lrn_across_channels(x, size, alpha, beta, k, force="interpret")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_pallas_lrn_gradient_matches_xla():
+    x = jnp.asarray(np.random.RandomState(1).randn(1, 6, 4, 4) * 5, jnp.float32)
+
+    g_pallas = jax.grad(
+        lambda t: jnp.sum(lrn_across_channels(t, 5, 1e-4, 0.75, 1.0,
+                                              force="interpret") ** 2))(x)
+    g_xla = jax.grad(
+        lambda t: jnp.sum(lrn_across_channels_xla(t, 5, 1e-4, 0.75, 1.0) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(g_pallas), np.asarray(g_xla), atol=1e-4)
+
+
+def test_pallas_lrn_nonaligned_spatial_padding():
+    """Spatial size not a multiple of the tile exercises the pad/crop path."""
+    x = jnp.asarray(np.random.RandomState(2).randn(1, 4, 13, 11), jnp.float32)
+    ref = lrn_across_channels_xla(x, 3, 1e-4, 0.75, 1.0)
+    out = lrn_across_channels(x, 3, 1e-4, 0.75, 1.0, force="interpret")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_lrn_layer_uses_selector_and_stays_correct():
+    """The LRN layer's output is unchanged after the pallas wiring (CPU
+    backend routes to XLA)."""
+    from sparknet_tpu.common import Phase
+    from sparknet_tpu.ops.registry import create_layer
+    from sparknet_tpu.proto.text_format import Message
+
+    lp = Message().set("name", "n").set("type", "LRN")
+    lp.add("bottom", "x"); lp.add("top", "n")
+    lp.set("lrn_param", Message().set("local_size", 5).set("alpha", 1e-4).set("beta", 0.75))
+    layer = create_layer(lp, Phase.TRAIN)
+    x = jnp.asarray(np.random.RandomState(3).randn(2, 8, 5, 5), jnp.float32)
+    out = layer.apply([], {}, [x], train=True).outputs[0]
+    ref = lrn_across_channels_xla(x, 5, 1e-4, 0.75, 1.0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_lrn_even_size_rejected():
+    x = jnp.zeros((1, 4, 2, 2), jnp.float32)
+    with pytest.raises(ValueError, match="odd"):
+        lrn_across_channels(x, 4, 1e-4, 0.75, 1.0)

@@ -202,3 +202,22 @@ def test_cli_time_lenet(capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "conv1" in out and "TOTAL" in out
+
+
+def test_profiling_trace_writes_files(tmp_path):
+    from sparknet_tpu.utils import profiling
+
+    d = str(tmp_path / "prof")
+    with profiling.trace(d):
+        jnp_sum = jax.jit(lambda x: x * 2)(np.ones(16, np.float32))
+        jax.block_until_ready(jnp_sum)
+    # a plugins/profile/<ts>/ tree with at least one trace artifact
+    found = [f for root, _, fs in os.walk(d) for f in fs]
+    assert found, "profiler produced no artifacts"
+
+
+def test_device_memory_stats_shape():
+    from sparknet_tpu.utils.profiling import device_memory_stats
+
+    stats = device_memory_stats()
+    assert isinstance(stats, dict)  # CPU backends may expose nothing
