@@ -27,11 +27,20 @@ BASELINE_IMG_S = 267.0  # K40 + cuDNN CaffeNet training (performance_hardware.md
 
 
 def main() -> None:
+    import os
+
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
     batch = 256 if on_accel else 16
     iters = 20 if on_accel else 2
     warmup = 3 if on_accel else 1
+
+    # SPARKNET_BENCH_DTYPE=bf16 runs activations in bfloat16 (master params
+    # f32) — the TPU-native design point; default matches the baseline's f32.
+    if os.environ.get("SPARKNET_BENCH_DTYPE", "f32") in ("bf16", "bfloat16"):
+        from sparknet_tpu.common import set_config
+
+        set_config(compute_dtype=jnp.bfloat16)
 
     solver = Solver(models.alexnet_solver(), models.alexnet(batch))
     step = jax.jit(solver._make_train_step(), donate_argnums=(0, 1))

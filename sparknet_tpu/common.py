@@ -55,7 +55,12 @@ def get_config() -> Config:
 
 
 def set_config(**overrides) -> Config:
-    """Replace fields of the global config; returns the new config."""
+    """Replace fields of the global config; returns the new config.
+
+    The config is read at TRACE time: jitted programs (Solver steps,
+    trainers) bake in the values seen on their first call and do NOT
+    retrace on later ``set_config`` — set ``compute_dtype`` etc. before
+    constructing/stepping a Solver, not between steps."""
     global _config
     with _lock:
         _config = dataclasses.replace(_config, **overrides)

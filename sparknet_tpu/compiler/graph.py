@@ -26,7 +26,7 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 
-from sparknet_tpu.common import Phase, layer_key
+from sparknet_tpu.common import Phase, get_config, layer_key
 from sparknet_tpu.ops import create_layer
 from sparknet_tpu.ops.base import Layer, ParamSpec
 from sparknet_tpu.ops.data_layers import InputLayer
@@ -240,11 +240,26 @@ class Network:
         ref: Net::ForwardFromTo (net.cpp:565-583) + loss accumulation
         (layer.hpp Forward loss() * loss_weight)."""
         train = (self.phase == Phase.TRAIN) if train is None else train
+        # Mixed precision (Config.compute_dtype, default f32): master params
+        # and optimizer state stay in param_dtype; activations and the conv/
+        # matmul FLOPs run in compute_dtype (bf16 keeps the MXU at full
+        # rate).  Loss layers always compute in f32; state updates
+        # (BatchNorm stats) are cast back to their stored dtype.
+        cdt = get_config().compute_dtype
+        mixed = cdt != jnp.float32
+
+        def _cast(x, dt):
+            return (
+                x.astype(dt)
+                if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+                else x
+            )
+
         blob: dict[str, jax.Array] = {}
         for name in self.feed_blobs:
             if name not in feeds:
                 raise ValueError(f"missing feed for input blob {name!r}")
-            blob[name] = feeds[name]
+            blob[name] = _cast(feeds[name], cdt) if mixed else feeds[name]
         new_state: State = {}
         total_loss = jnp.zeros((), jnp.float32)
         for idx, layer in enumerate(self.layers):
@@ -256,11 +271,23 @@ class Network:
                 continue
             p = variables.params.get(layer.name, [])
             s = variables.state.get(layer.name, {})
-            out = layer.apply(
-                p, s, [blob[b] for b in layer.bottoms], train=train, rng=sub
-            )
+            ins = [blob[b] for b in layer.bottoms]
+            if mixed:
+                if layer.IS_LOSS:
+                    ins = [_cast(x, jnp.float32) for x in ins]
+                else:
+                    p = [_cast(x, cdt) for x in p]
+            out = layer.apply(p, s, ins, train=train, rng=sub)
             if out.state:
-                new_state[layer.name] = out.state
+                if mixed and layer.name in variables.state:
+                    prev = variables.state[layer.name]
+                    out_state = {
+                        k: _cast(v, prev[k].dtype) if k in prev else v
+                        for k, v in out.state.items()
+                    }
+                else:
+                    out_state = out.state
+                new_state[layer.name] = out_state
             for top, o in zip(layer.tops, out.outputs):
                 blob[top] = o
             for w, o in zip(layer.loss_weights(), out.outputs):

@@ -1,0 +1,86 @@
+"""HDF5 data sources (ref: caffe/src/caffe/layers/hdf5_data_layer.cpp).
+
+Caffe's HDF5Data layer reads a *source* text file listing .h5 files, each
+holding equally-sized datasets (canonically ``data`` and ``label``), and
+cycles through them in order.  Here the same format feeds the host data
+plane: ``hdf5_minibatches`` yields feed dicts for the named datasets.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator
+
+import numpy as np
+
+
+def read_hdf5_file(path: str, keys: tuple[str, ...] = ()) -> dict[str, np.ndarray]:
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        names = list(keys) if keys else sorted(f.keys())
+        out = {k: np.asarray(f[k]) for k in names}
+    sizes = {v.shape[0] for v in out.values()}
+    if len(sizes) > 1:
+        raise ValueError(f"{path}: datasets disagree on leading dim: {sizes}")
+    return out
+
+
+def write_hdf5_file(path: str, arrays: dict[str, np.ndarray]) -> None:
+    import h5py
+
+    with h5py.File(path, "w") as f:
+        for k, v in arrays.items():
+            f.create_dataset(k, data=v)
+
+
+def hdf5_minibatches(
+    source: str,
+    batch_size: int,
+    keys: tuple[str, ...] = ("data", "label"),
+    loop: bool = False,
+) -> Iterator[dict[str, np.ndarray]]:
+    """``source``: text file of .h5 paths (one per line, relative paths
+    resolved against the source file's directory — Caffe's convention).
+    Yields fixed-size feed dicts; ragged file tails are carried into the
+    next file, final tail dropped."""
+    root = os.path.dirname(os.path.abspath(source))
+    with open(source) as f:
+        files = [l.strip() for l in f if l.strip()]
+    if not files:
+        raise ValueError(f"{source}: no .h5 files listed")
+    files = [p if os.path.isabs(p) else os.path.join(root, p) for p in files]
+
+    while True:
+        # cursor-based assembly: each sample is copied once into its batch
+        # (linear, vs re-concatenating the whole remainder per yield)
+        pending: dict[str, list[np.ndarray]] = {k: [] for k in keys}
+        have = 0
+        yielded = False
+        for path in files:
+            data = read_hdf5_file(path, keys)
+            n = next(iter(data.values())).shape[0]
+            pos = 0
+            while pos < n:
+                take = min(batch_size - have, n - pos)
+                for k in keys:
+                    pending[k].append(data[k][pos : pos + take])
+                have += take
+                pos += take
+                if have == batch_size:
+                    yield {
+                        k: (v[0] if len(v) == 1 else np.concatenate(v))
+                        for k, v in pending.items()
+                    }
+                    pending = {k: [] for k in keys}
+                    have = 0
+                    yielded = True
+        if not loop:
+            return
+        if not yielded:
+            raise ValueError(
+                f"{source}: fewer than batch_size={batch_size} samples in "
+                "total; loop=True would spin forever yielding nothing"
+            )
+        pending = {k: [] for k in keys}  # ragged epoch tail dropped
+        have = 0

@@ -227,10 +227,53 @@ class TPUNet:
         )
         return loaded
 
+    # -- HDF5 snapshots (ref: Net::ToHDF5/CopyTrainedLayersFromHDF5,
+    # caffe/src/caffe/net.cpp:926 + util/hdf5.cpp) -------------------------
+    def save_hdf5(self, path: str) -> None:
+        """Layout mirrors Caffe's: group ``data/<layer>/<i>`` per blob."""
+        import h5py
+
+        with h5py.File(path, "w") as f:
+            data = f.create_group("data")
+            for lname, plist in self.solver.variables.params.items():
+                g = data.create_group(lname)
+                for i, p in enumerate(plist):
+                    g.create_dataset(str(i), data=np.asarray(p))
+
+    def load_hdf5(self, path: str) -> list[str]:
+        """Copy-by-layer-name with the same semantics as load_caffemodel."""
+        import h5py
+
+        params = {k: list(v) for k, v in self.solver.variables.params.items()}
+        loaded = []
+        with h5py.File(path, "r") as f:
+            for lname in f["data"]:
+                if lname not in params:
+                    continue
+                g = f["data"][lname]
+                target = params[lname]
+                arrs = [np.asarray(g[str(i)]) for i in range(len(g))]
+                if len(arrs) != len(target):
+                    raise ValueError(
+                        f"layer {lname!r}: snapshot has {len(arrs)} blobs, "
+                        f"net expects {len(target)}"
+                    )
+                params[lname] = [
+                    jnp.asarray(a.reshape(p.shape), p.dtype)
+                    for a, p in zip(arrs, target)
+                ]
+                loaded.append(lname)
+        self.solver.variables = NetVars(
+            params=params, state=self.solver.variables.state
+        )
+        return loaded
+
     # -- persistence (ref: Net.scala:234-240) ------------------------------
     def save_weights_to_file(self, path: str) -> None:
         if path.endswith(".caffemodel"):
             return self.save_caffemodel(path)
+        if path.endswith((".h5", ".hdf5", ".caffemodel.h5")):
+            return self.save_hdf5(path)
         flat = {}
         for lname, arrs in self.get_weights().weights.items():
             for i, a in enumerate(arrs):
@@ -240,6 +283,9 @@ class TPUNet:
     def load_weights_from_file(self, path: str) -> None:
         if path.endswith(".caffemodel"):
             self.load_caffemodel(path)
+            return
+        if path.endswith((".h5", ".hdf5", ".caffemodel.h5")):
+            self.load_hdf5(path)
             return
         if not path.endswith(".npz"):
             path = path + ".npz"

@@ -64,6 +64,10 @@ class SolverConfig:
     clip_gradients: float = -1.0
     iter_size: int = 1
     solver_type: str = "SGD"
+    # TPU-native memory knob: rematerialize the forward under grad
+    # (jax.checkpoint) — trades FLOPs for HBM on activation-heavy nets.
+    # No reference counterpart; Caffe holds all activations resident.
+    remat: bool = False
     random_seed: int = -1
     test_iter: tuple = ()
     test_interval: int = 0
@@ -171,6 +175,9 @@ class Solver:
                 NetVars(params=params, state=state), feeds, rng=rng
             )
             return loss, new_state
+
+        if cfg.remat:
+            loss_fn = jax.checkpoint(loss_fn)
 
         def train_step(variables, slots, it, feeds, key):
             rng = step_key(key, it)

@@ -1,0 +1,47 @@
+"""Per-process persistent object store.
+
+Parity surface for ``WorkerStore`` (ref:
+src/main/scala/libs/WorkerStore.scala:5-25) — the JVM-singleton mutable
+map each Spark executor used to keep its CaffeNet and CaffeLibrary alive
+across driver-side loop iterations.  On TPU the need is smaller (the
+trainer owns device state), but multi-host drivers still want a place to
+pin per-process objects (compiled nets, data streams, native handles)
+across outer-loop closures, keyed the same way.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+
+class WorkerStore:
+    def __init__(self):
+        self._store: dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def set(self, key: str, value: Any) -> None:
+        with self._lock:
+            self._store[key] = value
+
+    def get(self, key: str) -> Any:
+        """KeyError with the reference's contract: get of a missing key is
+        a programming error, not a None."""
+        with self._lock:
+            return self._store[key]
+
+    def contains(self, key: str) -> bool:
+        with self._lock:
+            return key in self._store
+
+    def remove(self, key: str) -> None:
+        with self._lock:
+            self._store.pop(key, None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._store.clear()
+
+
+# the per-process singleton, like the Scala `object workerStore`
+worker_store = WorkerStore()

@@ -167,3 +167,44 @@ def test_jit_apply_and_grad():
     g = jax.grad(loss_fn)(variables.params, variables.state, feeds)
     assert g["conv"][0].shape == (4, 3, 3, 3)
     assert float(jnp.sum(jnp.abs(g["conv"][0]))) > 0
+
+
+def test_mixed_precision_bf16_compute():
+    """compute_dtype=bfloat16: activations run bf16, loss stays f32, params
+    untouched (master f32), grads f32, and training still learns."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sparknet_tpu import models
+    from sparknet_tpu.common import Phase, set_config
+    from sparknet_tpu.compiler.graph import Network
+    from sparknet_tpu.solvers.solver import Solver
+
+    try:
+        set_config(compute_dtype=jnp.bfloat16)
+        net = Network(models.lenet(4), Phase.TRAIN)
+        variables = net.init(jax.random.PRNGKey(0))
+        feeds = {
+            "data": np.random.RandomState(0).randn(4, 1, 28, 28).astype(np.float32),
+            "label": np.zeros(4, np.int32),
+        }
+        blobs, new_state, loss = net.apply(variables, feeds, rng=jax.random.PRNGKey(1))
+        assert blobs["conv1"].dtype == jnp.bfloat16
+        assert loss.dtype == jnp.float32 and bool(jnp.isfinite(loss))
+        # grads flow in f32 (master params f32)
+        def loss_fn(params):
+            from sparknet_tpu.compiler.graph import NetVars
+            _, _, l = net.apply(NetVars(params=params, state=variables.state),
+                                feeds, rng=jax.random.PRNGKey(1))
+            return l
+        g = jax.grad(loss_fn)(variables.params)
+        leaf = jax.tree_util.tree_leaves(g)[0]
+        assert leaf.dtype == jnp.float32
+        # a few solver steps still reduce the loss
+        solver = Solver(models.lenet_solver(), models.lenet(4))
+        l0 = solver.step(1, lambda it: feeds)
+        l5 = solver.step(5, lambda it: feeds)
+        assert l5 < l0 + 1e-3
+    finally:
+        set_config(compute_dtype=jnp.float32)

@@ -1,0 +1,106 @@
+"""HDF5 weight/data IO, WorkerStore, and remat tests."""
+
+import numpy as np
+import pytest
+
+from sparknet_tpu import models
+from sparknet_tpu.data.hdf5 import hdf5_minibatches, read_hdf5_file, write_hdf5_file
+from sparknet_tpu.net import TPUNet
+from sparknet_tpu.worker_store import WorkerStore, worker_store
+
+h5py = pytest.importorskip("h5py")
+
+
+# ---------------------------------------------------------------- hdf5 data
+def test_hdf5_minibatches_across_files(tmp_path):
+    rs = np.random.RandomState(0)
+    for i, n in enumerate([5, 7]):
+        write_hdf5_file(
+            str(tmp_path / f"part{i}.h5"),
+            {"data": rs.randn(n, 3, 4, 4).astype(np.float32),
+             "label": np.arange(n, dtype=np.int32) + i * 100},
+        )
+    src = tmp_path / "source.txt"
+    src.write_text("part0.h5\npart1.h5\n")  # relative paths resolve vs source
+    batches = list(hdf5_minibatches(str(src), 4))
+    # 12 samples -> 3 full batches, ragged tail dropped
+    assert len(batches) == 3
+    assert batches[0]["data"].shape == (4, 3, 4, 4)
+    # batch 2 spans the file boundary: labels 4 then 100
+    np.testing.assert_array_equal(batches[1]["label"], [4, 100, 101, 102])
+
+
+def test_hdf5_file_mismatched_dims_raises(tmp_path):
+    p = str(tmp_path / "bad.h5")
+    write_hdf5_file(p, {"data": np.zeros((4, 2)), "label": np.zeros(3)})
+    with pytest.raises(ValueError, match="leading dim"):
+        read_hdf5_file(p)
+
+
+def test_hdf5_minibatches_loop(tmp_path):
+    write_hdf5_file(str(tmp_path / "a.h5"),
+                    {"data": np.zeros((4, 2), np.float32),
+                     "label": np.arange(4, dtype=np.int32)})
+    (tmp_path / "src.txt").write_text("a.h5\n")
+    it = hdf5_minibatches(str(tmp_path / "src.txt"), 3, loop=True)
+    a = next(it)
+    b = next(it)  # second epoch restarts cleanly
+    np.testing.assert_array_equal(a["label"], [0, 1, 2])
+    np.testing.assert_array_equal(b["label"], [0, 1, 2])
+
+
+# ---------------------------------------------------------------- hdf5 weights
+def test_tpunet_hdf5_weights_roundtrip(tmp_path):
+    net = TPUNet(models.lenet_solver(), models.lenet(2))
+    p = str(tmp_path / "w.caffemodel.h5")
+    net.save_weights_to_file(p)
+    net2 = TPUNet(models.lenet_solver(), models.lenet(2))
+    net2.load_weights_from_file(p)
+    for lname, plist in net.solver.variables.params.items():
+        for a, b in zip(plist, net2.solver.variables.params[lname]):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------- worker store
+def test_worker_store_contract():
+    ws = WorkerStore()
+    ws.set("net", {"x": 1})
+    assert ws.contains("net")
+    assert ws.get("net")["x"] == 1
+    with pytest.raises(KeyError):
+        ws.get("missing")
+    ws.remove("net")
+    assert not ws.contains("net")
+    # module singleton exists and is the same object across imports
+    from sparknet_tpu.worker_store import worker_store as ws2
+
+    assert ws2 is worker_store
+
+
+# ---------------------------------------------------------------- remat
+def test_remat_solver_trains_identically():
+    """jax.checkpoint must not change the math — losses match exactly."""
+    import dataclasses
+
+    from sparknet_tpu.solvers.solver import Solver
+
+    rs = np.random.RandomState(0)
+    feeds = {
+        "data": rs.randn(4, 1, 28, 28).astype(np.float32),
+        "label": rs.randint(0, 10, 4).astype(np.int32),
+    }
+    base = models.lenet_solver()
+    s1 = Solver(base, models.lenet(4))
+    s2 = Solver(dataclasses.replace(base, remat=True), models.lenet(4))
+    l1 = s1.step(3, lambda it: feeds)
+    l2 = s2.step(3, lambda it: feeds)
+    assert np.allclose(l1, l2, atol=1e-6), (l1, l2)
+
+
+def test_hdf5_minibatches_too_small_loop_raises(tmp_path):
+    write_hdf5_file(str(tmp_path / "t.h5"),
+                    {"data": np.zeros((2, 2), np.float32),
+                     "label": np.zeros(2, np.int32)})
+    (tmp_path / "s.txt").write_text("t.h5\n")
+    with pytest.raises(ValueError, match="spin forever"):
+        next(hdf5_minibatches(str(tmp_path / "s.txt"), 3, loop=True))
