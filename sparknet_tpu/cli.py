@@ -221,6 +221,79 @@ def cmd_time(args) -> int:
     return 0
 
 
+def cmd_convert_imageset(args) -> int:
+    """Image list -> record DB (ref: caffe/tools/convert_imageset.cpp:
+    listfile of "<relpath> <label>" lines, optional resize, LMDB out)."""
+    from sparknet_tpu.data.createdb import create_db
+    from sparknet_tpu.data.minibatch import decode_jpeg
+
+    def samples():
+        import os
+
+        with open(args.listfile) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rel, label = line.rsplit(maxsplit=1)
+                with open(os.path.join(args.root, rel), "rb") as img:
+                    arr = decode_jpeg(img.read(), args.resize, args.resize)
+                if arr is None:
+                    continue  # same drop-broken-images semantics
+                yield arr, int(label)
+
+    n = create_db(args.db, samples())
+    print(json.dumps({"records": n, "db": args.db}))
+    return 0
+
+
+def cmd_compute_image_mean(args) -> int:
+    """Record DB -> mean image .npy (ref: caffe/tools/compute_image_mean.cpp)."""
+    from sparknet_tpu.data.createdb import db_minibatches
+    from sparknet_tpu.data.minibatch import compute_mean_from_minibatches
+
+    try:
+        first = next(db_minibatches(args.db, 1))
+    except StopIteration:
+        raise SystemExit(f"record db {args.db!r} is empty") from None
+    shape = first["data"].shape[1:]
+    mean = compute_mean_from_minibatches(
+        (
+            (b["data"], b["label"])
+            for b in db_minibatches(
+                args.db, args.batch or 64, drop_remainder=False
+            )
+        ),
+        shape,
+    )
+    np.save(args.out, mean)
+    print(json.dumps({"out": args.out, "shape": list(shape)}))
+    return 0
+
+
+def cmd_extract_features(args) -> int:
+    """Forward a dataset and dump an intermediate blob per batch to .npy
+    (ref: caffe/tools/extract_features.cpp + apps/FeaturizerApp.scala)."""
+    from sparknet_tpu.apps.featurizer import FeaturizerApp
+    from sparknet_tpu.net import TPUNet
+
+    net_param, solver_cfg = _build_net_and_solver(args)
+    net = TPUNet(solver_cfg, net_param)
+    if args.snapshot:
+        # --snapshot is a .solverstate.npz (what `train --output` writes);
+        # restore via the solver, like cmd_train/cmd_test
+        net.solver.restore(args.snapshot)
+    _, test_fn = _data_fns(args, net.test_net)
+    app = FeaturizerApp(net, feature_blob=args.blob)
+    feats = list(
+        app.featurize(test_fn(b) for b in range(args.iterations or 10))
+    )
+    out = np.concatenate(feats)
+    np.save(args.out, out)
+    print(json.dumps({"out": args.out, "shape": list(out.shape)}))
+    return 0
+
+
 def cmd_device_query(args) -> int:
     """ref: caffe.cpp:110-150 device_query()."""
     import jax
@@ -272,6 +345,25 @@ def main(argv=None) -> int:
     sp = sub.add_parser("time", help="per-layer timing")
     common(sp)
     sp.set_defaults(fn=cmd_time)
+
+    sp = sub.add_parser("convert_imageset", help="image list -> record DB")
+    sp.add_argument("--root", required=True, help="image directory")
+    sp.add_argument("--listfile", required=True, help='lines of "relpath label"')
+    sp.add_argument("--db", required=True, help="output record DB path")
+    sp.add_argument("--resize", type=int, default=256)
+    sp.set_defaults(fn=cmd_convert_imageset)
+
+    sp = sub.add_parser("compute_image_mean", help="record DB -> mean .npy")
+    sp.add_argument("--db", required=True)
+    sp.add_argument("--out", required=True)
+    sp.add_argument("--batch", type=int, default=0)
+    sp.set_defaults(fn=cmd_compute_image_mean)
+
+    sp = sub.add_parser("extract_features", help="dump an intermediate blob")
+    common(sp)
+    sp.add_argument("--blob", required=True, help="blob name, e.g. ip1")
+    sp.add_argument("--out", required=True, help="output .npy")
+    sp.set_defaults(fn=cmd_extract_features)
 
     sp = sub.add_parser("device_query", help="show devices")
     sp.set_defaults(fn=cmd_device_query)

@@ -56,13 +56,16 @@ def create_db(
 
 
 def db_minibatches(
-    path: str, batch_size: int, loop: bool = False
+    path: str, batch_size: int, loop: bool = False, drop_remainder: bool = True
 ) -> Iterator[dict[str, np.ndarray]]:
-    """Fixed-size feed dicts from a record DB (ragged tail dropped, like
-    the packing stage); ``loop=True`` restarts the cursor each epoch (the
-    DataLayer's rewind)."""
+    """Feed dicts from a record DB.  ``drop_remainder=True`` (the training
+    contract) yields only full batches; ``False`` yields the final short
+    batch too (stats passes — compute_image_mean must see every record).
+    ``loop=True`` restarts the cursor each epoch (the DataLayer's rewind)."""
     with RecordDB(path, "r") as db:
-        if len(db) < batch_size:
+        if loop and (
+            len(db) == 0 or (len(db) < batch_size and drop_remainder)
+        ):
             raise ValueError(
                 f"db holds {len(db)} records < batch_size {batch_size}; "
                 "loop=True would spin forever yielding nothing"
@@ -79,5 +82,10 @@ def db_minibatches(
                         "label": np.asarray(labels, np.int32),
                     }
                     imgs, labels = [], []
+            if imgs and not drop_remainder:
+                yield {
+                    "data": np.stack(imgs).astype(np.float32),
+                    "label": np.asarray(labels, np.int32),
+                }
             if not loop:
                 return

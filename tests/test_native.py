@@ -174,3 +174,13 @@ def test_db_minibatches_too_small_loop_raises(tmp_path):
     create_db(p, [(np.zeros((1, 2, 2), np.uint8), 0)])
     with pytest.raises(ValueError, match="spin forever"):
         next(db_minibatches(p, 8, loop=True))
+
+
+def test_db_minibatches_remainder_kept(tmp_path):
+    """drop_remainder=False yields the final short batch (stats passes see
+    every record — the compute_image_mean contract)."""
+    p = str(tmp_path / "r.sndb")
+    create_db(p, [(np.full((1, 2, 2), i, np.uint8), i) for i in range(5)])
+    batches = list(db_minibatches(p, 2, drop_remainder=False))
+    assert [len(b["label"]) for b in batches] == [2, 2, 1]
+    assert sum(len(b["label"]) for b in batches) == 5

@@ -197,3 +197,41 @@ def test_sync_to_solver_and_snapshot(tmp_path):
         np.asarray(tr._averaged_variables().params["ip2"][0]),
         atol=1e-6,
     )
+
+
+def test_tau_convergence_parity():
+    """The paper's claim: tau-step local SGD + periodic averaging converges
+    like fully-sync SGD on the same budget of local steps (SparkNet Fig. 4
+    regime, small tau).  tau=4 with 5 rounds == 20 local iterations; both
+    modes must solve the synthetic task."""
+    imgs, labels = synth(1024, seed=3)
+
+    def run(tau, rounds):
+        solver = Solver(
+            SolverConfig(base_lr=0.1, momentum=0.9, solver_type="SGD"),
+            small_net(batch=BATCH if tau == 1 else BATCH // 8),
+        )
+        trainer = ParallelTrainer(solver, tau=tau)
+        rs = np.random.RandomState(tau)
+        for _ in range(rounds):
+            idx = rs.randint(0, 1024, BATCH * max(tau, 1))
+            if tau == 1:
+                trainer.train_round(lambda it: feeds_of(imgs[idx], labels[idx]))
+            else:
+                shape = (tau, BATCH)
+                f = {
+                    "data": imgs[idx].reshape(shape + imgs.shape[1:]),
+                    "label": labels[idx].reshape(shape),
+                }
+                trainer.train_round(lambda it: f)
+        test_idx = np.arange(512)
+        scores = trainer.test(
+            4, lambda b: feeds_of(imgs[test_idx[b::4][:BATCH]],
+                                  labels[test_idx[b::4][:BATCH]])
+        )
+        return scores["accuracy"]
+
+    acc_sync = run(tau=1, rounds=20)   # 20 sync steps
+    acc_tau = run(tau=4, rounds=5)     # 5 rounds x 4 local steps
+    assert acc_sync > 0.9, acc_sync
+    assert acc_tau > 0.9, acc_tau

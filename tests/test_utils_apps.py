@@ -221,3 +221,44 @@ def test_device_memory_stats_shape():
 
     stats = device_memory_stats()
     assert isinstance(stats, dict)  # CPU backends may expose nothing
+
+
+def test_cli_dataset_tools_pipeline(tmp_path, monkeypatch, capsys):
+    """convert_imageset -> compute_image_mean -> extract_features chain."""
+    import io as _io
+    from PIL import Image
+
+    from sparknet_tpu.cli import main
+
+    native = pytest.importorskip("sparknet_tpu.native")
+    if not native.available():
+        pytest.skip("native record DB unavailable")
+
+    rs = np.random.RandomState(0)
+    imgdir = tmp_path / "imgs"
+    imgdir.mkdir()
+    lines = []
+    for i in range(6):
+        arr = rs.randint(0, 255, (20, 20, 3)).astype(np.uint8)
+        Image.fromarray(arr).save(imgdir / f"im{i}.jpg")
+        lines.append(f"im{i}.jpg {i % 3}")
+    listfile = tmp_path / "list.txt"
+    listfile.write_text("\n".join(lines) + "\n")
+
+    monkeypatch.chdir(tmp_path)
+    db = str(tmp_path / "set.sndb")
+    assert main(["convert_imageset", "--root", str(imgdir), "--listfile",
+                 str(listfile), "--db", db, "--resize", "16"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["records"] == 6
+
+    assert main(["compute_image_mean", "--db", db, "--out",
+                 str(tmp_path / "mean.npy"), "--batch", "2"]) == 0
+    mean = np.load(tmp_path / "mean.npy")
+    assert mean.shape == (3, 16, 16)
+
+    assert main(["extract_features", "--solver", "zoo:lenet", "--batch", "4",
+                 "--data", "synthetic", "--iterations", "2",
+                 "--blob", "ip1", "--out", str(tmp_path / "feats.npy")]) == 0
+    feats = np.load(tmp_path / "feats.npy")
+    assert feats.shape == (8, 500)
