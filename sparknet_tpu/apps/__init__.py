@@ -10,3 +10,8 @@ program per outer round.
 from sparknet_tpu.apps.cifar_app import CifarApp  # noqa: F401
 from sparknet_tpu.apps.imagenet_app import ImageNetApp  # noqa: F401
 from sparknet_tpu.apps.featurizer import FeaturizerApp  # noqa: F401
+from sparknet_tpu.apps.db_apps import (  # noqa: F401
+    CifarDBApp,
+    ImageNetCreateDBApp,
+    ImageNetRunDBApp,
+)

@@ -236,10 +236,13 @@ def cmd_convert_imageset(args) -> int:
                 if not line:
                     continue
                 rel, label = line.rsplit(maxsplit=1)
-                with open(os.path.join(args.root, rel), "rb") as img:
-                    arr = decode_jpeg(img.read(), args.resize, args.resize)
+                try:
+                    with open(os.path.join(args.root, rel), "rb") as img:
+                        arr = decode_jpeg(img.read(), args.resize, args.resize)
+                except OSError:
+                    arr = None  # missing file == broken image: drop, continue
                 if arr is None:
-                    continue  # same drop-broken-images semantics
+                    continue
                 yield arr, int(label)
 
     n = create_db(args.db, samples())
@@ -249,25 +252,14 @@ def cmd_convert_imageset(args) -> int:
 
 def cmd_compute_image_mean(args) -> int:
     """Record DB -> mean image .npy (ref: caffe/tools/compute_image_mean.cpp)."""
-    from sparknet_tpu.data.createdb import db_minibatches
-    from sparknet_tpu.data.minibatch import compute_mean_from_minibatches
+    from sparknet_tpu.data.createdb import db_mean
 
     try:
-        first = next(db_minibatches(args.db, 1))
-    except StopIteration:
-        raise SystemExit(f"record db {args.db!r} is empty") from None
-    shape = first["data"].shape[1:]
-    mean = compute_mean_from_minibatches(
-        (
-            (b["data"], b["label"])
-            for b in db_minibatches(
-                args.db, args.batch or 64, drop_remainder=False
-            )
-        ),
-        shape,
-    )
+        mean = db_mean(args.db, args.batch or 64)
+    except ValueError as e:
+        raise SystemExit(str(e)) from None
     np.save(args.out, mean)
-    print(json.dumps({"out": args.out, "shape": list(shape)}))
+    print(json.dumps({"out": args.out, "shape": list(mean.shape)}))
     return 0
 
 

@@ -55,6 +55,24 @@ def create_db(
     return n
 
 
+def db_mean(path: str, batch_size: int = 256) -> np.ndarray:
+    """Mean image over every record in a DB (the compute_image_mean job:
+    probe the shape from one record, then stream with the remainder kept)."""
+    from sparknet_tpu.data.minibatch import compute_mean_from_minibatches
+
+    try:
+        first = next(db_minibatches(path, 1))
+    except StopIteration:
+        raise ValueError(f"record db {path!r} is empty") from None
+    return compute_mean_from_minibatches(
+        (
+            (b["data"], b["label"])
+            for b in db_minibatches(path, batch_size, drop_remainder=False)
+        ),
+        first["data"].shape[1:],
+    )
+
+
 def db_minibatches(
     path: str, batch_size: int, loop: bool = False, drop_remainder: bool = True
 ) -> Iterator[dict[str, np.ndarray]]:
