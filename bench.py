@@ -43,7 +43,7 @@ def main() -> None:
         set_config(compute_dtype=jnp.bfloat16)
 
     solver = Solver(models.alexnet_solver(), models.alexnet(batch))
-    step = jax.jit(solver._make_train_step(), donate_argnums=(0, 1))
+    step, variables, slots, key = solver.jitted_train_step(donate=True)
 
     rs = np.random.RandomState(0)
     feeds = {
@@ -52,9 +52,8 @@ def main() -> None:
     }
     feeds = jax.device_put(feeds)
 
-    variables, slots = solver.variables, solver.slots
     for i in range(warmup):
-        variables, slots, loss = step(variables, slots, i, feeds, solver._key)
+        variables, slots, loss = step(variables, slots, i, feeds, key)
     # Fetch the VALUE, not just readiness: remote-relay backends (axon) can
     # report buffers ready before the chain has executed; pulling the scalar
     # is the reliable fence.
@@ -62,7 +61,7 @@ def main() -> None:
 
     t0 = time.perf_counter()
     for i in range(warmup, warmup + iters):
-        variables, slots, loss = step(variables, slots, i, feeds, solver._key)
+        variables, slots, loss = step(variables, slots, i, feeds, key)
     final_loss = float(loss)
     dt = time.perf_counter() - t0
     assert np.isfinite(final_loss), final_loss

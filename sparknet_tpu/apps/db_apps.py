@@ -55,21 +55,22 @@ class CifarDBApp:
         self.net = TPUNet(models.cifar10_full_solver(), models.cifar10_full(batch))
 
     def run(self, num_iters: int = 100, test_batches: int = 10) -> dict[str, float]:
-        train_stream = db_minibatches(self.train_db, self.batch, loop=True)
+        train_stream = db_minibatches(self.train_db, self.batch, loop=True, dtype=np.uint8)
 
         def train_fn(it):
             b = next(train_stream)
             return {
-                "data": self.transform(b["data"].astype(np.uint8), True),
+                "data": self.transform(b["data"], True),
                 "label": b["label"],
             }
 
         def test_feeds():
-            stream = db_minibatches(self.test_db, self.batch, loop=True)
+            stream = db_minibatches(self.test_db, self.batch, loop=True,
+                                    dtype=np.uint8)
             for _ in range(test_batches):
                 b = next(stream)
                 yield {
-                    "data": self.transform(b["data"].astype(np.uint8), False),
+                    "data": self.transform(b["data"], False),
                     "label": b["label"],
                 }
 
@@ -165,12 +166,13 @@ class ImageNetRunDBApp:
             self.log(f"resumed from {weights}")
 
     def run(self, num_iters: int) -> float:
-        stream = db_minibatches(self.db_path, self.batch, loop=True)
+        stream = db_minibatches(self.db_path, self.batch, loop=True,
+                                dtype=np.uint8)
 
         def train_fn(it):
             b = next(stream)
             return {
-                "data": self.transform(b["data"].astype(np.uint8), True),
+                "data": self.transform(b["data"], True),
                 "label": b["label"],
             }
 

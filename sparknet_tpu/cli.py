@@ -107,40 +107,50 @@ def cmd_train(args) -> int:
     log = EventLogger(".", prefix="tpunet_train")
     train_fn, test_fn = _data_fns(args, solver.train_net)
 
-    iters = args.iterations or solver_cfg.max_iter
-    if args.tau > 1 or args.distributed:
-        trainer = ParallelTrainer(solver, tau=args.tau)
-        outer = -(-iters // max(args.tau, 1))  # ceil: run >= requested iters
-        tau_fn = _stack_tau(train_fn, args.tau, trainer.num_workers)
-        with SignalHandler() as sig:
-            for o in range(outer):
-                if args.tau > 1:
-                    loss = trainer.train_round(tau_fn)
-                else:
-                    loss = trainer.train_round(
-                        _widen_batch(train_fn, trainer.num_workers)
-                    )
-                log(f"loss: {loss:.5f}", i=trainer.iter)
-                action = sig.check()
-                if action is SolverAction.SNAPSHOT:
-                    trainer.sync_to_solver()
-                    solver.save(f"tpunet_iter_{trainer.iter}")
-                elif action is SolverAction.STOP:
-                    break
-        trainer.sync_to_solver()
-    else:
-        with SignalHandler() as sig:
-            def hook(it, loss):
-                action = sig.check()
-                if action is SolverAction.SNAPSHOT:
-                    solver.save(f"tpunet_iter_{it}")
-                elif action is SolverAction.STOP:
-                    raise KeyboardInterrupt
+    import contextlib
 
-            try:
-                solver.step(iters, train_fn, callback=hook)
-            except KeyboardInterrupt:
-                log("stopped by signal", i=solver.iter)
+    profile_ctx = contextlib.nullcontext()
+    if args.profile:
+        from sparknet_tpu.utils import profiling
+
+        profile_ctx = profiling.trace(args.profile)
+        log(f"profiling -> {args.profile}")
+
+    iters = args.iterations or solver_cfg.max_iter
+    with profile_ctx:
+        if args.tau > 1 or args.distributed:
+            trainer = ParallelTrainer(solver, tau=args.tau)
+            outer = -(-iters // max(args.tau, 1))  # ceil: run >= requested
+            tau_fn = _stack_tau(train_fn, args.tau, trainer.num_workers)
+            with SignalHandler() as sig:
+                for o in range(outer):
+                    if args.tau > 1:
+                        loss = trainer.train_round(tau_fn)
+                    else:
+                        loss = trainer.train_round(
+                            _widen_batch(train_fn, trainer.num_workers)
+                        )
+                    log(f"loss: {loss:.5f}", i=trainer.iter)
+                    action = sig.check()
+                    if action is SolverAction.SNAPSHOT:
+                        trainer.sync_to_solver()
+                        solver.save(f"tpunet_iter_{trainer.iter}")
+                    elif action is SolverAction.STOP:
+                        break
+            trainer.sync_to_solver()
+        else:
+            with SignalHandler() as sig:
+                def hook(it, loss):
+                    action = sig.check()
+                    if action is SolverAction.SNAPSHOT:
+                        solver.save(f"tpunet_iter_{it}")
+                    elif action is SolverAction.STOP:
+                        raise KeyboardInterrupt
+
+                try:
+                    solver.step(iters, train_fn, callback=hook)
+                except KeyboardInterrupt:
+                    log("stopped by signal", i=solver.iter)
     if args.test_iters:
         scores = solver.test(args.test_iters, test_fn)
         log(f"scores: {scores}")
@@ -196,13 +206,40 @@ def cmd_test(args) -> int:
 
 
 def cmd_time(args) -> int:
-    """Per-layer forward/backward breakdown (ref: caffe.cpp:290-380)."""
+    """Per-layer forward/backward breakdown (ref: caffe.cpp:290-380).
+    ``--fused`` instead times the whole jitted train step — the number that
+    matters on TPU, where XLA fuses the layer loop away."""
     from sparknet_tpu.common import Phase
     from sparknet_tpu.compiler.graph import Network
     from sparknet_tpu.utils.timing import time_layers
     import jax
 
-    net_param, _ = _build_net_and_solver(args)
+    net_param, solver_cfg = _build_net_and_solver(args)
+    if args.fused:
+        import time as _time
+
+        from sparknet_tpu.solvers.solver import Solver
+
+        solver = Solver(solver_cfg, net_param)
+        train_fn, _ = _data_fns(args, solver.train_net)
+        feeds = jax.device_put(train_fn(0))
+        step, v, s, key = solver.jitted_train_step(donate=True)
+        iters = args.iterations or 10
+        v, s, loss = step(v, s, 0, feeds, key)
+        float(loss)  # compile + fence
+        t0 = _time.perf_counter()
+        for i in range(1, iters + 1):
+            v, s, loss = step(v, s, i, feeds, key)
+        float(loss)
+        dt = (_time.perf_counter() - t0) / iters
+        batch = next(iter(feeds.values())).shape[0]
+        print(json.dumps({
+            "fused_step_ms": round(dt * 1e3, 3),
+            "batch": int(batch),
+            "img_per_sec": round(batch / dt, 1),
+        }))
+        return 0
+
     net = Network(net_param, Phase.TRAIN)
     variables = net.init(jax.random.PRNGKey(0))
     train_fn, _ = _data_fns(args, net)
@@ -246,6 +283,11 @@ def cmd_convert_imageset(args) -> int:
                 yield arr, int(label)
 
     n = create_db(args.db, samples())
+    if n == 0:
+        raise SystemExit(
+            f"no decodable images: check --root {args.root!r} and the "
+            f"listfile paths (0 of the listed files produced records)"
+        )
     print(json.dumps({"records": n, "db": args.db}))
     return 0
 
@@ -328,6 +370,7 @@ def main(argv=None) -> int:
     sp.add_argument("--distributed", action="store_true", help="use the device mesh")
     sp.add_argument("--test-iters", type=int, default=0)
     sp.add_argument("--output", help="snapshot prefix for the final model")
+    sp.add_argument("--profile", help="capture a jax.profiler trace into DIR")
     sp.set_defaults(fn=cmd_train)
 
     sp = sub.add_parser("test", help="score a model")
@@ -336,6 +379,8 @@ def main(argv=None) -> int:
 
     sp = sub.add_parser("time", help="per-layer timing")
     common(sp)
+    sp.add_argument("--fused", action="store_true",
+                    help="time the whole jitted train step instead")
     sp.set_defaults(fn=cmd_time)
 
     sp = sub.add_parser("convert_imageset", help="image list -> record DB")

@@ -74,12 +74,18 @@ def db_mean(path: str, batch_size: int = 256) -> np.ndarray:
 
 
 def db_minibatches(
-    path: str, batch_size: int, loop: bool = False, drop_remainder: bool = True
+    path: str,
+    batch_size: int,
+    loop: bool = False,
+    drop_remainder: bool = True,
+    dtype=np.float32,
 ) -> Iterator[dict[str, np.ndarray]]:
     """Feed dicts from a record DB.  ``drop_remainder=True`` (the training
     contract) yields only full batches; ``False`` yields the final short
     batch too (stats passes — compute_image_mean must see every record).
-    ``loop=True`` restarts the cursor each epoch (the DataLayer's rewind)."""
+    ``loop=True`` restarts the cursor each epoch (the DataLayer's rewind).
+    ``dtype=np.uint8`` hands back raw pixels (skip the float cast when a
+    transformer will cast anyway)."""
     with RecordDB(path, "r") as db:
         if loop and (
             len(db) == 0 or (len(db) < batch_size and drop_remainder)
@@ -96,13 +102,13 @@ def db_minibatches(
                 labels.append(label)
                 if len(imgs) == batch_size:
                     yield {
-                        "data": np.stack(imgs).astype(np.float32),
+                        "data": np.stack(imgs).astype(dtype),
                         "label": np.asarray(labels, np.int32),
                     }
                     imgs, labels = [], []
             if imgs and not drop_remainder:
                 yield {
-                    "data": np.stack(imgs).astype(np.float32),
+                    "data": np.stack(imgs).astype(dtype),
                     "label": np.asarray(labels, np.int32),
                 }
             if not loop:

@@ -220,6 +220,19 @@ class Solver:
         return eval_step
 
     # ------------------------------------------------------------------
+    def jitted_train_step(self, donate: bool = True):
+        """Public handle for benchmarking/driving the fused train step:
+        ``(fn, variables, slots, key)`` where
+        ``fn(variables, slots, it, feeds, key) -> (variables, slots, loss)``.
+        With ``donate=True`` the returned state buffers are donated on each
+        call — thread the returned values, do not reuse ``self.variables``
+        afterwards."""
+        fn = jax.jit(
+            self._make_train_step(), donate_argnums=(0, 1) if donate else ()
+        )
+        return fn, self.variables, self.slots, self._key
+
+    # ------------------------------------------------------------------
     def step(self, num_iters: int, data_fn: DataFn, callback=None) -> float:
         """Run ``num_iters`` training iterations (ref: Solver::Step).
 

@@ -308,3 +308,25 @@ def test_db_apps_cifar_and_imagenet(tmp_path, cifar_dir):
     assert info["workers"][0]["records"] == 4  # 2 full batches of 2
     mean = np.load(info["mean"])
     assert mean.shape == (3, 32, 32)
+
+
+def test_cli_time_fused(capsys):
+    from sparknet_tpu.cli import main
+
+    rc = main(["time", "--solver", "zoo:lenet", "--batch", "4",
+               "--data", "synthetic", "--iterations", "2", "--fused"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["batch"] == 4 and out["fused_step_ms"] > 0
+
+
+def test_cli_train_profile(tmp_path, monkeypatch):
+    from sparknet_tpu.cli import main
+
+    monkeypatch.chdir(tmp_path)
+    rc = main(["train", "--solver", "zoo:lenet", "--batch", "4",
+               "--data", "synthetic", "--iterations", "2",
+               "--profile", str(tmp_path / "prof")])
+    assert rc == 0
+    found = [f for root, _, fs in os.walk(tmp_path / "prof") for f in fs]
+    assert found, "no profiler artifacts written"
