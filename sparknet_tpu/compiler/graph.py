@@ -139,6 +139,34 @@ class Network:
         self.net_inputs = self._net_level_inputs()
         self.feed_blobs.extend(n for n, _ in self.net_inputs)
         self._blob_info: dict[str, BlobInfo] | None = None
+        # Cross-layer weight sharing via `param { name: ... }` (ref:
+        # net.cpp:470+ AppendParam shared-blob wiring; the siamese example's
+        # two towers).  First occurrence of a name owns the array; later
+        # (layer, idx) positions alias it — apply() substitutes the owner's
+        # array, so autodiff accumulates every tower's gradient into it,
+        # exactly Caffe's shared-diff accumulation.  Ownership is elected
+        # over the UNFILTERED layer list so train/test phase views agree on
+        # the owner (phases share one variables pytree via the Solver).
+        self.param_aliases: dict[tuple[str, int], tuple[str, int]] = {}
+        owners: dict[str, tuple[str, int]] = {}
+        phase_names = {l.name for l in self.layers}
+        for lp in net_param.get_all("layer") or net_param.get_all("layers"):
+            lname = lp.get_str("name")
+            for i, pm in enumerate(lp.get_all("param")):
+                pname = pm.get_str("name", "")
+                if not pname:
+                    continue
+                if pname in owners:
+                    if lname in phase_names and owners[pname][0] != lname:
+                        self.param_aliases[(lname, i)] = owners[pname]
+                else:
+                    owners[pname] = (lname, i)
+        self._shared_names = {
+            alias: name
+            for name, owner in owners.items()
+            for alias, o in self.param_aliases.items()
+            if o == owner
+        }
 
     # -- legacy net-level inputs (ref: net.cpp AppendTop "deprecated 4D input
     # dimensions" / input_shape) ------------------------------------------
@@ -203,15 +231,67 @@ class Network:
                 continue
             in_shapes = [blob[b].shape for b in layer.bottoms]
             p, s = layer.init(sub, in_shapes)
+            if p and self.param_aliases:
+                # aliased positions store a 0-size placeholder; the real
+                # array lives at (and is updated through) the owner only
+                checked = []
+                for i, arr in enumerate(p):
+                    owner = self.param_aliases.get((layer.name, i))
+                    if owner is None:
+                        checked.append(arr)
+                        continue
+                    pname = self._shared_names.get((layer.name, i), "?")
+                    olist = params.get(owner[0])
+                    if olist is None or owner[1] >= len(olist):
+                        raise ValueError(
+                            f"Cannot share param {pname!r}: owner layer "
+                            f"{owner[0]!r} (position {owner[1]}) declares "
+                            "no such blob (is the param{} on a param-less "
+                            "or later layer?)"
+                        )
+                    if tuple(olist[owner[1]].shape) != tuple(arr.shape):
+                        raise ValueError(
+                            f"Cannot share param {pname!r}: owner "
+                            f"{owner[0]}[{owner[1]}] has shape "
+                            f"{tuple(olist[owner[1]].shape)} but "
+                            f"{layer.name}[{i}] expects {tuple(arr.shape)}"
+                        )
+                    checked.append(jnp.zeros((0,), arr.dtype))
+                p = checked
             if p:
                 params[layer.name] = p
             if s:
                 state[layer.name] = s
-            outs = self._abstract_apply(layer, p, s, [blob[b] for b in layer.bottoms])
+            outs = self._abstract_apply(
+                layer,
+                self._resolve_shared(layer, p, params),
+                s,
+                [blob[b] for b in layer.bottoms],
+            )
             for top, o in zip(layer.tops, outs):
                 blob[top] = jax.ShapeDtypeStruct(o.shape, o.dtype)
         self._blob_info = {k: BlobInfo(v.shape, v.dtype) for k, v in blob.items()}
         return NetVars(params=params, state=state)
+
+    def _resolve_shared(self, layer, p, all_params):
+        """Substitute owner arrays for aliased param positions."""
+        if not self.param_aliases or not p:
+            return p
+        out = list(p)
+        for i in range(len(out)):
+            owner = self.param_aliases.get((layer.name, i))
+            if owner is not None:
+                olist = all_params.get(owner[0])
+                if olist is None or owner[1] >= len(olist):
+                    pname = self._shared_names.get((layer.name, i), "?")
+                    raise ValueError(
+                        f"Cannot share param {pname!r}: owner {owner[0]!r} "
+                        f"has no params in this variables pytree (the owner "
+                        "layer may be filtered out of the phase that "
+                        "initialized the net)"
+                    )
+                out[i] = olist[owner[1]]
+        return out
 
     def _abstract_apply(self, layer, p, s, in_structs):
         train = self.phase == Phase.TRAIN
@@ -269,7 +349,9 @@ class Network:
                     for top, val in zip(layer.tops, layer.constant_values()):
                         blob[top] = val
                 continue
-            p = variables.params.get(layer.name, [])
+            p = self._resolve_shared(
+                layer, variables.params.get(layer.name, []), variables.params
+            )
             s = variables.state.get(layer.name, {})
             ins = [blob[b] for b in layer.bottoms]
             if mixed:

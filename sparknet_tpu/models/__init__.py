@@ -18,4 +18,6 @@ from sparknet_tpu.models.zoo import (  # noqa: F401
     googlenet_solver,
     lenet,
     lenet_solver,
+    mnist_siamese,
+    mnist_siamese_solver,
 )

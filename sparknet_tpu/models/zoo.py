@@ -361,3 +361,74 @@ def googlenet_solver() -> SolverConfig:
         momentum=0.9, weight_decay=2e-4, max_iter=2400000,
         solver_type="SGD", display=40,
     )
+
+
+# ---------------------------------------------------------------------------
+# MNIST siamese — the weight-sharing example (ref:
+# caffe/examples/siamese/mnist_siamese_train_test.prototxt): a stacked
+# image pair is sliced into two LeNet-style towers whose conv/ip layers
+# share weights via `param { name: ... }`; a ContrastiveLoss pulls same-
+# class embeddings together and pushes different-class pairs apart.
+# ---------------------------------------------------------------------------
+def _shared(m: Message, *names: str) -> Message:
+    """Attach named param{} messages for cross-layer weight sharing.
+    lr_mults follow the reference siamese file: weights 1, biases 2."""
+    for n, lr in zip(names, (1.0, 2.0)):
+        m.add("param", Message().set("name", n).set("lr_mult", lr))
+    return m
+
+
+def _siamese_tower(suffix: str, bottom: str, embed_dim: int) -> list[Message]:
+    s = suffix
+    return [
+        _shared(ConvolutionLayer(f"conv1{s}", [bottom], kernel=(5, 5),
+                                 num_output=20), "conv1_w", "conv1_b"),
+        PoolingLayer(f"pool1{s}", [f"conv1{s}"], Pooling.Max,
+                     kernel=(2, 2), stride=(2, 2)),
+        _shared(ConvolutionLayer(f"conv2{s}", [f"pool1{s}"], kernel=(5, 5),
+                                 num_output=50), "conv2_w", "conv2_b"),
+        PoolingLayer(f"pool2{s}", [f"conv2{s}"], Pooling.Max,
+                     kernel=(2, 2), stride=(2, 2)),
+        _shared(InnerProductLayer(f"ip1{s}", [f"pool2{s}"], num_output=500),
+                "ip1_w", "ip1_b"),
+        ReLULayer(f"relu1{s}", [f"ip1{s}"], in_place=True),
+        _shared(InnerProductLayer(f"ip2{s}", [f"ip1{s}"], num_output=10),
+                "ip2_w", "ip2_b"),
+        _shared(InnerProductLayer(f"feat{s}", [f"ip2{s}"],
+                                  num_output=embed_dim), "feat_w", "feat_b"),
+    ]
+
+
+def mnist_siamese(batch: int = 64, embed_dim: int = 2, margin: float = 1.0) -> Message:
+    slice_layer = Message()
+    slice_layer.set("name", "slice_pair").set("type", "Slice")
+    slice_layer.add("bottom", "pair_data")
+    slice_layer.add("top", "data")
+    slice_layer.add("top", "data_p")
+    slice_layer.set(
+        "slice_param", Message().set("slice_dim", 1).set("slice_point", 1)
+    )
+    loss = Message()
+    loss.set("name", "loss").set("type", "ContrastiveLoss")
+    for b in ("feat", "feat_p", "sim"):
+        loss.add("bottom", b)
+    loss.add("top", "loss")
+    loss.set("contrastive_loss_param", Message().set("margin", margin))
+    return NetParam(
+        "mnist_siamese",
+        RDDLayer("pair_data", shape=[batch, 2, 28, 28]),
+        RDDLayer("sim", shape=[batch]),
+        slice_layer,
+        *_siamese_tower("", "data", embed_dim),
+        *_siamese_tower("_p", "data_p", embed_dim),
+        loss,
+    )
+
+
+def mnist_siamese_solver() -> SolverConfig:
+    """ref: caffe/examples/siamese/mnist_siamese_solver.prototxt."""
+    return SolverConfig(
+        base_lr=0.01, lr_policy="inv", gamma=1e-4, power=0.75,
+        momentum=0.9, weight_decay=0.0, max_iter=50000,
+        solver_type="SGD", display=500,
+    )

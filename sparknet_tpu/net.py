@@ -174,13 +174,19 @@ class TPUNet:
 
         layers = []
         type_by_name = {l.name: l.TYPE for l in self.train_net.layers}
-        for lname, plist in self.solver.variables.params.items():
+        aliases = self.train_net.param_aliases
+        all_params = self.solver.variables.params
+        for lname, plist in all_params.items():
+            blobs = []
+            for i, p in enumerate(plist):
+                owner = aliases.get((lname, i))
+                if owner is not None:
+                    # write the owner's (current) array, matching Caffe's
+                    # per-layer duplication of shared blobs in ToProto
+                    p = all_params[owner[0]][owner[1]]
+                blobs.append(np.asarray(p))
             layers.append(
-                CaffeModelLayer(
-                    lname,
-                    type_by_name.get(lname, ""),
-                    [np.asarray(p) for p in plist],
-                )
+                CaffeModelLayer(lname, type_by_name.get(lname, ""), blobs)
             )
         save_caffemodel(path, CaffeModel(self.train_net.net_param.get_str("name", ""), layers))
 
@@ -205,6 +211,12 @@ class TPUNet:
             new = []
             ok = True
             for src, dst in zip(layer.blobs, target):
+                if dst.size == 0:
+                    # shared-param alias placeholder: the real array lives
+                    # at the owner layer (Caffe files duplicate shared
+                    # blobs per layer; the owner's copy wins)
+                    new.append(dst)
+                    continue
                 if tuple(src.shape) != tuple(dst.shape):
                     if np.prod(src.shape) == np.prod(dst.shape):
                         # Caffe reshapes legacy 4D fc blobs (1,1,N,K)->(N,K)
@@ -230,14 +242,21 @@ class TPUNet:
     # -- HDF5 snapshots (ref: Net::ToHDF5/CopyTrainedLayersFromHDF5,
     # caffe/src/caffe/net.cpp:926 + util/hdf5.cpp) -------------------------
     def save_hdf5(self, path: str) -> None:
-        """Layout mirrors Caffe's: group ``data/<layer>/<i>`` per blob."""
+        """Layout mirrors Caffe's: group ``data/<layer>/<i>`` per blob.
+        Shared-param aliases write the owner's values (Caffe duplicates
+        shared blobs per layer)."""
         import h5py
 
+        aliases = self.train_net.param_aliases
+        all_params = self.solver.variables.params
         with h5py.File(path, "w") as f:
             data = f.create_group("data")
-            for lname, plist in self.solver.variables.params.items():
+            for lname, plist in all_params.items():
                 g = data.create_group(lname)
                 for i, p in enumerate(plist):
+                    owner = aliases.get((lname, i))
+                    if owner is not None:
+                        p = all_params[owner[0]][owner[1]]
                     g.create_dataset(str(i), data=np.asarray(p))
 
     def load_hdf5(self, path: str) -> list[str]:
@@ -259,7 +278,8 @@ class TPUNet:
                         f"net expects {len(target)}"
                     )
                 params[lname] = [
-                    jnp.asarray(a.reshape(p.shape), p.dtype)
+                    # zero-size placeholder = shared alias; owner's copy wins
+                    p if p.size == 0 else jnp.asarray(a.reshape(p.shape), p.dtype)
                     for a, p in zip(arrs, target)
                 ]
                 loaded.append(lname)

@@ -55,14 +55,35 @@ def replace_data_layers(
         for v in vals:
             out.add(k, v.copy() if isinstance(v, Message) else v)
 
+    def _phase_tops(phase: str) -> list[str]:
+        """Top names of the data layers active in ``phase`` (so surgery
+        preserves nonstandard names like the siamese pair_data/sim)."""
+        tops: list[str] = []
+        for lp in net_param.get_all("layer") or net_param.get_all("layers"):
+            if lp.get_str("type") not in _DATA_LAYER_TYPES:
+                continue
+            includes = lp.get_all("include")
+            if includes and not any(
+                r.get_str("phase", phase) == phase for r in includes
+            ):
+                continue
+            for t in lp.get_all("top"):
+                if str(t) not in tops:
+                    tops.append(str(t))
+        return tops or ["data", "label"]
+
     def input_pair(batch: int, phase: str) -> list[Message]:
-        data = RDDLayer("data", [batch, channels, height, width])
-        data.set("name", f"data_{phase.lower()}")
-        data.add("include", Message().set("phase", phase))
-        label = RDDLayer("label", [batch])
-        label.set("name", f"label_{phase.lower()}")
-        label.add("include", Message().set("phase", phase))
-        return [data, label]
+        tops = _phase_tops(phase)
+        layers = []
+        # first top carries the image geometry; the rest are per-sample
+        # scalars (label / similarity)
+        for i, top in enumerate(tops):
+            shape = [batch, channels, height, width] if i == 0 else [batch]
+            l = RDDLayer(top, shape)
+            l.set("name", f"{top}_{phase.lower()}")
+            l.add("include", Message().set("phase", phase))
+            layers.append(l)
+        return layers
 
     for l in input_pair(train_batch_size, "TRAIN") + input_pair(test_batch_size, "TEST"):
         out.add("layer", l)

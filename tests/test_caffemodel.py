@@ -196,3 +196,30 @@ def test_load_caffemodel_permissive_skips_mismatch(tmp_path):
     loaded = other.load_caffemodel(path, strict_shapes=False)
     # ip2 (10 classes vs 7) skipped; the rest load
     assert "ip2" not in loaded and "conv1" in loaded
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference tree not mounted")
+def test_reference_siamese_prototxt_compiles():
+    """The weight-sharing siamese example parses, survives surgery with its
+    nonstandard pair_data/sim tops, compiles, and shares params."""
+    import jax.numpy as jnp
+
+    from sparknet_tpu.common import Phase
+    from sparknet_tpu.compiler.graph import Network
+    from sparknet_tpu.proto_loader import load_net_prototxt, replace_data_layers
+
+    B = 2
+    net_param = replace_data_layers(
+        load_net_prototxt(os.path.join(
+            REF, "examples/siamese/mnist_siamese_train_test.prototxt")),
+        B, B, 2, 28, 28,
+    )
+    net = Network(net_param, Phase.TRAIN)
+    assert ("conv1_p", 0) in net.param_aliases
+    variables = net.init(jax.random.PRNGKey(0))
+    feeds = {
+        "pair_data": jnp.zeros((B, 2, 28, 28), jnp.float32),
+        "sim": jnp.zeros((B,), jnp.float32),
+    }
+    blobs, _, loss = net.apply(variables, feeds, rng=jax.random.PRNGKey(1))
+    assert np.isfinite(float(loss))

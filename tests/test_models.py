@@ -163,3 +163,168 @@ def test_googlenet_gradients_flow():
     grads = jax.grad(loss_fn)(variables.params)
     leaves = jax.tree_util.tree_leaves(grads)
     assert all(bool(jnp.all(jnp.isfinite(g))) for g in leaves)
+
+
+def test_siamese_weight_sharing():
+    """The two towers share arrays: the alias map routes conv1_p/ip*_p to
+    the first tower's params, placeholders are zero-size, gradients
+    accumulate from BOTH towers into the owner."""
+    B = 4
+    m = models.mnist_siamese(B)
+    net = Network(m, Phase.TRAIN)
+    # aliases: every _p tower param points at the bare-tower owner
+    assert net.param_aliases[("conv1_p", 0)] == ("conv1", 0)
+    assert net.param_aliases[("feat_p", 1)] == ("feat", 1)
+    variables = net.init(jax.random.PRNGKey(0))
+    assert variables.params["conv1_p"][0].size == 0  # placeholder
+    assert variables.params["conv1"][0].shape == (20, 1, 5, 5)
+
+    rs = np.random.RandomState(0)
+    feeds = {
+        "pair_data": jnp.asarray(rs.randn(B, 2, 28, 28), jnp.float32),
+        "sim": jnp.asarray(rs.randint(0, 2, B), jnp.float32),
+    }
+    blobs, _, loss = net.apply(variables, feeds, rng=jax.random.PRNGKey(1))
+    assert blobs["feat"].shape == (B, 2) and blobs["feat_p"].shape == (B, 2)
+    assert jnp.isfinite(loss)
+
+    # gradient of the shared conv1 weight sees both towers: zeroing the _p
+    # tower's input must CHANGE the owner's grad
+    def loss_fn(params, f):
+        from sparknet_tpu.compiler.graph import NetVars
+        _, _, l = net.apply(NetVars(params=params, state=variables.state),
+                            f, rng=jax.random.PRNGKey(1))
+        return l
+
+    g1 = jax.grad(loss_fn)(variables.params, feeds)
+    feeds2 = dict(feeds)
+    feeds2["pair_data"] = feeds["pair_data"].at[:, 1].set(0.0)
+    g2 = jax.grad(loss_fn)(variables.params, feeds2)
+    assert not np.allclose(np.asarray(g1["conv1"][0]),
+                           np.asarray(g2["conv1"][0]))
+    # placeholder grads are empty
+    assert g1["conv1_p"][0].size == 0
+
+
+def test_siamese_trains_contrastive():
+    """Same-class pairs end up closer than different-class pairs."""
+    from sparknet_tpu.net import TPUNet
+
+    B = 32
+    rs = np.random.RandomState(0)
+
+    def digits(n):
+        labels = rs.randint(0, 4, n)
+        imgs = rs.randn(n, 1, 28, 28).astype(np.float32) * 0.3
+        for i, k in enumerate(labels):
+            imgs[i, 0, :, 4 + k * 5] += 2.5
+        return imgs, labels
+
+    def gen():
+        while True:
+            a_img, a_lab = digits(B)
+            b_img, b_lab = digits(B)
+            yield {
+                "pair_data": np.concatenate([a_img, b_img], axis=1),
+                "sim": (a_lab == b_lab).astype(np.float32),
+            }
+
+    net = TPUNet(models.mnist_siamese_solver(), models.mnist_siamese(B))
+    net.set_train_data(gen())
+    net.train(120)
+
+    # embed a fresh batch; same-class distance << diff-class distance
+    a_img, a_lab = digits(B)
+    b_img, b_lab = digits(B)
+    blobs = net.forward({
+        "pair_data": np.concatenate([a_img, b_img], axis=1),
+        "sim": (a_lab == b_lab).astype(np.float32),
+    })
+    d = np.linalg.norm(np.asarray(blobs["feat"]) - np.asarray(blobs["feat_p"]), axis=1)
+    same = d[a_lab == b_lab].mean()
+    diff = d[a_lab != b_lab].mean()
+    assert same < 0.5 * diff, (same, diff)
+
+
+def test_siamese_caffemodel_shared_roundtrip(tmp_path):
+    """Shared params export with the owner's values duplicated per layer
+    (Caffe's ToProto layout) and reload into placeholders cleanly."""
+    from sparknet_tpu.net import TPUNet
+
+    net = TPUNet(models.mnist_siamese_solver(), models.mnist_siamese(4))
+    p = str(tmp_path / "siam.caffemodel")
+    net.save_caffemodel(p)
+    from sparknet_tpu.proto.binary import load_caffemodel
+
+    m = load_caffemodel(p)
+    by = m.by_name()
+    np.testing.assert_array_equal(by["conv1"].blobs[0], by["conv1_p"].blobs[0])
+
+    net2 = TPUNet(models.mnist_siamese_solver(), models.mnist_siamese(4))
+    loaded = net2.load_caffemodel(p)
+    assert "conv1" in loaded and "conv1_p" in loaded
+    np.testing.assert_array_equal(
+        np.asarray(net.solver.variables.params["conv1"][0]),
+        np.asarray(net2.solver.variables.params["conv1"][0]))
+    assert net2.solver.variables.params["conv1_p"][0].size == 0
+
+
+def test_siamese_hdf5_shared_roundtrip(tmp_path):
+    """HDF5 snapshots duplicate shared blobs per layer (owner values) and
+    reload placeholders cleanly — same contract as the caffemodel path."""
+    import h5py
+    from sparknet_tpu.net import TPUNet
+
+    net = TPUNet(models.mnist_siamese_solver(), models.mnist_siamese(4))
+    p = str(tmp_path / "siam.h5")
+    net.save_hdf5(p)
+    with h5py.File(p, "r") as f:
+        a = np.asarray(f["data/conv1/0"])
+        b = np.asarray(f["data/conv1_p/0"])
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (20, 1, 5, 5)
+
+    net2 = TPUNet(models.mnist_siamese_solver(), models.mnist_siamese(4))
+    loaded = net2.load_hdf5(p)
+    assert "conv1" in loaded
+    np.testing.assert_array_equal(
+        np.asarray(net.solver.variables.params["conv1"][0]),
+        np.asarray(net2.solver.variables.params["conv1"][0]))
+    assert net2.solver.variables.params["conv1_p"][0].size == 0
+
+
+def test_shared_param_mismatched_shape_rejected():
+    """Sharing a name across incompatible blobs raises the clear error, not
+    a deep conv shape failure (Caffe's 'Cannot share param' CHECK)."""
+    from sparknet_tpu.layers_dsl import _filler
+    from sparknet_tpu.proto.text_format import Message
+
+    def named(m, name):
+        m.add("param", Message().set("name", name))
+        return m
+
+    from sparknet_tpu.layers_dsl import (
+        ConvolutionLayer as Conv, InnerProductLayer as Ip, NetParam, RDDLayer,
+        SoftmaxWithLoss,
+    )
+
+    m = NetParam(
+        "bad",
+        RDDLayer("data", shape=[2, 1, 8, 8]),
+        RDDLayer("label", shape=[2]),
+        named(Conv("c1", ["data"], kernel=(3, 3), num_output=4), "w"),
+        named(Conv("c2", ["c1"], kernel=(3, 3), num_output=8), "w"),
+        SoftmaxWithLoss("loss", ["c2", "label"]),
+    )
+    net = Network(m, Phase.TRAIN)
+    with pytest.raises(ValueError, match="Cannot share param 'w'"):
+        net.init(jax.random.PRNGKey(0))
+
+
+def test_siamese_bias_lr_mult_matches_reference():
+    """Biases train at lr_mult=2 like the reference siamese prototxt."""
+    net = Network(models.mnist_siamese(2), Phase.TRAIN)
+    variables = net.init(jax.random.PRNGKey(0))
+    specs = net.param_specs_for(variables)
+    assert specs["conv1"][0].lr_mult == 1.0
+    assert specs["conv1"][1].lr_mult == 2.0
