@@ -44,7 +44,9 @@ class CifarDBApp:
             np.save(mean_path, self.mean_image)
         elif os.path.exists(mean_path):
             self.log("reusing existing DBs + mean")
-            self.mean_image = np.load(mean_path)
+            from sparknet_tpu.data.transform import load_mean_file
+
+            self.mean_image = load_mean_file(mean_path)
         else:  # DBs from an older materialize: one recovery scan, then cache
             self.log("reusing existing DBs; recomputing mean from train DB")
             self.mean_image = db_mean(self.train_db)
@@ -154,7 +156,9 @@ class ImageNetRunDBApp:
         with open(os.path.join(db_dir, "info.json")) as f:
             self.info = json.load(f)
         self.db_path = self.info["workers"][worker]["db"]
-        mean = np.load(self.info["mean"])
+        from sparknet_tpu.data.transform import load_mean_file
+
+        mean = load_mean_file(self.info["mean"])
         self.transform = DataTransformer(
             TransformConfig(crop_size=crop, mirror=True, mean_image=mean)
         )

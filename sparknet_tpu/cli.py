@@ -300,7 +300,12 @@ def cmd_compute_image_mean(args) -> int:
         mean = db_mean(args.db, args.batch or 64)
     except ValueError as e:
         raise SystemExit(str(e)) from None
-    np.save(args.out, mean)
+    if args.out.endswith(".binaryproto"):
+        from sparknet_tpu.data.io_utils import save_mean_binaryproto
+
+        save_mean_binaryproto(args.out, mean)
+    else:
+        np.save(args.out, mean)
     print(json.dumps({"out": args.out, "shape": list(mean.shape)}))
     return 0
 
@@ -325,6 +330,26 @@ def cmd_extract_features(args) -> int:
     out = np.concatenate(feats)
     np.save(args.out, out)
     print(json.dumps({"out": args.out, "shape": list(out.shape)}))
+    return 0
+
+
+def cmd_draw(args) -> int:
+    """Net prototxt -> Graphviz DOT (ref: caffe/python/draw_net.py)."""
+    from sparknet_tpu import models
+    from sparknet_tpu.proto.text_format import parse_file
+    from sparknet_tpu.utils.draw import draw_net_to_file
+
+    if args.net.startswith("zoo:"):
+        net_param = getattr(models, args.net[4:])(args.batch or 100)
+    else:
+        net_param = parse_file(args.net)
+    draw_net_to_file(
+        net_param,
+        args.out,
+        rankdir=args.rankdir,
+        phase=args.phase or None,
+    )
+    print(json.dumps({"out": args.out, "rankdir": args.rankdir}))
     return 0
 
 
@@ -401,6 +426,14 @@ def main(argv=None) -> int:
     sp.add_argument("--blob", required=True, help="blob name, e.g. ip1")
     sp.add_argument("--out", required=True, help="output .npy")
     sp.set_defaults(fn=cmd_extract_features)
+
+    sp = sub.add_parser("draw", help="net prototxt -> Graphviz DOT")
+    sp.add_argument("--net", required=True, help="net prototxt path or zoo:<name>")
+    sp.add_argument("--out", required=True, help="output .dot path")
+    sp.add_argument("--rankdir", default="LR", choices=["LR", "TB", "BT", "RL"])
+    sp.add_argument("--phase", default="", help="filter by TRAIN/TEST")
+    sp.add_argument("--batch", type=int, default=0, help="zoo batch override")
+    sp.set_defaults(fn=cmd_draw)
 
     sp = sub.add_parser("device_query", help="show devices")
     sp.set_defaults(fn=cmd_device_query)

@@ -148,6 +148,7 @@ class Network:
         # over the UNFILTERED layer list so train/test phase views agree on
         # the owner (phases share one variables pytree via the Solver).
         self.param_aliases: dict[tuple[str, int], tuple[str, int]] = {}
+        self._shared_names: dict[tuple[str, int], str] = {}
         owners: dict[str, tuple[str, int]] = {}
         phase_names = {l.name for l in self.layers}
         for lp in net_param.get_all("layer") or net_param.get_all("layers"):
@@ -159,14 +160,9 @@ class Network:
                 if pname in owners:
                     if lname in phase_names and owners[pname][0] != lname:
                         self.param_aliases[(lname, i)] = owners[pname]
+                        self._shared_names[(lname, i)] = pname
                 else:
                     owners[pname] = (lname, i)
-        self._shared_names = {
-            alias: name
-            for name, owner in owners.items()
-            for alias, o in self.param_aliases.items()
-            if o == owner
-        }
 
     # -- legacy net-level inputs (ref: net.cpp AppendTop "deprecated 4D input
     # dimensions" / input_shape) ------------------------------------------
@@ -231,6 +227,16 @@ class Network:
                 continue
             in_shapes = [blob[b].shape for b in layer.bottoms]
             p, s = layer.init(sub, in_shapes)
+            # an alias position the layer never materializes would otherwise
+            # be silently skipped and train unshared (Caffe CHECK-fails,
+            # ref: net.cpp:470+ AppendParam)
+            for (aname, ai), pname in self._shared_names.items():
+                if aname == layer.name and ai >= len(p or []):
+                    raise ValueError(
+                        f"param name {pname!r} at position {ai} of layer "
+                        f"{aname!r}, which has only {len(p or [])} learnable "
+                        "blob(s) — sharing would be silently dropped"
+                    )
             if p and self.param_aliases:
                 # aliased positions store a 0-size placeholder; the real
                 # array lives at (and is updated through) the owner only

@@ -21,6 +21,16 @@ import dataclasses
 import numpy as np
 
 
+def load_mean_file(path: str) -> np.ndarray:
+    """Load a mean image from ``.npy`` or Caffe ``.binaryproto`` (ref:
+    data_transformer.cpp:19-29 reads mean_file as a BlobProto)."""
+    if path.endswith(".npy"):
+        return np.load(path).astype(np.float32)
+    from sparknet_tpu.data.io_utils import load_mean_binaryproto
+
+    return load_mean_binaryproto(path)
+
+
 @dataclasses.dataclass
 class TransformConfig:
     """ref: TransformationParameter (caffe.proto:399-426)."""

@@ -170,10 +170,20 @@ def SoftmaxWithLoss(name: str, bottoms: Sequence[str]) -> Message:
     return _layer(name, "SoftmaxWithLoss", bottoms)
 
 
-def AccuracyLayer(name: str, bottoms: Sequence[str], top_k: int = 1) -> Message:
+def AccuracyLayer(
+    name: str,
+    bottoms: Sequence[str],
+    top_k: int = 1,
+    phase: str | None = None,
+) -> Message:
+    """``phase="TEST"`` adds the include rule the reference prototxts put on
+    every Accuracy layer (e.g. caffe/examples/mnist/lenet_train_test.prototxt:
+    ``include { phase: TEST }``)."""
     m = _layer(name, "Accuracy", bottoms)
     if top_k != 1:
         m.set("accuracy_param", Message().set("top_k", top_k))
+    if phase is not None:
+        m.add("include", Message().set("phase", phase))
     return m
 
 

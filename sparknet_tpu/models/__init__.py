@@ -5,6 +5,9 @@ Each builder returns a ``NetParameter`` Message ready for ``Network``/
 (ref: caffe/models/ + caffe/examples/).
 """
 
+from sparknet_tpu.models.classifier import Classifier  # noqa: F401
+from sparknet_tpu.models.deploy import DeployNet  # noqa: F401
+from sparknet_tpu.models.detector import Detector  # noqa: F401
 from sparknet_tpu.models.zoo import (  # noqa: F401
     alexnet,
     alexnet_solver,

@@ -1,0 +1,77 @@
+"""Image classifier specialization — pycaffe ``caffe.Classifier`` parity.
+
+ref: caffe/python/caffe/classifier.py:11-99 — scale input images to
+``image_dims``, center-crop or 10-crop oversample to the net's input size,
+preprocess through the Transformer, forward, and (for oversampling) average
+predictions over the 10 crops.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from sparknet_tpu.data import io_utils as cio
+from sparknet_tpu.models.deploy import DeployNet
+
+
+class Classifier(DeployNet):
+    def __init__(
+        self,
+        model_file,
+        pretrained_file=None,
+        image_dims=None,
+        mean=None,
+        input_scale=None,
+        raw_scale=None,
+        channel_swap=None,
+    ):
+        super().__init__(
+            model_file,
+            pretrained_file,
+            mean=mean,
+            input_scale=input_scale,
+            raw_scale=raw_scale,
+            channel_swap=channel_swap,
+        )
+        in_ = self.inputs[0]
+        self.crop_dims = np.array(self.feed_shapes[in_][2:])
+        self.image_dims = tuple(image_dims) if image_dims else tuple(self.crop_dims)
+
+    def predict(self, inputs, oversample: bool = True) -> np.ndarray:
+        """(N) iterable of (H, W, K) images -> (N, C) class probabilities.
+
+        ``oversample=True`` averages over 4 corners + center and mirrors
+        (classifier.py:47-99); ``False`` takes the center crop only.
+        """
+        inputs = list(inputs)
+        input_ = np.zeros(
+            (len(inputs), self.image_dims[0], self.image_dims[1], inputs[0].shape[2]),
+            np.float32,
+        )
+        for ix, im in enumerate(inputs):
+            input_[ix] = cio.resize_image(im, self.image_dims)
+
+        if oversample:
+            input_ = cio.oversample(input_, self.crop_dims)
+        else:
+            center = np.array(self.image_dims) / 2.0
+            crop = np.tile(center, (1, 2))[0] + np.concatenate(
+                [-self.crop_dims / 2.0, self.crop_dims / 2.0]
+            )
+            crop = crop.astype(int)
+            input_ = input_[:, crop[0] : crop[2], crop[1] : crop[3], :]
+
+        in_ = self.inputs[0]
+        caffe_in = np.zeros(
+            (len(input_),) + tuple(np.array(input_.shape)[[3, 1, 2]]), np.float32
+        )
+        for ix, im in enumerate(input_):
+            caffe_in[ix] = self.transformer.preprocess(in_, im)
+        out = self.forward_all(in_, caffe_in)
+        predictions = out[self.outputs[0]]
+        predictions = predictions.reshape(len(predictions), -1)
+
+        if oversample:
+            predictions = predictions.reshape((len(predictions) // 10, 10, -1))
+            predictions = predictions.mean(1)
+        return predictions

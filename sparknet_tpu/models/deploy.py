@@ -1,0 +1,89 @@
+"""Deploy-time inference wrapper shared by Classifier / Detector.
+
+The pycaffe model-usage classes (ref: caffe/python/caffe/classifier.py:11-99,
+detector.py:22-211) extend ``caffe.Net`` loaded in TEST phase from a deploy
+prototxt + ``.caffemodel``.  Here the equivalent handle owns a compiled
+TEST-phase :class:`~sparknet_tpu.compiler.graph.Network` and a fixed-shape
+jitted forward — inference over any number of inputs runs in net-batch-size
+chunks so XLA compiles exactly one program (dynamic batch shapes would
+recompile per call; see pycaffe.py:155-197 ``_Net_forward_all`` for the
+reference's equivalent host-side batching).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from sparknet_tpu.data import io_utils as cio
+from sparknet_tpu.net import TPUNet
+from sparknet_tpu.proto.text_format import Message
+from sparknet_tpu.solvers.solver import SolverConfig
+
+
+class DeployNet:
+    """TEST-phase net + Transformer, loaded from prototxt (+ weights).
+
+    Parameters mirror the pycaffe classes: ``model_file`` is a deploy
+    prototxt path or an already-parsed ``NetParameter`` Message;
+    ``pretrained_file`` is a ``.caffemodel`` (or ``.h5``/HDF5) weights file.
+    """
+
+    def __init__(
+        self,
+        model_file: str | Message,
+        pretrained_file: str | None = None,
+        mean: np.ndarray | None = None,
+        input_scale: float | None = None,
+        raw_scale: float | None = None,
+        channel_swap: tuple[int, ...] | None = None,
+    ):
+        if isinstance(model_file, Message):
+            net_param = model_file
+        else:
+            from sparknet_tpu.proto_loader import load_net_prototxt
+
+            net_param = load_net_prototxt(model_file)
+        self.net = TPUNet(SolverConfig(), net_param)
+        if pretrained_file is not None:
+            if pretrained_file.endswith((".h5", ".hdf5", ".caffemodel.h5")):
+                self.net.load_hdf5(pretrained_file)
+            else:
+                self.net.load_caffemodel(pretrained_file)
+
+        shapes = self.net.test_net.feed_shapes()
+        # data inputs only — a deploy net has no label feed, but a net built
+        # from a train prototxt may; keep 4-D image feeds
+        self.inputs = [n for n, s in shapes.items() if len(s) == 4] or list(shapes)
+        self.outputs = self.net.test_net.output_blobs()
+        self.feed_shapes = shapes
+
+        in_ = self.inputs[0]
+        self.transformer = cio.Transformer({in_: shapes[in_]})
+        self.transformer.set_transpose(in_, (2, 0, 1))
+        if mean is not None:
+            self.transformer.set_mean(in_, np.asarray(mean, np.float32))
+        if input_scale is not None:
+            self.transformer.set_input_scale(in_, input_scale)
+        if raw_scale is not None:
+            self.transformer.set_raw_scale(in_, raw_scale)
+        if channel_swap is not None:
+            self.transformer.set_channel_swap(in_, channel_swap)
+
+    # ------------------------------------------------------------------
+    def forward_all(self, in_: str, data: np.ndarray) -> dict[str, np.ndarray]:
+        """Forward N preprocessed samples in net-batch chunks; concat outputs.
+
+        ref: pycaffe.py:155-197 — batch, forward, drop padding.
+        """
+        batch = self.feed_shapes[in_][0]
+        n = len(data)
+        outs: dict[str, list[np.ndarray]] = {o: [] for o in self.outputs}
+        for lo in range(0, n, batch):
+            chunk = data[lo : lo + batch]
+            if len(chunk) < batch:  # pad the ragged tail; trimmed below
+                pad = np.zeros((batch - len(chunk),) + chunk.shape[1:], chunk.dtype)
+                chunk = np.concatenate([chunk, pad])
+            blobs = self.net.forward({in_: chunk})
+            for o in self.outputs:
+                outs[o].append(np.asarray(blobs[o]))
+        return {o: np.concatenate(v)[:n] for o, v in outs.items()}

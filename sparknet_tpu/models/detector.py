@@ -1,0 +1,156 @@
+"""Windowed R-CNN-style detector — pycaffe ``caffe.Detector`` parity.
+
+ref: caffe/python/caffe/detector.py:22-211 — classify a list of image
+windows, each cropped (optionally with ``context_pad`` surrounding context,
+mean-padded out of bounds) and warped to the net input size.  The selective-
+search proposal mode (detector.py:101-124) required an external MATLAB
+package in the reference and is not reproduced; callers pass explicit
+windows.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from sparknet_tpu.data import io_utils as cio
+from sparknet_tpu.models.deploy import DeployNet
+
+
+class Detector(DeployNet):
+    def __init__(
+        self,
+        model_file,
+        pretrained_file=None,
+        mean=None,
+        input_scale=None,
+        raw_scale=None,
+        channel_swap=None,
+        context_pad=None,
+    ):
+        super().__init__(
+            model_file,
+            pretrained_file,
+            mean=mean,
+            input_scale=input_scale,
+            raw_scale=raw_scale,
+            channel_swap=channel_swap,
+        )
+        self.configure_crop(context_pad)
+
+    def detect_windows(self, images_windows) -> list[dict]:
+        """(image, window-list) pairs -> per-window prediction dicts.
+
+        ``images_windows`` items are ``(filename_or_array, windows)`` where
+        each window is (ymin, xmin, ymax, xmax) (detector.py:56-99).
+        """
+        images_windows = [
+            (im, [np.asarray(w) for w in windows]) for im, windows in images_windows
+        ]
+        window_inputs = []
+        for image_src, windows in images_windows:
+            image = self._load(image_src)
+            for window in windows:
+                window_inputs.append(self.crop(image, window))
+
+        in_ = self.inputs[0]
+        in_dims = self.feed_shapes[in_][2:]
+        caffe_in = np.zeros(
+            (len(window_inputs), window_inputs[0].shape[2]) + tuple(in_dims),
+            np.float32,
+        )
+        for ix, window_in in enumerate(window_inputs):
+            caffe_in[ix] = self.transformer.preprocess(in_, window_in)
+        out = self.forward_all(in_, caffe_in)
+        predictions = out[self.outputs[0]].reshape(len(caffe_in), -1)
+
+        detections = []
+        ix = 0
+        for image_src, windows in images_windows:
+            fname = image_src if isinstance(image_src, str) else None
+            for window in windows:
+                detections.append(
+                    {
+                        "window": window,
+                        "prediction": predictions[ix],
+                        "filename": fname,
+                    }
+                )
+                ix += 1
+        return detections
+
+    @staticmethod
+    def _load(src) -> np.ndarray:
+        if isinstance(src, str):
+            return cio.load_image(src).astype(np.float32)
+        return np.asarray(src, np.float32)
+
+    def crop(self, im: np.ndarray, window: np.ndarray) -> np.ndarray:
+        """Crop a window, optionally with scaled surrounding context and
+        mean padding where the context runs off the image
+        (detector.py:125-180)."""
+        window = np.asarray(window)
+        crop = im[window[0] : window[2], window[1] : window[3]]
+
+        if self.context_pad:
+            box = window.astype(float).copy()
+            crop_size = self.feed_shapes[self.inputs[0]][3]  # square input
+            scale = crop_size / (1.0 * crop_size - self.context_pad * 2)
+            half_h = (box[2] - box[0] + 1) / 2.0
+            half_w = (box[3] - box[1] + 1) / 2.0
+            center = (box[0] + half_h, box[1] + half_w)
+            scaled_dims = scale * np.array((-half_h, -half_w, half_h, half_w))
+            box = np.round(np.tile(center, 2) + scaled_dims)
+            full_h = box[2] - box[0] + 1
+            full_w = box[3] - box[1] + 1
+            scale_h = crop_size / full_h
+            scale_w = crop_size / full_w
+            pad_y = int(round(max(0.0, -box[0]) * scale_h))
+            pad_x = int(round(max(0.0, -box[1]) * scale_w))
+
+            im_h, im_w = im.shape[:2]
+            box = np.clip(box, 0.0, [im_h, im_w, im_h, im_w]).astype(int)
+            clip_h = box[2] - box[0] + 1
+            clip_w = box[3] - box[1] + 1
+            assert clip_h > 0 and clip_w > 0
+            crop_h = int(round(clip_h * scale_h))
+            crop_w = int(round(clip_w * scale_w))
+            crop_h = min(crop_h, crop_size - pad_y)
+            crop_w = min(crop_w, crop_size - pad_x)
+
+            context_crop = im[box[0] : box[2], box[1] : box[3]]
+            context_crop = cio.resize_image(context_crop, (crop_h, crop_w))
+            crop = np.ones(self.crop_dims, dtype=np.float32) * self.crop_mean
+            crop[pad_y : pad_y + crop_h, pad_x : pad_x + crop_w] = context_crop
+
+        return crop
+
+    def configure_crop(self, context_pad) -> None:
+        """Set crop dims in input-image space and the unprocessed-space mean
+        used for context padding (detector.py:181-211)."""
+        in_ = self.inputs[0]
+        tpose = self.transformer.transpose[in_]
+        inv_tpose = [tpose[t] for t in tpose]
+        self.crop_dims = np.array(self.feed_shapes[in_][1:])[inv_tpose]
+        self.context_pad = context_pad
+        if self.context_pad:
+            transpose = self.transformer.transpose.get(in_)
+            channel_order = self.transformer.channel_swap.get(in_)
+            raw_scale = self.transformer.raw_scale.get(in_)
+            mean = self.transformer.mean.get(in_)
+            if mean is not None:
+                inv_transpose = [transpose[t] for t in transpose]
+                crop_mean = mean.copy().transpose(inv_transpose)
+                if crop_mean.shape[:2] == (1, 1):  # broadcast channel mean
+                    crop_mean = np.broadcast_to(
+                        crop_mean, tuple(self.crop_dims)
+                    ).copy()
+                if channel_order is not None:
+                    channel_order_inverse = [
+                        channel_order.index(i) for i in range(crop_mean.shape[2])
+                    ]
+                    crop_mean = crop_mean[:, :, channel_order_inverse]
+                if raw_scale is not None:
+                    crop_mean /= raw_scale
+                self.crop_mean = crop_mean
+            else:
+                self.crop_mean = np.zeros(tuple(self.crop_dims), np.float32)

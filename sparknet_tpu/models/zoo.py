@@ -61,7 +61,7 @@ def lenet(batch: int = 64, num_classes: int = 10) -> Message:
         ReLULayer("relu1", ["ip1"], in_place=True),
         InnerProductLayer("ip2", ["ip1"], num_output=num_classes),
         SoftmaxWithLoss("loss", ["ip2", "label"]),
-        AccuracyLayer("accuracy", ["ip2", "label"]),
+        AccuracyLayer("accuracy", ["ip2", "label"], phase="TEST"),
     )
 
 
@@ -99,7 +99,7 @@ def cifar10_quick(batch: int = 100, num_classes: int = 10) -> Message:
         InnerProductLayer("ip2", ["ip1"], num_output=num_classes,
                           weight_filler=_gauss(0.1)),
         SoftmaxWithLoss("loss", ["ip2", "label"]),
-        AccuracyLayer("accuracy", ["ip2", "label"]),
+        AccuracyLayer("accuracy", ["ip2", "label"], phase="TEST"),
     )
 
 
@@ -141,7 +141,7 @@ def cifar10_full(batch: int = 100, num_classes: int = 10) -> Message:
         InnerProductLayer("ip1", ["pool3"], num_output=num_classes,
                           weight_filler=_gauss(0.01)),
         SoftmaxWithLoss("loss", ["ip1", "label"]),
-        AccuracyLayer("accuracy", ["ip1", "label"]),
+        AccuracyLayer("accuracy", ["ip1", "label"], phase="TEST"),
     )
 
 
@@ -171,7 +171,7 @@ def _alex_tail(fc6_bottom: str, num_classes: int) -> list[Message]:
         InnerProductLayer("fc8", ["fc7"], num_output=num_classes,
                           weight_filler=_gauss(0.01)),
         SoftmaxWithLoss("loss", ["fc8", "label"]),
-        AccuracyLayer("accuracy", ["fc8", "label"]),
+        AccuracyLayer("accuracy", ["fc8", "label"], phase="TEST"),
     ]
 
 
@@ -348,8 +348,8 @@ def googlenet(batch: int = 32, num_classes: int = 1000, crop: int = 224) -> Mess
                           num_output=num_classes, weight_filler=w(),
                           bias_filler=_const(0.0)),
         SoftmaxWithLoss("loss3/loss3", ["loss3/classifier", "label"]),
-        AccuracyLayer("loss3/top-1", ["loss3/classifier", "label"]),
-        AccuracyLayer("loss3/top-5", ["loss3/classifier", "label"], top_k=5),
+        AccuracyLayer("loss3/top-1", ["loss3/classifier", "label"], phase="TEST"),
+        AccuracyLayer("loss3/top-5", ["loss3/classifier", "label"], top_k=5, phase="TEST"),
     ]
     return NetParam("GoogleNet", *layers)
 

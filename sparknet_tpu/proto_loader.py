@@ -57,15 +57,15 @@ def replace_data_layers(
 
     def _phase_tops(phase: str) -> list[str]:
         """Top names of the data layers active in ``phase`` (so surgery
-        preserves nonstandard names like the siamese pair_data/sim)."""
+        preserves nonstandard names like the siamese pair_data/sim).
+        Phase selection delegates to the compiler's NetStateRule matcher so
+        include/exclude/stage semantics can't diverge (ref: Net::FilterNet)."""
+        from sparknet_tpu.common import Phase
+        from sparknet_tpu.compiler.graph import filter_phase
+
         tops: list[str] = []
-        for lp in net_param.get_all("layer") or net_param.get_all("layers"):
+        for lp in filter_phase(net_param, Phase[phase]):
             if lp.get_str("type") not in _DATA_LAYER_TYPES:
-                continue
-            includes = lp.get_all("include")
-            if includes and not any(
-                r.get_str("phase", phase) == phase for r in includes
-            ):
                 continue
             for t in lp.get_all("top"):
                 if str(t) not in tops:

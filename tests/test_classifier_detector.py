@@ -1,0 +1,121 @@
+"""Classifier / Detector model-usage parity (ref: caffe/python/caffe/
+classifier.py, detector.py; exercised like pycaffe's test_net usage)."""
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.models.classifier import Classifier
+from sparknet_tpu.models.detector import Detector
+from sparknet_tpu.proto import parse
+
+DEPLOY = """
+name: "tiny_deploy"
+input: "data"
+input_dim: 4 input_dim: 3 input_dim: 8 input_dim: 8
+layer {
+  name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 4 kernel_size: 3 stride: 1 pad: 1
+    weight_filler { type: "gaussian" std: 0.1 } }
+}
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer {
+  name: "ip1" type: "InnerProduct" bottom: "conv1" top: "ip1"
+  inner_product_param { num_output: 5
+    weight_filler { type: "gaussian" std: 0.1 } }
+}
+layer { name: "prob" type: "Softmax" bottom: "ip1" top: "prob" }
+"""
+
+
+@pytest.fixture(scope="module")
+def deploy_param():
+    return parse(DEPLOY)
+
+
+class TestClassifier:
+    def test_predict_center_crop(self, deploy_param, rng):
+        clf = Classifier(deploy_param, image_dims=(12, 12))
+        images = [rng.rand(20, 24, 3).astype(np.float32) for _ in range(3)]
+        preds = clf.predict(images, oversample=False)
+        assert preds.shape == (3, 5)
+        assert np.allclose(preds.sum(1), 1.0, atol=1e-4)  # softmax rows
+
+    def test_predict_oversample_averages_ten_crops(self, deploy_param, rng):
+        clf = Classifier(deploy_param, image_dims=(12, 12))
+        images = [rng.rand(16, 16, 3).astype(np.float32) for _ in range(2)]
+        preds = clf.predict(images, oversample=True)
+        assert preds.shape == (2, 5)
+        assert np.allclose(preds.sum(1), 1.0, atol=1e-4)
+
+    def test_batching_beyond_net_batch(self, deploy_param, rng):
+        # net batch is 4; 7 images * 10 crops = 70 samples run in chunks
+        clf = Classifier(deploy_param)
+        images = [rng.rand(8, 8, 3).astype(np.float32) for _ in range(7)]
+        preds = clf.predict(images, oversample=True)
+        assert preds.shape == (7, 5)
+
+    def test_deterministic_per_image(self, deploy_param, rng):
+        clf = Classifier(deploy_param)
+        im = rng.rand(8, 8, 3).astype(np.float32)
+        a = clf.predict([im], oversample=False)
+        b = clf.predict([im, im], oversample=False)
+        assert np.allclose(a[0], b[0], atol=1e-5)
+        assert np.allclose(b[0], b[1], atol=1e-5)
+
+    def test_transformer_options_applied(self, deploy_param, rng):
+        mean = np.array([0.2, 0.3, 0.4], np.float32)
+        clf = Classifier(
+            deploy_param, mean=mean, raw_scale=255.0, channel_swap=(2, 1, 0)
+        )
+        im = rng.rand(8, 8, 3).astype(np.float32)
+        preds = clf.predict([im], oversample=False)
+        base = Classifier(deploy_param).predict([im], oversample=False)
+        assert preds.shape == base.shape
+        assert not np.allclose(preds, base)  # preprocessing changed the input
+
+
+class TestDetector:
+    def test_detect_windows_plain(self, deploy_param, rng):
+        det = Detector(deploy_param)
+        im = rng.rand(32, 40, 3).astype(np.float32)
+        windows = [(0, 0, 16, 16), (8, 10, 30, 38)]
+        dets = det.detect_windows([(im, windows)])
+        assert len(dets) == 2
+        for d, w in zip(dets, windows):
+            assert d["prediction"].shape == (5,)
+            assert tuple(d["window"]) == w
+            assert d["filename"] is None
+
+    def test_detect_windows_context_pad(self, deploy_param, rng):
+        det = Detector(
+            deploy_param,
+            mean=np.array([0.5, 0.5, 0.5], np.float32),
+            context_pad=2,
+        )
+        im = rng.rand(32, 40, 3).astype(np.float32)
+        # window touching the image border: context must be mean-padded
+        dets = det.detect_windows([(im, [(0, 0, 10, 10), (20, 28, 32, 40)])])
+        assert len(dets) == 2
+        assert all(np.isfinite(d["prediction"]).all() for d in dets)
+
+    def test_crop_without_context_is_plain_slice(self, deploy_param, rng):
+        det = Detector(deploy_param)
+        im = rng.rand(20, 20, 3).astype(np.float32)
+        w = np.array([2, 3, 10, 12])
+        assert np.allclose(det.crop(im, w), im[2:10, 3:12])
+
+    def test_crop_with_context_is_input_sized(self, deploy_param, rng):
+        det = Detector(deploy_param, context_pad=1)
+        im = rng.rand(20, 20, 3).astype(np.float32)
+        crop = det.crop(im, np.array([4, 4, 12, 12]))
+        assert crop.shape == tuple(det.crop_dims)
+
+    def test_filename_input(self, deploy_param, rng, tmp_path):
+        from PIL import Image
+
+        arr = (rng.rand(24, 24, 3) * 255).astype(np.uint8)
+        p = str(tmp_path / "im.png")
+        Image.fromarray(arr).save(p)
+        det = Detector(deploy_param)
+        dets = det.detect_windows([(p, [(0, 0, 12, 12)])])
+        assert dets[0]["filename"] == p

@@ -1,0 +1,72 @@
+"""Net -> DOT visualization (ref: caffe/python/caffe/draw.py +
+python/draw_net.py)."""
+
+import os
+
+import pytest
+
+from sparknet_tpu import models
+from sparknet_tpu.proto import parse
+from sparknet_tpu.utils.draw import draw_net_to_file, get_edge_label, get_layer_label, net_to_dot
+
+REF = "/root/reference/caffe"
+needs_ref = pytest.mark.skipif(not os.path.isdir(REF), reason="no reference tree")
+
+
+def test_layer_labels():
+    conv = parse(
+        'name: "c1" type: "Convolution" '
+        "convolution_param { num_output: 8 kernel_size: 5 stride: 2 pad: 1 }"
+    )
+    lab = get_layer_label(conv, "LR")
+    assert "c1" in lab and "kernel size: 5" in lab and "stride: 2" in lab
+    assert get_edge_label(conv) == "8"
+    pool = parse('name: "p1" type: "Pooling" pooling_param { pool: AVE kernel_size: 3 }')
+    assert "AVE" in get_layer_label(pool, "TB")
+    ip = parse('name: "ip" type: "InnerProduct" inner_product_param { num_output: 10 }')
+    assert get_edge_label(ip) == "10"
+
+
+def test_lenet_dot_structure():
+    dot = net_to_dot(models.lenet(8))
+    assert dot.startswith("digraph")
+    assert dot.rstrip().endswith("}")
+    # layer nodes with colors, blob octagons, and edges all present
+    assert '"layer_conv1"' in dot and "#FF5050" in dot
+    assert '"blob_data"' in dot and "octagon" in dot
+    assert '"blob_data" -> "layer_conv1"' in dot
+    # in-place ReLU folds onto its blob: no relu blob self-edge
+    assert '"layer_relu1" -> "blob_' not in dot
+
+
+def test_phase_filter_drops_test_only_layers():
+    net = models.lenet(8)
+    full = net_to_dot(net)
+    train = net_to_dot(net, phase="TRAIN")
+    assert "accuracy" in full.lower()
+    assert "accuracy" not in train.lower()
+
+
+def test_draw_net_to_file(tmp_path):
+    p = str(tmp_path / "net.dot")
+    draw_net_to_file(models.cifar10_quick(4), p, rankdir="TB")
+    src = open(p).read()
+    assert "rankdir=TB" in src and src.count("->") > 10
+
+
+@needs_ref
+def test_googlenet_from_reference_prototxt():
+    from sparknet_tpu.proto import parse_file
+
+    npz = parse_file(f"{REF}/models/bvlc_googlenet/train_val.prototxt")
+    dot = net_to_dot(npz)
+    # 166-layer prototxt: every layer node must appear
+    assert dot.count("shape=box") == len(npz.get_all("layer"))
+
+
+def test_cli_draw(tmp_path, capsys):
+    from sparknet_tpu.cli import main
+
+    out = str(tmp_path / "z.dot")
+    assert main(["draw", "--net", "zoo:lenet", "--out", out, "--batch", "4"]) == 0
+    assert "digraph" in open(out).read()

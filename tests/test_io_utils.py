@@ -1,0 +1,137 @@
+"""pycaffe ``caffe.io`` surface parity (ref: caffe/python/caffe/io.py;
+roundtrip style mirrors caffe/python/caffe/test/test_io.py)."""
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.data import io_utils as cio
+from sparknet_tpu.data.transform import load_mean_file
+
+
+class TestBlobProto:
+    def test_roundtrip(self, rng):
+        a = rng.randn(2, 3, 4, 5).astype(np.float32)
+        out = cio.blobproto_to_array(cio.array_to_blobproto(a))
+        assert out.shape == a.shape
+        assert np.allclose(out, a)
+
+    def test_scalar_and_1d(self, rng):
+        v = rng.randn(7).astype(np.float32)
+        assert np.allclose(cio.blobproto_to_array(cio.array_to_blobproto(v)), v)
+
+    def test_mean_binaryproto_file(self, rng, tmp_path):
+        mean = rng.rand(3, 8, 8).astype(np.float32) * 255
+        path = str(tmp_path / "mean.binaryproto")
+        cio.save_mean_binaryproto(path, mean)
+        back = cio.load_mean_binaryproto(path)
+        assert back.shape == (3, 8, 8)
+        assert np.allclose(back, mean)
+        # load_mean_file dispatches on extension
+        assert np.allclose(load_mean_file(path), mean)
+        npy = str(tmp_path / "mean.npy")
+        np.save(npy, mean)
+        assert np.allclose(load_mean_file(npy), mean)
+
+
+class TestDatum:
+    def test_uint8_roundtrip(self, rng):
+        arr = (rng.rand(3, 10, 10) * 255).astype(np.uint8)
+        buf = cio.array_to_datum(arr, label=42)
+        back, label = cio.datum_to_array(buf)
+        assert label == 42
+        assert back.dtype == np.uint8
+        assert (back == arr).all()
+
+    def test_float_roundtrip(self, rng):
+        arr = rng.randn(1, 4, 6).astype(np.float32)
+        back, label = cio.datum_to_array(cio.array_to_datum(arr, label=0))
+        assert label == 0
+        assert np.allclose(back, arr)
+
+    def test_bad_rank_rejected(self):
+        with pytest.raises(ValueError):
+            cio.array_to_datum(np.zeros((4, 4)))
+
+
+class TestImageOps:
+    def test_resize_shapes_and_range(self, rng):
+        im = rng.rand(16, 20, 3).astype(np.float32)
+        out = cio.resize_image(im, (8, 10))
+        assert out.shape == (8, 10, 3)
+        assert out.min() >= im.min() - 1e-5 and out.max() <= im.max() + 1e-5
+
+    def test_resize_constant_image(self):
+        im = np.full((5, 5, 1), 3.25, np.float32)
+        out = cio.resize_image(im, (9, 7))
+        assert out.shape == (9, 7, 1)
+        assert (out == 3.25).all()
+
+    def test_resize_nearest(self):
+        im = np.arange(4, dtype=np.float32).reshape(2, 2, 1)
+        out = cio.resize_image(im, (4, 4), interp_order=0)
+        # nearest keeps only original values
+        assert set(np.unique(out)) <= set(im.ravel().tolist())
+
+    def test_oversample_ten_crops(self, rng):
+        im = rng.rand(12, 14, 3).astype(np.float32)
+        crops = cio.oversample([im, im], (8, 8))
+        assert crops.shape == (20, 8, 8, 3)
+        # crop 0 is the top-left corner; crop 5 is its horizontal mirror
+        assert np.allclose(crops[0], im[:8, :8])
+        assert np.allclose(crops[5], crops[0][:, ::-1, :])
+        # crop 4 is the center crop
+        assert np.allclose(crops[4], im[2:10, 3:11])
+
+    def test_load_image_color_and_gray(self, tmp_path, rng):
+        from PIL import Image
+
+        arr = (rng.rand(6, 5, 3) * 255).astype(np.uint8)
+        p = str(tmp_path / "x.png")
+        Image.fromarray(arr).save(p)
+        im = cio.load_image(p)
+        assert im.shape == (6, 5, 3) and im.dtype == np.float32
+        assert np.allclose(im, arr / 255.0, atol=1e-6)
+        gray = cio.load_image(p, color=False)
+        assert gray.shape == (6, 5, 1)
+
+
+class TestTransformer:
+    def make(self):
+        t = cio.Transformer({"data": (1, 3, 8, 10)})
+        t.set_transpose("data", (2, 0, 1))
+        t.set_channel_swap("data", (2, 1, 0))
+        t.set_raw_scale("data", 255.0)
+        t.set_mean("data", np.array([104.0, 117.0, 123.0], np.float32))
+        t.set_input_scale("data", 0.5)
+        return t
+
+    def test_preprocess_shape_and_inverse(self, rng):
+        t = self.make()
+        im = rng.rand(16, 20, 3).astype(np.float32)
+        blob = t.preprocess("data", im)
+        assert blob.shape == (3, 8, 10)
+        # deprocess inverts everything but the resize
+        resized = cio.resize_image(im, (8, 10))
+        assert np.allclose(t.deprocess("data", blob), resized, atol=1e-4)
+
+    def test_preprocess_order_matches_reference(self):
+        # input_scale applies AFTER mean; raw_scale BEFORE (io.py:261-276)
+        t = cio.Transformer({"data": (1, 1, 2, 2)})
+        t.set_raw_scale("data", 10.0)
+        t.set_mean("data", np.array([1.0], np.float32))
+        t.set_input_scale("data", 2.0)
+        im = np.ones((2, 2, 1), np.float32)
+        t.set_transpose("data", (2, 0, 1))
+        out = t.preprocess("data", im)
+        assert np.allclose(out, (1 * 10 - 1) * 2)
+
+    def test_validation(self):
+        t = cio.Transformer({"data": (1, 3, 8, 10)})
+        with pytest.raises(ValueError):
+            t.preprocess("nope", np.zeros((8, 10, 3)))
+        with pytest.raises(ValueError):
+            t.set_transpose("data", (0, 1))
+        with pytest.raises(ValueError):
+            t.set_channel_swap("data", (0, 1))
+        with pytest.raises(ValueError):
+            t.set_mean("data", np.zeros(4, np.float32))

@@ -321,6 +321,64 @@ def test_shared_param_mismatched_shape_rejected():
         net.init(jax.random.PRNGKey(0))
 
 
+def test_shared_param_on_paramless_layer_rejected():
+    """A param{name} alias on a layer position that never materializes a
+    blob must raise, not silently train unshared (Caffe CHECK-fails in
+    AppendParam, ref: net.cpp:470+)."""
+    from sparknet_tpu.proto.text_format import Message
+
+    def named(m, name):
+        m.add("param", Message().set("name", name))
+        return m
+
+    from sparknet_tpu.layers_dsl import (
+        ConvolutionLayer as Conv, NetParam, PoolingLayer, Pooling, RDDLayer,
+        SoftmaxWithLoss,
+    )
+
+    m = NetParam(
+        "bad2",
+        RDDLayer("data", shape=[2, 1, 8, 8]),
+        RDDLayer("label", shape=[2]),
+        named(Conv("c1", ["data"], kernel=(3, 3), num_output=4), "w"),
+        named(PoolingLayer("p1", ["c1"], Pooling.Max, kernel=(2, 2)), "w"),
+        SoftmaxWithLoss("loss", ["p1", "label"]),
+    )
+    net = Network(m, Phase.TRAIN)
+    with pytest.raises(ValueError, match="param name 'w'.*'p1'"):
+        net.init(jax.random.PRNGKey(0))
+
+
+def test_replace_data_layers_honors_exclude_rules():
+    """Data-layer surgery must use full NetStateRule semantics: a layer with
+    `exclude { phase: TEST }` is TRAIN-only (ref: Net::FilterNet)."""
+    from sparknet_tpu.proto import parse
+    from sparknet_tpu.proto_loader import replace_data_layers
+
+    npz = parse(
+        """
+        name: "x"
+        layer { name: "d_tr" type: "Data" top: "data" top: "label"
+                exclude { phase: TEST } }
+        layer { name: "d_te" type: "Data" top: "tdata" top: "tlabel"
+                include { phase: TEST } }
+        layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+                inner_product_param { num_output: 2 } }
+        """
+    )
+    out = replace_data_layers(npz, 4, 2, 1, 8, 8)
+    rdd = [
+        (l.get_str("name"), [str(t) for t in l.get_all("top")])
+        for l in out.get_all("layer")
+        if l.get_str("type") == "JavaData"
+    ]
+    by_name = dict(rdd)
+    assert by_name["data_train"] == ["data"]
+    assert by_name["tdata_test"] == ["tdata"]
+    # the excluded-from-TEST tops must NOT appear as TEST feed layers
+    assert "data_test" not in by_name
+
+
 def test_siamese_bias_lr_mult_matches_reference():
     """Biases train at lr_mult=2 like the reference siamese prototxt."""
     net = Network(models.mnist_siamese(2), Phase.TRAIN)

@@ -52,7 +52,13 @@ def test_time_layers_lenet():
     conv = next(r for r in rows if r["layer"] == "conv1")
     assert conv["forward_ms"] > 0
     assert conv["backward_ms"] is not None and conv["backward_ms"] > 0
-    acc = next(r for r in rows if r["layer"] == "accuracy")
+    # accuracy is TEST-only (include { phase: TEST }, like the reference
+    # prototxts) — absent from the TRAIN table, forward-only in the TEST one
+    assert "accuracy" not in names
+    test_rows = time_layers(
+        Network(models.lenet(2), Phase.TEST), variables, feeds, iterations=1
+    )
+    acc = next(r for r in test_rows if r["layer"] == "accuracy")
     assert acc["forward_ms"] > 0  # non-differentiable: forward only
 
 
