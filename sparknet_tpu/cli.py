@@ -342,7 +342,9 @@ def cmd_draw(args) -> int:
     if args.net.startswith("zoo:"):
         net_param = getattr(models, args.net[4:])(args.batch or 100)
     else:
-        net_param = parse_file(args.net)
+        from sparknet_tpu.proto.upgrade import upgrade_net
+
+        net_param = upgrade_net(parse_file(args.net))
     draw_net_to_file(
         net_param,
         args.out,
@@ -350,6 +352,32 @@ def cmd_draw(args) -> int:
         phase=args.phase or None,
     )
     print(json.dumps({"out": args.out, "rankdir": args.rankdir}))
+    return 0
+
+
+def cmd_upgrade_net_proto_text(args) -> int:
+    """Legacy V0/V1 net prototxt -> current schema (ref:
+    caffe/tools/upgrade_net_proto_text.cpp)."""
+    from sparknet_tpu.proto.text_format import parse_file, serialize
+    from sparknet_tpu.proto.upgrade import upgrade_net
+
+    upgraded = upgrade_net(parse_file(args.input))
+    with open(args.output, "w") as f:
+        f.write(serialize(upgraded) + "\n")
+    print(json.dumps({"out": args.output, "layers": len(upgraded.get_all("layer"))}))
+    return 0
+
+
+def cmd_upgrade_solver_proto_text(args) -> int:
+    """Deprecated solver_type enum -> type string (ref:
+    caffe/tools/upgrade_solver_proto_text.cpp)."""
+    from sparknet_tpu.proto.text_format import parse_file, serialize
+    from sparknet_tpu.proto.upgrade import upgrade_solver
+
+    upgraded = upgrade_solver(parse_file(args.input))
+    with open(args.output, "w") as f:
+        f.write(serialize(upgraded) + "\n")
+    print(json.dumps({"out": args.output, "type": upgraded.get_str("type", "SGD")}))
     return 0
 
 
@@ -434,6 +462,15 @@ def main(argv=None) -> int:
     sp.add_argument("--phase", default="", help="filter by TRAIN/TEST")
     sp.add_argument("--batch", type=int, default=0, help="zoo batch override")
     sp.set_defaults(fn=cmd_draw)
+
+    for cmd, fn in (
+        ("upgrade_net_proto_text", cmd_upgrade_net_proto_text),
+        ("upgrade_solver_proto_text", cmd_upgrade_solver_proto_text),
+    ):
+        sp = sub.add_parser(cmd, help="migrate a legacy prototxt")
+        sp.add_argument("input")
+        sp.add_argument("output")
+        sp.set_defaults(fn=fn)
 
     sp = sub.add_parser("device_query", help="show devices")
     sp.set_defaults(fn=cmd_device_query)

@@ -117,6 +117,9 @@ class Network:
         phase: Phase = Phase.TRAIN,
         batch_override: int | None = None,
     ):
+        from sparknet_tpu.proto.upgrade import upgrade_net
+
+        net_param = upgrade_net(net_param)
         self.net_param = net_param
         self.phase = phase
         self.name = net_param.get_str("name", "net")

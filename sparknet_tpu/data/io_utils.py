@@ -139,7 +139,8 @@ def datum_to_array(buf: bytes) -> tuple[np.ndarray, int]:
         elif field == _DATUM_DATA and wt == _LEN:
             raw = val
         elif field == _DATUM_LABEL and wt == _VARINT:
-            label = val
+            # negative int32 arrives as a 64-bit two's-complement varint
+            label = val - (1 << 64) if val >= (1 << 63) else val
         elif field == _DATUM_FLOAT:
             if wt == _LEN:
                 floats.append(np.frombuffer(val, "<f4"))

@@ -12,12 +12,14 @@ reference's equivalent host-side batching).
 
 from __future__ import annotations
 
+import jax
 import numpy as np
 
+from sparknet_tpu.common import Phase
+from sparknet_tpu.compiler.graph import Network, NetVars
 from sparknet_tpu.data import io_utils as cio
-from sparknet_tpu.net import TPUNet
+from sparknet_tpu.net import copy_caffemodel_params, copy_hdf5_params
 from sparknet_tpu.proto.text_format import Message
-from sparknet_tpu.solvers.solver import SolverConfig
 
 
 class DeployNet:
@@ -26,6 +28,9 @@ class DeployNet:
     Parameters mirror the pycaffe classes: ``model_file`` is a deploy
     prototxt path or an already-parsed ``NetParameter`` Message;
     ``pretrained_file`` is a ``.caffemodel`` (or ``.h5``/HDF5) weights file.
+    Only the TEST-phase graph is compiled and only the params pytree is
+    held — no TRAIN graph and no optimizer slots (a deploy-scale model
+    would otherwise double its weight memory for state it never uses).
     """
 
     def __init__(
@@ -43,18 +48,27 @@ class DeployNet:
             from sparknet_tpu.proto_loader import load_net_prototxt
 
             net_param = load_net_prototxt(model_file)
-        self.net = TPUNet(SolverConfig(), net_param)
+        self.network = Network(net_param, Phase.TEST)
+        self.variables = self.network.init(jax.random.key(0))
         if pretrained_file is not None:
             if pretrained_file.endswith((".h5", ".hdf5", ".caffemodel.h5")):
-                self.net.load_hdf5(pretrained_file)
+                params, _ = copy_hdf5_params(self.variables.params, pretrained_file)
             else:
-                self.net.load_caffemodel(pretrained_file)
+                params, _ = copy_caffemodel_params(
+                    self.variables.params, pretrained_file
+                )
+            self.variables = NetVars(params=params, state=self.variables.state)
+        self._forward = jax.jit(
+            lambda variables, feeds: self.network.apply(
+                variables, feeds, rng=None, train=False
+            )[0]
+        )
 
-        shapes = self.net.test_net.feed_shapes()
+        shapes = self.network.feed_shapes()
         # data inputs only — a deploy net has no label feed, but a net built
         # from a train prototxt may; keep 4-D image feeds
         self.inputs = [n for n, s in shapes.items() if len(s) == 4] or list(shapes)
-        self.outputs = self.net.test_net.output_blobs()
+        self.outputs = self.network.output_blobs()
         self.feed_shapes = shapes
 
         in_ = self.inputs[0]
@@ -83,7 +97,7 @@ class DeployNet:
             if len(chunk) < batch:  # pad the ragged tail; trimmed below
                 pad = np.zeros((batch - len(chunk),) + chunk.shape[1:], chunk.dtype)
                 chunk = np.concatenate([chunk, pad])
-            blobs = self.net.forward({in_: chunk})
+            blobs = self._forward(self.variables, {in_: chunk})
             for o in self.outputs:
                 outs[o].append(np.asarray(blobs[o]))
         return {o: np.concatenate(v)[:n] for o, v in outs.items()}

@@ -85,6 +85,86 @@ def collection_to_variables(wc: WeightCollection, template: NetVars) -> NetVars:
     return NetVars(params=params, state=state)
 
 
+def copy_caffemodel_params(
+    params: dict[str, list], path: str, strict_shapes: bool = True
+) -> tuple[dict[str, list], list[str]]:
+    """Copy a .caffemodel's blobs into a params pytree by layer name
+    (CopyTrainedLayersFrom semantics, ref: net.cpp:737-805).  Returns
+    (new params, loaded layer names); source layers absent from the net
+    are ignored."""
+    from sparknet_tpu.proto.binary import load_caffemodel
+
+    model = load_caffemodel(path)
+    params = {k: list(v) for k, v in params.items()}
+    loaded = []
+    for layer in model.layers:
+        if layer.name not in params or not layer.blobs:
+            continue
+        target = params[layer.name]
+        if len(layer.blobs) != len(target):
+            raise ValueError(
+                f"layer {layer.name!r}: snapshot has {len(layer.blobs)} "
+                f"blobs, net expects {len(target)}"
+            )
+        new = []
+        ok = True
+        for src, dst in zip(layer.blobs, target):
+            if dst.size == 0:
+                # shared-param alias placeholder: the real array lives
+                # at the owner layer (Caffe files duplicate shared
+                # blobs per layer; the owner's copy wins)
+                new.append(dst)
+                continue
+            if tuple(src.shape) != tuple(dst.shape):
+                if np.prod(src.shape) == np.prod(dst.shape):
+                    # Caffe reshapes legacy 4D fc blobs (1,1,N,K)->(N,K)
+                    src = src.reshape(dst.shape)
+                elif strict_shapes:
+                    raise ValueError(
+                        f"layer {layer.name!r}: blob shape {src.shape} "
+                        f"!= net {tuple(dst.shape)}"
+                    )
+                else:  # PERMISSIVE: skip the incompatible layer
+                    ok = False
+                    break
+            new.append(jnp.asarray(src, dst.dtype))
+        if not ok:
+            continue
+        params[layer.name] = new
+        loaded.append(layer.name)
+    return params, loaded
+
+
+def copy_hdf5_params(
+    params: dict[str, list], path: str
+) -> tuple[dict[str, list], list[str]]:
+    """HDF5 variant of :func:`copy_caffemodel_params` (Caffe's
+    ``data/<layer>/<i>`` group layout, ref: net.cpp:926+)."""
+    import h5py
+
+    params = {k: list(v) for k, v in params.items()}
+    loaded = []
+    with h5py.File(path, "r") as f:
+        for lname in f["data"]:
+            if lname not in params:
+                continue
+            g = f["data"][lname]
+            target = params[lname]
+            arrs = [np.asarray(g[str(i)]) for i in range(len(g))]
+            if len(arrs) != len(target):
+                raise ValueError(
+                    f"layer {lname!r}: snapshot has {len(arrs)} blobs, "
+                    f"net expects {len(target)}"
+                )
+            params[lname] = [
+                # zero-size placeholder = shared alias; owner's copy wins
+                p if p.size == 0 else jnp.asarray(a.reshape(p.shape), p.dtype)
+                for a, p in zip(arrs, target)
+            ]
+            loaded.append(lname)
+    return params, loaded
+
+
 class TPUNet:
     """The CaffeNet-equivalent handle (ref: Net.scala:67-250): owns the
     compiled train/test programs, the solver state, and the data hookups."""
@@ -194,46 +274,9 @@ class TPUNet:
         """Copy params by layer name (CopyTrainedLayersFrom semantics,
         ref: net.cpp:737-805): source layers absent from this net are
         ignored; blob-shape mismatch raises.  Returns loaded layer names."""
-        from sparknet_tpu.proto.binary import load_caffemodel
-
-        model = load_caffemodel(path)
-        params = {k: list(v) for k, v in self.solver.variables.params.items()}
-        loaded = []
-        for layer in model.layers:
-            if layer.name not in params or not layer.blobs:
-                continue
-            target = params[layer.name]
-            if len(layer.blobs) != len(target):
-                raise ValueError(
-                    f"layer {layer.name!r}: snapshot has {len(layer.blobs)} "
-                    f"blobs, net expects {len(target)}"
-                )
-            new = []
-            ok = True
-            for src, dst in zip(layer.blobs, target):
-                if dst.size == 0:
-                    # shared-param alias placeholder: the real array lives
-                    # at the owner layer (Caffe files duplicate shared
-                    # blobs per layer; the owner's copy wins)
-                    new.append(dst)
-                    continue
-                if tuple(src.shape) != tuple(dst.shape):
-                    if np.prod(src.shape) == np.prod(dst.shape):
-                        # Caffe reshapes legacy 4D fc blobs (1,1,N,K)->(N,K)
-                        src = src.reshape(dst.shape)
-                    elif strict_shapes:
-                        raise ValueError(
-                            f"layer {layer.name!r}: blob shape {src.shape} "
-                            f"!= net {tuple(dst.shape)}"
-                        )
-                    else:  # PERMISSIVE: skip the incompatible layer
-                        ok = False
-                        break
-                new.append(jnp.asarray(src, dst.dtype))
-            if not ok:
-                continue
-            params[layer.name] = new
-            loaded.append(layer.name)
+        params, loaded = copy_caffemodel_params(
+            self.solver.variables.params, path, strict_shapes
+        )
         self.solver.variables = NetVars(
             params=params, state=self.solver.variables.state
         )
@@ -261,28 +304,7 @@ class TPUNet:
 
     def load_hdf5(self, path: str) -> list[str]:
         """Copy-by-layer-name with the same semantics as load_caffemodel."""
-        import h5py
-
-        params = {k: list(v) for k, v in self.solver.variables.params.items()}
-        loaded = []
-        with h5py.File(path, "r") as f:
-            for lname in f["data"]:
-                if lname not in params:
-                    continue
-                g = f["data"][lname]
-                target = params[lname]
-                arrs = [np.asarray(g[str(i)]) for i in range(len(g))]
-                if len(arrs) != len(target):
-                    raise ValueError(
-                        f"layer {lname!r}: snapshot has {len(arrs)} blobs, "
-                        f"net expects {len(target)}"
-                    )
-                params[lname] = [
-                    # zero-size placeholder = shared alias; owner's copy wins
-                    p if p.size == 0 else jnp.asarray(a.reshape(p.shape), p.dtype)
-                    for a, p in zip(arrs, target)
-                ]
-                loaded.append(lname)
+        params, loaded = copy_hdf5_params(self.solver.variables.params, path)
         self.solver.variables = NetVars(
             params=params, state=self.solver.variables.state
         )

@@ -179,6 +179,10 @@ def load_caffemodel(path: str) -> CaffeModel:
 
 # ---------------------------------------------------------------- writing
 def _varint(v: int) -> bytes:
+    if v < 0:
+        # proto2 negative int32/int64 varints are the two's-complement
+        # 64-bit value (10 bytes on the wire)
+        v &= 0xFFFFFFFFFFFFFFFF
     out = bytearray()
     while True:
         b = v & 0x7F

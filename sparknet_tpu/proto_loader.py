@@ -23,8 +23,12 @@ _DATA_LAYER_TYPES = {
 
 
 def load_net_prototxt(path: str) -> Message:
-    """ref: ProtoLoader.loadNetPrototxt (:9-16)."""
-    return parse_file(path)
+    """ref: ProtoLoader.loadNetPrototxt (:9-16); legacy V0/V1 schemas are
+    migrated on load (ref: ReadNetParamsFromTextFileOrDie ->
+    UpgradeNetAsNeeded, upgrade_proto.cpp:59-105)."""
+    from sparknet_tpu.proto.upgrade import upgrade_net
+
+    return upgrade_net(parse_file(path))
 
 
 def load_solver_prototxt_with_net(path: str, net_param: Message) -> Message:

@@ -92,35 +92,41 @@ def net_to_dot(
         '  node [fontsize=10, height=0.2, width=0.2];',
     ]
     blob_nodes: set[str] = set()
+    # in-place layers (top == bottom) emit no box: their name/type fold into
+    # the blob's label (ref draw.py:143-151)
+    blob_annotations: dict[str, list[str]] = {}
     edges: list[str] = []
 
     for layer in layers:
         name = layer.get_str("name")
         ltype = layer.get_str("type")
+        bottoms = [str(b) for b in layer.get_all("bottom")]
+        tops = [str(t) for t in layer.get_all("top")]
+        if len(tops) == 1 and tops == bottoms:
+            blob_nodes.add(tops[0])
+            blob_annotations.setdefault(tops[0], []).append(f"{name} ({ltype})")
+            continue
         node = f"layer_{name}"
         color = _COLORS.get(ltype, _DEFAULT_COLOR)
         lines.append(
             f"  {_q(node)} [label={_q(get_layer_label(layer, rankdir))}, "
             f'shape=box, style=filled, fillcolor="{color}"];'
         )
-        bottoms = [str(b) for b in layer.get_all("bottom")]
-        tops = [str(t) for t in layer.get_all("top")]
         for b in bottoms:
             blob_nodes.add(b)
             edges.append(f"  {_q('blob_' + b)} -> {_q(node)};")
         for t in tops:
             if t in bottoms:
-                # in-place op: annotate the existing blob, no new node
-                # (ref draw.py:143-151 folds in-place layers)
-                continue
+                continue  # multi-top partial in-place: keep the box, no self-edge
             blob_nodes.add(t)
             lab = get_edge_label(layer) if label_edges else ""
             attr = f" [label={_q(lab)}]" if lab else ""
             edges.append(f"  {_q(node)} -> {_q('blob_' + t)}{attr};")
 
     for b in sorted(blob_nodes):
+        label = "\\n".join([b] + blob_annotations.get(b, []))
         lines.append(
-            f"  {_q('blob_' + b)} [label={_q(b)}, shape=octagon, "
+            f"  {_q('blob_' + b)} [label={_q(label)}, shape=octagon, "
             'style=filled, fillcolor="#E0E0E0"];'
         )
     lines.extend(edges)

@@ -60,8 +60,16 @@ def test_googlenet_from_reference_prototxt():
 
     npz = parse_file(f"{REF}/models/bvlc_googlenet/train_val.prototxt")
     dot = net_to_dot(npz)
-    # 166-layer prototxt: every layer node must appear
-    assert dot.count("shape=box") == len(npz.get_all("layer"))
+    # 166-layer prototxt: every non-in-place layer gets a box; in-place ones
+    # (ReLU/Dropout, single top == bottom) fold into their blob's label
+    layers = npz.get_all("layer")
+    inplace = sum(
+        1 for l in layers
+        if [str(t) for t in l.get_all("top")] == [str(b) for b in l.get_all("bottom")]
+        and len(l.get_all("top")) == 1
+    )
+    assert inplace > 0
+    assert dot.count("shape=box") == len(layers) - inplace
 
 
 def test_cli_draw(tmp_path, capsys):

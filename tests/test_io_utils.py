@@ -52,6 +52,14 @@ class TestDatum:
         with pytest.raises(ValueError):
             cio.array_to_datum(np.zeros((4, 4)))
 
+    def test_negative_label_roundtrip(self):
+        # Datum.label is signed int32; negatives are 10-byte varints
+        arr = np.zeros((1, 2, 2), np.uint8)
+        back, label = cio.datum_to_array(cio.array_to_datum(arr, label=-1))
+        assert label == -1
+        back, label = cio.datum_to_array(cio.array_to_datum(arr, label=-1000))
+        assert label == -1000
+
 
 class TestImageOps:
     def test_resize_shapes_and_range(self, rng):
