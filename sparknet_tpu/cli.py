@@ -336,15 +336,14 @@ def cmd_extract_features(args) -> int:
 def cmd_draw(args) -> int:
     """Net prototxt -> Graphviz DOT (ref: caffe/python/draw_net.py)."""
     from sparknet_tpu import models
-    from sparknet_tpu.proto.text_format import parse_file
     from sparknet_tpu.utils.draw import draw_net_to_file
 
     if args.net.startswith("zoo:"):
         net_param = getattr(models, args.net[4:])(args.batch or 100)
     else:
-        from sparknet_tpu.proto.upgrade import upgrade_net
+        from sparknet_tpu.proto_loader import load_net_prototxt
 
-        net_param = upgrade_net(parse_file(args.net))
+        net_param = load_net_prototxt(args.net)
     draw_net_to_file(
         net_param,
         args.out,

@@ -127,11 +127,11 @@ class Network:
         self.layers: list[Layer] = [
             create_layer(lp, phase) for lp in filter_phase(net_param, phase)
         ]
-        seen: dict[str, int] = {}
-        for l in self.layers:
-            if l.name in seen:  # same-name layers across phases already filtered
-                raise ValueError(f"duplicate layer name {l.name!r} in phase {phase}")
-            seen[l.name] = 1
+        # Caffe never enforces unique layer names; the zoo relies on that
+        # (mnist_autoencoder has two param-less "loss" layers in TRAIN).
+        # Duplicates are fine until two same-name layers both own params —
+        # the params pytree is keyed by name, so THAT collides (checked in
+        # init(), where param ownership is known).
         self.input_layers = [l for l in self.layers if isinstance(l, InputLayer)]
         # External feed blobs: tops of input layers that aren't self-feeding.
         self.feed_blobs: list[str] = []
@@ -268,8 +268,21 @@ class Network:
                     checked.append(jnp.zeros((0,), arr.dtype))
                 p = checked
             if p:
+                # every name-keyed lookup (params, param_specs_for,
+                # layer_by_name, snapshot layout) would bind ambiguously —
+                # a param OWNER may not share its name with ANY other layer
+                if sum(1 for l2 in self.layers if l2.name == layer.name) > 1:
+                    raise ValueError(
+                        f"param-owning layer {layer.name!r} shares its name "
+                        "with another layer; rename one (params are keyed "
+                        "by layer name, matching Caffe snapshot layout)"
+                    )
                 params[layer.name] = p
             if s:
+                if layer.name in state:
+                    raise ValueError(
+                        f"two stateful layers share the name {layer.name!r}"
+                    )
                 state[layer.name] = s
             outs = self._abstract_apply(
                 layer,

@@ -161,6 +161,34 @@ def ConcatLayer(name: str, bottoms: Sequence[str], axis: int = 1) -> Message:
     return m
 
 
+def SigmoidLayer(name: str, bottoms: Sequence[str], in_place: bool = False) -> Message:
+    return _layer(name, "Sigmoid", bottoms, bottoms if in_place else None)
+
+
+def FlattenLayer(name: str, bottoms: Sequence[str]) -> Message:
+    return _layer(name, "Flatten", bottoms)
+
+
+def EuclideanLossLayer(
+    name: str, bottoms: Sequence[str], loss_weight: float | None = None,
+    top: str | None = None,
+) -> Message:
+    m = _layer(name, "EuclideanLoss", bottoms, [top] if top else None)
+    if loss_weight is not None:
+        m.add("loss_weight", loss_weight)
+    return m
+
+
+def SigmoidCrossEntropyLossLayer(
+    name: str, bottoms: Sequence[str], loss_weight: float | None = None,
+    top: str | None = None,
+) -> Message:
+    m = _layer(name, "SigmoidCrossEntropyLoss", bottoms, [top] if top else None)
+    if loss_weight is not None:
+        m.add("loss_weight", loss_weight)
+    return m
+
+
 def SoftmaxLayer(name: str, bottoms: Sequence[str]) -> Message:
     return _layer(name, "Softmax", bottoms)
 

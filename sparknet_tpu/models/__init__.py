@@ -21,6 +21,8 @@ from sparknet_tpu.models.zoo import (  # noqa: F401
     googlenet_solver,
     lenet,
     lenet_solver,
+    mnist_autoencoder,
+    mnist_autoencoder_solver,
     mnist_siamese,
     mnist_siamese_solver,
 )

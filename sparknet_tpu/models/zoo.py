@@ -22,6 +22,8 @@ from sparknet_tpu.layers_dsl import (
     ConcatLayer,
     ConvolutionLayer,
     DropoutLayer,
+    EuclideanLossLayer,
+    FlattenLayer,
     InnerProductLayer,
     LRNLayer,
     NetParam,
@@ -29,6 +31,8 @@ from sparknet_tpu.layers_dsl import (
     PoolingLayer,
     RDDLayer,
     ReLULayer,
+    SigmoidCrossEntropyLossLayer,
+    SigmoidLayer,
     SoftmaxWithLoss,
     _filler,
 )
@@ -431,4 +435,64 @@ def mnist_siamese_solver() -> SolverConfig:
         base_lr=0.01, lr_policy="inv", gamma=1e-4, power=0.75,
         momentum=0.9, weight_decay=0.0, max_iter=50000,
         solver_type="SGD", display=500,
+    )
+
+
+def _sparse_gauss(std: float, sparse: int) -> Message:
+    m = _filler("gaussian", std=std)
+    m.set("sparse", sparse)
+    return m
+
+
+def _ae_ip(name: str, bottom: str, n: int, sparse: bool = True) -> Message:
+    """Autoencoder InnerProduct: gaussian(std=1, sparse=15) weights, lr_mult
+    1/1 with decay_mult 1/0 (ref: mnist_autoencoder.prototxt:58-84)."""
+    m = InnerProductLayer(
+        name, [bottom], num_output=n,
+        weight_filler=_sparse_gauss(1.0, 15) if sparse else _filler("gaussian", std=0.1),
+        bias_filler=_filler("constant", value=0.0),
+    )
+    for decay in (1.0, 0.0):
+        m.add("param", Message().set("lr_mult", 1.0).set("decay_mult", decay))
+    return m
+
+
+def mnist_autoencoder(batch: int = 100) -> Message:
+    """Deep autoencoder 784-1000-500-250-30-250-500-1000-784 with sigmoid
+    cross-entropy reconstruction loss and a loss_weight=0 euclidean monitor
+    (ref: caffe/examples/mnist/mnist_autoencoder.prototxt)."""
+    layers = [
+        RDDLayer("data", shape=[batch, 1, 28, 28]),
+        FlattenLayer("flatdata", ["data"]),
+        _ae_ip("encode1", "data", 1000),
+        SigmoidLayer("encode1neuron", ["encode1"]),
+        _ae_ip("encode2", "encode1neuron", 500),
+        SigmoidLayer("encode2neuron", ["encode2"]),
+        _ae_ip("encode3", "encode2neuron", 250),
+        SigmoidLayer("encode3neuron", ["encode3"]),
+        _ae_ip("encode4", "encode3neuron", 30),
+        _ae_ip("decode4", "encode4", 250),
+        SigmoidLayer("decode4neuron", ["decode4"]),
+        _ae_ip("decode3", "decode4neuron", 500),
+        SigmoidLayer("decode3neuron", ["decode3"]),
+        _ae_ip("decode2", "decode3neuron", 1000),
+        SigmoidLayer("decode2neuron", ["decode2"]),
+        _ae_ip("decode1", "decode2neuron", 784),
+        SigmoidCrossEntropyLossLayer(
+            "loss", ["decode1", "flatdata"], loss_weight=1.0,
+            top="cross_entropy_loss"),
+        SigmoidLayer("decode1neuron", ["decode1"]),
+        EuclideanLossLayer(
+            "l2_monitor", ["decode1neuron", "flatdata"], loss_weight=0.0,
+            top="l2_error"),
+    ]
+    return NetParam("MNISTAutoencoder", *layers)
+
+
+def mnist_autoencoder_solver() -> SolverConfig:
+    """ref: caffe/examples/mnist/mnist_autoencoder_solver.prototxt."""
+    return SolverConfig(
+        base_lr=0.01, lr_policy="step", gamma=0.1, stepsize=10000,
+        momentum=0.9, weight_decay=0.0005, max_iter=65000,
+        solver_type="SGD", display=100, snapshot=10000,
     )

@@ -33,7 +33,16 @@ def fill(filler: Message, key: jax.Array, shape, dtype=jnp.float32) -> jax.Array
         return jax.random.uniform(key, shape, dtype, lo, hi)
     if ftype == "gaussian":
         mean, std = filler.get_float("mean", 0.0), filler.get_float("std", 1.0)
-        return mean + std * jax.random.normal(key, shape, dtype)
+        out = mean + std * jax.random.normal(key, shape, dtype)
+        sparse = filler.get_int("sparse", -1)
+        if sparse >= 0:
+            # ref filler.hpp GaussianFiller: bernoulli mask with
+            # p = sparse / num_outputs, num_outputs = blob shape[0]
+            num_outputs = shape[0] if shape else 1
+            prob = min(1.0, sparse / max(num_outputs, 1))
+            k2 = jax.random.split(key, 2)[1]
+            out = out * jax.random.bernoulli(k2, prob, shape).astype(dtype)
+        return out
     if ftype == "positive_unitball":
         x = jax.random.uniform(key, shape, dtype)
         flat = x.reshape(shape[0], -1)

@@ -1,0 +1,181 @@
+"""The ``Python`` layer type — user-defined layers loaded from a module.
+
+ref: caffe/src/caffe/layer_factory.cpp:199-214 (GetPythonLayer) +
+caffe/python/caffe/ (PythonLayer exposes setup/reshape/forward/backward
+over mutable blobs); declared in prototxt as
+``python_param { module: "m" layer: "Cls" param_str: "..." }`` — the module
+must be importable (PYTHONPATH), exactly the reference's contract
+(examples/pycaffe/linreg.prototxt:43-58).
+
+Two authoring styles are supported:
+
+- **JAX-native (first-class):** the class defines ``apply(self, *inputs)``
+  returning one array or a list.  It is traced straight into the XLA
+  program — it runs ON the TPU, fuses with its neighbors, and
+  differentiates through ``jax.grad`` with no extra work.  This is the
+  TPU-first re-think of "write a layer in Python".
+- **Caffe-compat:** the class defines ``setup/reshape/forward/backward``
+  mutating blob wrappers (``.data``/``.diff``/``.num``/``.count``), like
+  every existing pycaffe layer.  It is bridged with ``jax.pure_callback``
+  (host execution) and a ``custom_vjp`` whose backward calls the class's
+  own ``backward`` — numerically faithful, but host-resident: data round-
+  trips device↔host per step (the reference has the same caveat: Python
+  layers force CPU, layer_factory.cpp:203-207).  Because pure_callback
+  gives no cross-callback ordering or liveness guarantee, the backward
+  callback re-runs ``forward`` itself before calling ``backward``, so
+  per-object scratch state (pyloss's ``self.diff``) is always fresh —
+  forward work is duplicated in the backward pass, the price of hosting
+  an imperative layer inside a pure program.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparknet_tpu.ops.base import Layer, LayerOutput, Shape
+from sparknet_tpu.ops.registry import register
+
+
+class PyBlob:
+    """Mutable numpy blob with the pycaffe surface (data/diff/num/count)."""
+
+    def __init__(self, shape: Sequence[int]):
+        self.data = np.zeros(tuple(shape), np.float32)
+        self.diff = np.zeros(tuple(shape), np.float32)
+
+    @property
+    def num(self) -> int:
+        return self.data.shape[0] if self.data.ndim else 1
+
+    @property
+    def count(self) -> int:
+        return int(self.data.size)
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    def reshape(self, *shape: int) -> None:
+        self.data = np.zeros(shape, np.float32)
+        self.diff = np.zeros(shape, np.float32)
+
+
+@register
+class PythonLayer(Layer):
+    TYPE = "Python"
+
+    def __init__(self, lp, phase):
+        super().__init__(lp, phase)
+        pp = lp.get_msg("python_param")
+        module = pp.get_str("module")
+        cls_name = pp.get_str("layer")
+        if not module or not cls_name:
+            raise ValueError(
+                f"Python layer {self.name!r} needs python_param "
+                "{ module: ... layer: ... }"
+            )
+        mod = importlib.import_module(module)
+        cls = getattr(mod, cls_name)
+        try:
+            self.obj = cls()
+        except TypeError:
+            # pycaffe classes are built by the C++ side without __init__ args
+            self.obj = cls.__new__(cls)
+        self.obj.param_str = pp.get_str("param_str", "")
+        self.obj.phase = phase
+        self._jax_native = hasattr(self.obj, "apply")
+        if not self._jax_native and not (
+            hasattr(self.obj, "forward") and hasattr(self.obj, "setup")
+        ):
+            raise ValueError(
+                f"Python layer class {module}.{cls_name} must define either "
+                "apply(self, *inputs) [JAX-native] or "
+                "setup/reshape/forward[/backward] [pycaffe-compat]"
+            )
+        self._top_shapes_cache: dict[tuple, list[tuple]] = {}
+
+    # ------------------------------------------------------------------
+    def _host_shapes(self, in_shapes: Sequence[Shape]) -> list[tuple]:
+        """Run the compat object's setup+reshape on zero blobs to learn the
+        top shapes (the role of Layer::SetUp, layer.hpp:71-96)."""
+        key = tuple(tuple(s) for s in in_shapes)
+        if key not in self._top_shapes_cache:
+            bottoms = [PyBlob(s) for s in in_shapes]
+            tops = [PyBlob((1,)) for _ in self.tops]
+            self.obj.setup(bottoms, tops)
+            if hasattr(self.obj, "reshape"):
+                self.obj.reshape(bottoms, tops)
+            self._top_shapes_cache[key] = [t.data.shape for t in tops]
+        return self._top_shapes_cache[key]
+
+    # ------------------------------------------------------------------
+    def apply(self, params, state, inputs, *, train, rng=None) -> LayerOutput:
+        if self._jax_native:
+            out = self.obj.apply(*inputs)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            return LayerOutput(outputs=list(outs))
+
+        obj = self.obj
+        n_in = len(inputs)
+        in_shapes = [tuple(x.shape) for x in inputs]
+        top_shapes = self._host_shapes(in_shapes)
+        out_struct = [
+            jax.ShapeDtypeStruct(s, jnp.float32) for s in top_shapes
+        ]
+        in_struct = [jax.ShapeDtypeStruct(s, jnp.float32) for s in in_shapes]
+
+        def forward_host(*xs):
+            bottoms = [PyBlob(s) for s in in_shapes]
+            tops = [PyBlob(s) for s in top_shapes]
+            for b, x in zip(bottoms, xs):
+                b.data[...] = np.asarray(x, np.float32)
+            if hasattr(obj, "reshape"):
+                obj.reshape(bottoms, tops)
+            obj.forward(bottoms, tops)
+            return tuple(np.asarray(t.data, np.float32) for t in tops)
+
+        def backward_host(*args):
+            xs, gs = args[:n_in], args[n_in:]
+            bottoms = [PyBlob(s) for s in in_shapes]
+            tops = [PyBlob(s) for s in top_shapes]
+            for b, x in zip(bottoms, xs):
+                b.data[...] = np.asarray(x, np.float32)
+            # Re-run forward first: XLA may elide or reorder the forward
+            # callback (pure_callback gives no cross-callback ordering
+            # guarantee), so backward must NOT rely on object scratch state
+            # (e.g. pyloss's self.diff) from a previous callback — recompute
+            # it here, making backward self-contained.
+            if hasattr(obj, "reshape"):
+                obj.reshape(bottoms, tops)
+            obj.forward(bottoms, tops)
+            for t, g in zip(tops, gs):
+                t.diff[...] = np.asarray(g, np.float32)
+            obj.backward(tops, [True] * n_in, bottoms)
+            return tuple(np.asarray(b.diff, np.float32) for b in bottoms)
+
+        @jax.custom_vjp
+        def f(*xs):
+            out = jax.pure_callback(forward_host, tuple(out_struct), *xs)
+            return tuple(out)
+
+        def f_fwd(*xs):
+            return f(*xs), xs
+
+        def f_bwd(res, gs):
+            if not hasattr(obj, "backward"):
+                raise NotImplementedError(
+                    f"Python layer {self.name!r} has no backward()"
+                )
+            dxs = jax.pure_callback(
+                backward_host, tuple(in_struct), *res, *gs
+            )
+            return tuple(dxs)
+
+        f.defvjp(f_fwd, f_bwd)
+        xs32 = [jnp.asarray(x, jnp.float32) for x in inputs]
+        return LayerOutput(outputs=list(f(*xs32)))

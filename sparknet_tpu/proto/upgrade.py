@@ -310,5 +310,9 @@ def upgrade_net(net_param: Message) -> Message:
             out.add("layer", _upgrade_v1_layer(v1))
         net_param = out
     if net_needs_data_upgrade(net_param):
+        # copy before mutating: the caller's parsed Message must not be
+        # side-effected by load-time migration (the V0/V1 branches already
+        # build fresh Messages)
+        net_param = net_param.copy()
         upgrade_net_data_transformation(net_param)
     return net_param

@@ -379,6 +379,35 @@ def test_replace_data_layers_honors_exclude_rules():
     assert "data_test" not in by_name
 
 
+def test_mnist_autoencoder_trains():
+    """Reconstruction loss falls; the euclidean monitor top carries
+    loss_weight 0 (ref: examples/mnist/mnist_autoencoder.prototxt)."""
+    from sparknet_tpu.net import TPUNet
+    from sparknet_tpu.solvers.solver import SolverConfig
+
+    net = TPUNet(
+        SolverConfig(base_lr=0.01, momentum=0.9), models.mnist_autoencoder(16)
+    )
+    rs = np.random.RandomState(0)
+    base = rs.rand(64, 1, 28, 28).astype(np.float32)
+
+    def batch(it):
+        idx = rs.randint(0, 64, 16)
+        return {"data": base[idx]}
+
+    # sparse gaussian filler AT INIT (training densifies): keep-probability
+    # is sparse/num_outputs = 15/500 for encode2's (500, 1000) weight
+    # (ref: filler.hpp GaussianFiller sparse_)
+    w = np.asarray(net.solver.variables.params["encode2"][0])
+    assert 0.6 * (15 / 500) < (w != 0).mean() < 1.6 * (15 / 500)
+
+    net.set_train_data(batch)
+    l0 = net.train(1)
+    net.train(40)
+    l1 = net.train(1)
+    assert l1 < l0 * 0.9, (l0, l1)
+
+
 def test_siamese_bias_lr_mult_matches_reference():
     """Biases train at lr_mult=2 like the reference siamese prototxt."""
     net = Network(models.mnist_siamese(2), Phase.TRAIN)

@@ -193,6 +193,21 @@ class TestDataUpgradeAndIdempotence:
         assert d.get_msg("transform_param").get_float("scale") == 0.5
         assert not net_needs_data_upgrade(up)
 
+    def test_data_upgrade_does_not_mutate_caller(self):
+        from sparknet_tpu.proto import serialize as ser
+
+        npz = parse(
+            """
+            layer { name: "d" type: "Data" top: "data"
+                    data_param { source: "/x" batch_size: 2 scale: 0.5 } }
+            """
+        )
+        before = ser(npz)
+        up = upgrade_net(npz)
+        assert ser(npz) == before  # caller's message untouched
+        assert up is not npz
+        assert up.get_all("layer")[0].get_msg("transform_param").has("scale")
+
     def test_current_net_untouched(self):
         from sparknet_tpu import models
 
