@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 import numpy as np
@@ -240,6 +241,35 @@ def cmd_time(args) -> int:
         }))
         return 0
 
+    if args.hlo:
+        # XLA's own cost model for the compiled train step — flops and
+        # HBM traffic per program (SURVEY §5: the `caffe time` analog is a
+        # per-op HLO cost breakdown on TPU, where the layer loop is fused)
+        from sparknet_tpu.solvers.solver import Solver
+
+        solver = Solver(solver_cfg, net_param)
+        train_fn, _ = _data_fns(args, solver.train_net)
+        feeds = jax.device_put(train_fn(0))
+        step, v, s, key = solver.jitted_train_step(donate=False)
+        compiled = step.lower(v, s, 0, feeds, key).compile()
+        cost = compiled.cost_analysis() or {}
+        if isinstance(cost, list):  # older jax returns [dict]
+            cost = cost[0] if cost else {}
+        flops = float(cost.get("flops", 0.0))
+        bytes_ = float(cost.get("bytes accessed", 0.0))
+        batch = next(iter(feeds.values())).shape[0]
+        mem = compiled.memory_analysis()
+        print(json.dumps({
+            "flops_per_step": flops,
+            "hbm_bytes_per_step": bytes_,
+            "arithmetic_intensity": round(flops / bytes_, 2) if bytes_ else None,
+            "batch": int(batch),
+            "gflops_per_image": round(flops / batch / 1e9, 3) if batch else None,
+            "temp_bytes": getattr(mem, "temp_size_in_bytes", None),
+            "argument_bytes": getattr(mem, "argument_size_in_bytes", None),
+        }))
+        return 0
+
     net = Network(net_param, Phase.TRAIN)
     variables = net.init(jax.random.PRNGKey(0))
     train_fn, _ = _data_fns(args, net)
@@ -354,6 +384,84 @@ def cmd_draw(args) -> int:
     return 0
 
 
+def cmd_pull_shards(args) -> int:
+    """Explode a contiguous range of tar shards into a staging directory —
+    per-worker dataset staging (ref: ec2/pull.py, which pulled
+    files-shuf-NNN.tar from S3; here the shard store is a local/NFS dir,
+    the zero-egress TPU-pod equivalent)."""
+    import glob
+    import re
+    import tarfile
+
+    shards = sorted(glob.glob(os.path.join(args.store, "*.tar")))
+    if not shards:
+        raise SystemExit(f"no .tar shards under {args.store}")
+    # select by the shard NUMBER in the filename (files-shuf-007.tar is
+    # shard 7 even when earlier shards are missing), like the reference's
+    # explicit 'files-shuf-%03d.tar' % idx
+    sel = []
+    for path in shards:
+        m = re.findall(r"(\d+)", os.path.basename(path))
+        if m and args.start <= int(m[-1]) < args.stop:
+            sel.append(path)
+    if not sel:
+        raise SystemExit(
+            f"no shards numbered [{args.start}, {args.stop}) under {args.store}"
+        )
+    outdir = os.path.join(args.out, "%03d-%03d" % (args.start, args.stop))
+    os.makedirs(outdir, exist_ok=True)
+    written: set[str] = set()
+    clobbered = 0
+    for path in sel:
+        with tarfile.open(path) as tar:
+            for member in tar.getmembers():
+                if not member.isfile():
+                    continue
+                src = tar.extractfile(member)
+                if src is None:
+                    continue
+                # preserve in-archive relative paths; refuse escapes
+                rel = os.path.normpath(member.path).lstrip("/")
+                if rel.startswith(".."):
+                    raise SystemExit(f"shard member escapes outdir: {member.path}")
+                dst = os.path.join(outdir, rel)
+                os.makedirs(os.path.dirname(dst) or outdir, exist_ok=True)
+                if dst in written:
+                    clobbered += 1
+                written.add(dst)
+                with open(dst, "wb") as f:
+                    f.write(src.read())
+    print(json.dumps({
+        "out": outdir, "shards": len(sel), "files": len(written),
+        "clobbered": clobbered,
+    }))
+    return 0
+
+
+def cmd_create_labelfile(args) -> int:
+    """Write a train.txt for the files actually present in a directory,
+    labels looked up (case-normalized) from a master label file
+    (ref: ec2/create_labelfile.py)."""
+    labelmap = {}
+    with open(args.trainfile) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) >= 2:
+                labelmap[parts[0].upper()] = parts[1]
+    n, missing = 0, 0
+    with open(args.outfile, "w") as out:
+        for root, _dirs, files in os.walk(args.directory):
+            for fname in sorted(files):
+                label = labelmap.get(fname.upper())
+                if label is None:
+                    missing += 1
+                    continue
+                out.write(f"{fname} {label}\n")
+                n += 1
+    print(json.dumps({"out": args.outfile, "entries": n, "unlabeled": missing}))
+    return 0
+
+
 def cmd_upgrade_net_proto_text(args) -> int:
     """Legacy V0/V1 net prototxt -> current schema (ref:
     caffe/tools/upgrade_net_proto_text.cpp)."""
@@ -433,6 +541,9 @@ def main(argv=None) -> int:
     common(sp)
     sp.add_argument("--fused", action="store_true",
                     help="time the whole jitted train step instead")
+    sp.add_argument("--hlo", action="store_true",
+                    help="XLA cost analysis of the compiled step (flops, "
+                    "HBM bytes, arithmetic intensity)")
     sp.set_defaults(fn=cmd_time)
 
     sp = sub.add_parser("convert_imageset", help="image list -> record DB")
@@ -461,6 +572,19 @@ def main(argv=None) -> int:
     sp.add_argument("--phase", default="", help="filter by TRAIN/TEST")
     sp.add_argument("--batch", type=int, default=0, help="zoo batch override")
     sp.set_defaults(fn=cmd_draw)
+
+    sp = sub.add_parser("pull_shards", help="stage tar shards into a directory")
+    sp.add_argument("--store", required=True, help="directory of .tar shards")
+    sp.add_argument("--start", type=int, required=True)
+    sp.add_argument("--stop", type=int, required=True)
+    sp.add_argument("--out", required=True)
+    sp.set_defaults(fn=cmd_pull_shards)
+
+    sp = sub.add_parser("create_labelfile", help="train.txt for staged files")
+    sp.add_argument("directory")
+    sp.add_argument("trainfile")
+    sp.add_argument("outfile")
+    sp.set_defaults(fn=cmd_create_labelfile)
 
     for cmd, fn in (
         ("upgrade_net_proto_text", cmd_upgrade_net_proto_text),

@@ -444,12 +444,12 @@ def _sparse_gauss(std: float, sparse: int) -> Message:
     return m
 
 
-def _ae_ip(name: str, bottom: str, n: int, sparse: bool = True) -> Message:
+def _ae_ip(name: str, bottom: str, n: int) -> Message:
     """Autoencoder InnerProduct: gaussian(std=1, sparse=15) weights, lr_mult
     1/1 with decay_mult 1/0 (ref: mnist_autoencoder.prototxt:58-84)."""
     m = InnerProductLayer(
         name, [bottom], num_output=n,
-        weight_filler=_sparse_gauss(1.0, 15) if sparse else _filler("gaussian", std=0.1),
+        weight_filler=_sparse_gauss(1.0, 15),
         bias_filler=_filler("constant", value=0.0),
     )
     for decay in (1.0, 0.0):

@@ -81,13 +81,29 @@ class PythonLayer(Layer):
             )
         mod = importlib.import_module(module)
         cls = getattr(mod, cls_name)
-        try:
-            self.obj = cls()
-        except TypeError:
-            # pycaffe classes are built by the C++ side without __init__ args
-            self.obj = cls.__new__(cls)
+        # pycaffe classes are constructed by the C++ side without __init__
+        # args; only skip __init__ when it genuinely REQUIRES arguments —
+        # a TypeError raised inside a zero-arg __init__ must propagate
+        import inspect
+
+        needs_args = False
+        if cls.__init__ is not object.__init__:
+            try:
+                sig = inspect.signature(cls.__init__)
+                needs_args = any(
+                    p.default is inspect.Parameter.empty
+                    and p.kind
+                    in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD, p.KEYWORD_ONLY)
+                    for name, p in sig.parameters.items()
+                    if name != "self"
+                )
+            except (ValueError, TypeError):
+                pass
+        self.obj = cls.__new__(cls) if needs_args else cls()
         self.obj.param_str = pp.get_str("param_str", "")
-        self.obj.phase = phase
+        # pycaffe exposes phase as an int (TRAIN=0 / TEST=1) — layers do
+        # `if self.phase == 0:`; hand over the enum's value, not the enum
+        self.obj.phase = phase.value
         self._jax_native = hasattr(self.obj, "apply")
         if not self._jax_native and not (
             hasattr(self.obj, "forward") and hasattr(self.obj, "setup")

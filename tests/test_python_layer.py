@@ -163,6 +163,66 @@ class TestJaxNativeStyle:
         assert np.allclose(np.asarray(g), (1 - np.tanh(x) ** 2) * 2.5, atol=1e-5)
 
 
+class TestPycaffeContract:
+    def test_phase_is_int(self, tmp_path):
+        # pycaffe layers check `self.phase == 0` (TRAIN) — int, not enum
+        import sys as _sys
+
+        (tmp_path / "phasemod.py").write_text(
+            "class PhaseProbe:\n"
+            "    def setup(self, bottom, top): pass\n"
+            "    def reshape(self, bottom, top):\n"
+            "        top[0].reshape(*bottom[0].data.shape)\n"
+            "    def forward(self, bottom, top):\n"
+            "        assert self.phase in (0, 1), repr(self.phase)\n"
+            "        top[0].data[...] = bottom[0].data + (1 if self.phase == 0 else 2)\n"
+        )
+        _sys.path.insert(0, str(tmp_path))
+        try:
+            proto = (
+                'input: "data" input_shape { dim: 2 dim: 3 } '
+                'layer { type: "Python" name: "p" bottom: "data" top: "out" '
+                'python_param { module: "phasemod" layer: "PhaseProbe" } }'
+            )
+            for phase, offset in ((Phase.TRAIN, 1.0), (Phase.TEST, 2.0)):
+                net = Network(parse(proto), phase)
+                v = net.init(jax.random.PRNGKey(0))
+                x = np.zeros((2, 3), np.float32)
+                blobs, _, _ = net.apply(v, {"data": x}, rng=None, train=False)
+                assert np.allclose(np.asarray(blobs["out"]), offset)
+        finally:
+            _sys.path.remove(str(tmp_path))
+
+    def test_zero_arg_init_is_called_and_errors_propagate(self, tmp_path):
+        import sys as _sys
+
+        (tmp_path / "initmod.py").write_text(
+            "class GoodInit:\n"
+            "    def __init__(self): self.tag = 41\n"
+            "    def apply(self, x): return x + self.tag\n"
+            "class BadInit:\n"
+            "    def __init__(self): raise TypeError('broken ctor')\n"
+            "    def apply(self, x): return x\n"
+        )
+        _sys.path.insert(0, str(tmp_path))
+        try:
+            good = (
+                'input: "data" input_shape { dim: 2 } '
+                'layer { type: "Python" name: "p" bottom: "data" top: "out" '
+                'python_param { module: "initmod" layer: "GoodInit" } }'
+            )
+            net = Network(parse(good), Phase.TEST)
+            v = net.init(jax.random.PRNGKey(0))
+            blobs, _, _ = net.apply(v, {"data": np.ones(2, np.float32)}, rng=None)
+            assert np.allclose(np.asarray(blobs["out"]), 42.0)
+            # a TypeError raised INSIDE a zero-arg __init__ must surface
+            bad = good.replace("GoodInit", "BadInit")
+            with pytest.raises(TypeError, match="broken ctor"):
+                Network(parse(bad), Phase.TEST)
+        finally:
+            _sys.path.remove(str(tmp_path))
+
+
 class TestValidation:
     def test_missing_python_param(self):
         with pytest.raises(ValueError, match="python_param"):

@@ -62,6 +62,73 @@ def test_time_layers_lenet():
     assert acc["forward_ms"] > 0  # non-differentiable: forward only
 
 
+def test_cli_time_hlo_cost_analysis(capsys):
+    """`tpunet time --hlo`: XLA cost model of the compiled step (the
+    per-op HLO cost breakdown, SURVEY §5's `caffe time` analog)."""
+    import json as _json
+
+    from sparknet_tpu.cli import main
+
+    assert main(["time", "--hlo", "--solver", "zoo:lenet", "--batch", "4"]) == 0
+    out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["flops_per_step"] > 1e6  # lenet fwd+bwd at batch 4
+    assert out["hbm_bytes_per_step"] > 0
+    assert out["batch"] == 4
+
+
+def test_pull_shards_and_create_labelfile(tmp_path, capsys):
+    """Dataset staging tools (ref: ec2/pull.py + ec2/create_labelfile.py)."""
+    import io
+    import tarfile
+
+    from sparknet_tpu.cli import main
+
+    store = tmp_path / "store"
+    store.mkdir()
+    for i in range(3):
+        with tarfile.open(store / f"files-shuf-{i:03d}.tar", "w") as tar:
+            for j in range(2):
+                data = f"img {i}-{j}".encode()
+                info = tarfile.TarInfo(name=f"n{i:04d}_{j}.JPEG")
+                info.size = len(data)
+                tar.addfile(info, io.BytesIO(data))
+    out = tmp_path / "staged"
+    assert main(["pull_shards", "--store", str(store),
+                 "--start", "0", "--stop", "2", "--out", str(out)]) == 0
+    staged = out / "000-002"
+    files = sorted(p.name for p in staged.iterdir())
+    assert len(files) == 4  # shards 0 and 1 only
+    assert "n0002_0.JPEG" not in files
+
+    # selection is by shard NUMBER in the filename, not list position:
+    # with shard 001 deleted, [2, 3) still means shard 002
+    (store / "files-shuf-001.tar").unlink()
+    out2 = tmp_path / "staged2"
+    assert main(["pull_shards", "--store", str(store),
+                 "--start", "2", "--stop", "3", "--out", str(out2)]) == 0
+    files2 = sorted(p.name for p in (out2 / "002-003").iterdir())
+    assert files2 == ["n0002_0.JPEG", "n0002_1.JPEG"]
+
+    # empty numeric range is an error, not a silent 0-file success
+    import pytest as _pytest
+
+    with _pytest.raises(SystemExit, match="no shards numbered"):
+        main(["pull_shards", "--store", str(store),
+              "--start", "7", "--stop", "9", "--out", str(out2)])
+
+    master = tmp_path / "master_train.txt"
+    master.write_text(
+        "N0000_0.jpeg 7\nn0000_1.JPEG 3\nn0001_0.JPEG 1\n"
+        "n0001_1.JPEG 2\nunrelated.JPEG 9\n"
+    )
+    labelfile = tmp_path / "train.txt"
+    assert main(["create_labelfile", str(staged), str(master), str(labelfile)]) == 0
+    lines = dict(l.split() for l in labelfile.read_text().splitlines())
+    # case-normalized lookup; only staged files appear
+    assert lines == {"n0000_0.JPEG": "7", "n0000_1.JPEG": "3",
+                     "n0001_0.JPEG": "1", "n0001_1.JPEG": "2"}
+
+
 # ---------------------------------------------------------------- apps
 @pytest.fixture(scope="module")
 def cifar_dir(tmp_path_factory):
