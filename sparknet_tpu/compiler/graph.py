@@ -116,6 +116,8 @@ class Network:
         net_param: Message,
         phase: Phase = Phase.TRAIN,
         batch_override: int | None = None,
+        stages: set[str] | None = None,
+        level: int = 0,
     ):
         from sparknet_tpu.proto.upgrade import upgrade_net
 
@@ -124,8 +126,10 @@ class Network:
         self.phase = phase
         self.name = net_param.get_str("name", "net")
         self.batch_override = batch_override
+        self.stages = set(stages or ())
         self.layers: list[Layer] = [
-            create_layer(lp, phase) for lp in filter_phase(net_param, phase)
+            create_layer(lp, phase)
+            for lp in filter_phase(net_param, phase, level, self.stages)
         ]
         # Caffe never enforces unique layer names; the zoo relies on that
         # (mnist_autoencoder has two param-less "loss" layers in TRAIN).

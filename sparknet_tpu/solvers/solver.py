@@ -70,6 +70,12 @@ class SolverConfig:
     remat: bool = False
     random_seed: int = -1
     test_iter: tuple = ()
+    # one stage-tuple per test net (ref: SolverParameter.test_state +
+    # Solver::InitTestNets solver.cpp:135-190 NetState merge); () = one
+    # default test net with no stages.  test_levels holds the matching
+    # NetState.level per test net (0 when unspecified).
+    test_states: tuple = ()
+    test_levels: tuple = ()
     test_interval: int = 0
     display: int = 0
     average_loss: int = 1
@@ -104,6 +110,13 @@ class SolverConfig:
             solver_type=_TYPE_ALIASES[stype],
             random_seed=m.get_int("random_seed", -1),
             test_iter=tuple(int(v) for v in m.get_all("test_iter")),
+            test_states=tuple(
+                tuple(str(s) for s in ts.get_all("stage"))
+                for ts in m.get_all("test_state")
+            ),
+            test_levels=tuple(
+                ts.get_int("level", 0) for ts in m.get_all("test_state")
+            ),
             test_interval=m.get_int("test_interval", 0),
             display=m.get_int("display", 0),
             average_loss=m.get_int("average_loss", 1),
@@ -152,7 +165,26 @@ class Solver:
         )
         self.net_param = net_param
         self.train_net = Network(net_param, Phase.TRAIN, batch_override)
-        self.test_net = Network(net_param, Phase.TEST, batch_override)
+        # one TEST net per test_state (ref: Solver::InitTestNets
+        # solver.cpp:135-190: NetState per test net, merged stages);
+        # no test_state = the single default test net
+        states = self.config.test_states or ((),)
+        levels = self.config.test_levels or (0,) * len(states)
+        self.test_nets = [
+            Network(net_param, Phase.TEST, batch_override,
+                    stages=set(st), level=lv)
+            for st, lv in zip(states, levels)
+        ]
+        self.test_net = self.test_nets[0]
+        # ref: Solver::InitTestNets CHECK_EQ(test_iter size, num test nets)
+        if self.config.test_iter and len(self.config.test_iter) != len(
+            self.test_nets
+        ):
+            raise ValueError(
+                f"test_iter specifies {len(self.config.test_iter)} counts "
+                f"but there are {len(self.test_nets)} test nets "
+                "(one test_iter per test net, ref: solver.cpp:113-118)"
+            )
         seed = self.config.random_seed if self.config.random_seed >= 0 else None
         self._key = root_key(seed)
         self.variables = self.train_net.init(self._key, feed_shapes, feed_dtypes)
@@ -162,7 +194,10 @@ class Solver:
         self._loss_window: list[float] = []
         self._specs = self.train_net.param_specs_for(self.variables)
         self._train_step = jax.jit(self._make_train_step())
-        self._eval_step = jax.jit(self._make_eval_step())
+        self._eval_steps = [
+            jax.jit(self._make_eval_step(net)) for net in self.test_nets
+        ]
+        self._eval_step = self._eval_steps[0]
 
     # ------------------------------------------------------------------
     def _make_train_step(self):
@@ -209,10 +244,7 @@ class Solver:
 
         return train_step
 
-    def _make_eval_step(self):
-        net = self.test_net
-        outputs = None  # resolved lazily (test net output blob names)
-
+    def _make_eval_step(self, net: Network):
         def eval_step(variables, feeds):
             blobs, _, _ = net.apply(variables, feeds, rng=None, train=False)
             return {name: blobs[name] for name in net.output_blobs() if name in blobs}
@@ -270,17 +302,38 @@ class Solver:
         return float(sum(float(l) for l in self._loss_window) / len(self._loss_window))
 
     # ------------------------------------------------------------------
-    def test(self, num_batches: int, data_fn: DataFn) -> dict[str, float]:
+    def test(
+        self, num_batches: int, data_fn: DataFn, test_net_id: int = 0
+    ) -> dict[str, float]:
         """Distributed-eval semantics of the reference: accumulate each test
         output over batches, then divide by batch count (ref:
         Solver::TestAndStoreResult solver.cpp:414-444 + CifarApp.scala:113-115
-        average-of-per-batch-scores)."""
+        average-of-per-batch-scores).  ``test_net_id`` selects among the
+        test_state nets (ref: Solver::Test(test_net_id) solver.cpp:329)."""
+        step = self._eval_steps[test_net_id]
         sums: dict[str, float] = {}
         for b in range(num_batches):
-            outs = self._eval_step(self.variables, data_fn(b))
+            outs = step(self.variables, data_fn(b))
             for name, val in outs.items():
                 sums[name] = sums.get(name, 0.0) + float(jnp.sum(val))
         return {k: v / num_batches for k, v in sums.items()}
+
+    def test_all(self, data_fns) -> list[dict[str, float]]:
+        """Run every test net with its own test_iter count (ref:
+        Solver::TestAll solver.cpp:323-327).  ``data_fns``: one DataFn per
+        test net."""
+        cfg = self.config
+        data_fns = list(data_fns)
+        if len(data_fns) != len(self.test_nets):
+            raise ValueError(
+                f"test_all needs one data_fn per test net: got "
+                f"{len(data_fns)} for {len(self.test_nets)} nets"
+            )
+        results = []
+        for i, fn in enumerate(data_fns):
+            iters = cfg.test_iter[i] if i < len(cfg.test_iter) else 1
+            results.append(self.test(iters, fn, test_net_id=i))
+        return results
 
     # ------------------------------------------------------------------
     # Snapshot/restore (ref: Solver::Snapshot/Restore solver.cpp:447-519 +

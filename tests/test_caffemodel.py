@@ -223,3 +223,47 @@ def test_reference_siamese_prototxt_compiles():
     }
     blobs, _, loss = net.apply(variables, feeds, rng=jax.random.PRNGKey(1))
     assert np.isfinite(float(loss))
+
+
+def test_net_surgery_full_conv_transplant():
+    """The net_surgery workflow (ref: caffe/examples/net_surgery/
+    net_surgery.ipynb + bvlc_caffenet_full_conv.prototxt): transplant an
+    InnerProduct's weights into an equivalent Convolution whose kernel
+    covers its whole input — outputs must match exactly."""
+    import jax
+
+    from sparknet_tpu.common import Phase
+    from sparknet_tpu.compiler import Network
+    from sparknet_tpu.compiler.graph import NetVars
+    from sparknet_tpu.proto import parse
+
+    fc_net = Network(parse(
+        """
+        input: "data" input_shape { dim: 2 dim: 3 dim: 6 dim: 6 }
+        layer { name: "fc" type: "InnerProduct" bottom: "data" top: "out"
+                inner_product_param { num_output: 5
+                  weight_filler { type: "xavier" } } }
+        """
+    ), Phase.TEST)
+    conv_net = Network(parse(
+        """
+        input: "data" input_shape { dim: 2 dim: 3 dim: 6 dim: 6 }
+        layer { name: "fc-conv" type: "Convolution" bottom: "data" top: "out"
+                convolution_param { num_output: 5 kernel_size: 6 } }
+        """
+    ), Phase.TEST)
+    fcv = fc_net.init(jax.random.PRNGKey(1))
+    cv = conv_net.init(jax.random.PRNGKey(2))
+    # the notebook's transplant: conv W = fc W reshaped to (out, C, kh, kw)
+    w, b = fcv.params["fc"]
+    cv = NetVars(
+        params={"fc-conv": [w.reshape(5, 3, 6, 6), b]}, state=cv.state
+    )
+    x = np.random.RandomState(0).randn(2, 3, 6, 6).astype(np.float32)
+    fc_out, _, _ = fc_net.apply(fcv, {"data": x}, rng=None)
+    conv_out, _, _ = conv_net.apply(cv, {"data": x}, rng=None)
+    assert np.allclose(
+        np.asarray(fc_out["out"]),
+        np.asarray(conv_out["out"]).reshape(2, 5),
+        atol=1e-4,
+    )

@@ -241,3 +241,73 @@ def test_reference_solver_prototxts_parse():
     assert cfg.solver_type == "Adam"
     cfg = SolverConfig.from_proto(parse_file(f"{REF}/models/bvlc_googlenet/quick_solver.prototxt"))
     assert cfg.lr_policy == "poly"
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="no reference tree")
+def test_multi_test_nets_from_test_state():
+    """test_state stages build one TEST net each with its own data layers
+    (ref: Solver::InitTestNets solver.cpp:135-190; the mnist_autoencoder
+    solver's test-on-train / test-on-test pair)."""
+    solver_msg = parse_file(f"{REF}/examples/mnist/mnist_autoencoder_solver.prototxt")
+    cfg = SolverConfig.from_proto(solver_msg)
+    assert cfg.test_states == (("test-on-train",), ("test-on-test",))
+    assert cfg.test_iter == (500, 100)
+
+    net_param = parse_file(f"{REF}/examples/mnist/mnist_autoencoder.prototxt")
+    solver = Solver(cfg, net_param, feed_shapes={"data": (4, 1, 28, 28)})
+    assert len(solver.test_nets) == 2
+    # each test net selected exactly its stage's data layer
+    for net, stage in zip(solver.test_nets, ("test-on-train", "test-on-test")):
+        data_layers = [l for l in net.layers if l.type == "Data"]
+        assert len(data_layers) == 1
+        assert stage in net.stages
+
+    rs = np.random.RandomState(0)
+    fn = lambda b: {"data": rs.rand(4, 1, 28, 28).astype(np.float32)}
+    # run both test nets with their own (small) iteration counts
+    solver.config = dataclasses_replace_test_iter(cfg, (3, 2))
+    res = solver.test_all([fn, fn])
+    assert len(res) == 2
+    for scores in res:
+        assert any("loss" in k or "error" in k for k in scores), scores
+
+
+def dataclasses_replace_test_iter(cfg, new_iter):
+    import dataclasses as _dc
+
+    return _dc.replace(cfg, test_iter=new_iter)
+
+
+def test_test_state_level_and_validation():
+    """NetState level reaches the test net's rule matching; test_iter /
+    test net count mismatch fails like InitTestNets' CHECK_EQ."""
+    net_param = parse(
+        """
+        name: "lvl"
+        layer { name: "d" type: "Input" top: "data"
+                input_param { shape { dim: 2 dim: 4 } } }
+        layer { name: "ip" type: "InnerProduct" bottom: "data" top: "out"
+                inner_product_param { num_output: 2 } }
+        layer { name: "extra" type: "Power" bottom: "out" top: "pow"
+                include { min_level: 1 } }
+        """
+    )
+    base = parse("base_lr: 0.01")
+    base.add("test_state", parse("level: 1"))
+    base.add("test_iter", 1)
+    cfg = SolverConfig.from_proto(base)
+    assert cfg.test_levels == (1,)
+    solver = Solver(cfg, net_param)
+    assert any(l.name == "extra" for l in solver.test_nets[0].layers)
+    # default level 0 filters the min_level:1 layer out
+    solver0 = Solver(SolverConfig(), net_param)
+    assert not any(l.name == "extra" for l in solver0.test_nets[0].layers)
+
+    # CHECK_EQ(test_iter size, num test nets)
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="one test_iter per test net"):
+        Solver(SolverConfig(test_iter=(5, 5)), net_param)
+    # test_all arity mismatch is a clear error
+    with _pytest.raises(ValueError, match="one data_fn per test net"):
+        solver0.test_all([lambda b: {}, lambda b: {}])
