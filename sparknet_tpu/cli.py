@@ -105,6 +105,23 @@ def cmd_train(args) -> int:
     solver = Solver(solver_cfg, net_param)
     if args.snapshot:
         solver.restore(args.snapshot)
+    elif getattr(args, "weights", ""):
+        # finetuning: copy params by layer name from a zoo model, fresh
+        # optimizer state (ref: caffe.cpp:184-189 CopyLayers / the
+        # finetune_flickr_style recipe)
+        from sparknet_tpu.compiler.graph import NetVars
+        from sparknet_tpu.net import copy_caffemodel_params, copy_hdf5_params
+
+        if args.weights.endswith((".h5", ".hdf5", ".caffemodel.h5")):
+            params, loaded = copy_hdf5_params(
+                solver.variables.params, args.weights, strict_shapes=False
+            )
+        else:
+            params, loaded = copy_caffemodel_params(
+                solver.variables.params, args.weights, strict_shapes=False
+            )
+        solver.variables = NetVars(params=params, state=solver.variables.state)
+        print(json.dumps({"finetune_from": args.weights, "layers_loaded": loaded}))
     log = EventLogger(".", prefix="tpunet_train")
     train_fn, test_fn = _data_fns(args, solver.train_net)
 
@@ -384,6 +401,55 @@ def cmd_draw(args) -> int:
     return 0
 
 
+def cmd_classify(args) -> int:
+    """Classify images with a deploy net: top-N labels per image
+    (ref: examples/cpp_classification/classification.cpp — model_file
+    trained_file mean_file label_file image)."""
+    from sparknet_tpu.data.io_utils import load_image
+    from sparknet_tpu.models.classifier import Classifier
+
+    mean = None
+    if args.mean:
+        from sparknet_tpu.data.transform import load_mean_file
+
+        m = load_mean_file(args.mean)
+        # cpp_classification collapses the mean image to per-channel values
+        # (classification.cpp SetMean: channel_mean)
+        mean = m.reshape(m.shape[0], -1).mean(axis=1)
+    labels = None
+    if args.labels:
+        with open(args.labels) as f:
+            labels = [line.strip() for line in f if line.strip()]
+
+    clf = Classifier(
+        args.model,
+        args.weights or None,
+        mean=mean,
+        raw_scale=args.raw_scale if args.raw_scale else None,
+        channel_swap=(2, 1, 0) if args.bgr else None,
+    )
+    # match the deploy net's channel count: 1-channel nets (LeNet-style)
+    # get grayscale loads (pycaffe classify.py's --gray, auto-detected)
+    channels = clf.feed_shapes[clf.inputs[0]][1]
+    images = [load_image(p, color=channels != 1) for p in args.images]
+    probs = clf.predict(images, oversample=not args.center_only)
+    results = []
+    for path, p in zip(args.images, probs):
+        top = np.argsort(p)[::-1][: args.top]
+        results.append({
+            "image": path,
+            "predictions": [
+                {
+                    "label": labels[i] if labels and i < len(labels) else int(i),
+                    "prob": round(float(p[i]), 4),
+                }
+                for i in top
+            ],
+        })
+    print(json.dumps(results))
+    return 0
+
+
 def cmd_pull_shards(args) -> int:
     """Explode a contiguous range of tar shards into a staging directory —
     per-worker dataset staging (ref: ec2/pull.py, which pulled
@@ -526,6 +592,9 @@ def main(argv=None) -> int:
 
     sp = sub.add_parser("train", help="train a model")
     common(sp)
+    sp.add_argument("--weights", default="",
+                    help="finetune: copy params by layer name from a "
+                    ".caffemodel/.h5 (fresh optimizer state)")
     sp.add_argument("--tau", type=int, default=1, help="model-averaging interval")
     sp.add_argument("--distributed", action="store_true", help="use the device mesh")
     sp.add_argument("--test-iters", type=int, default=0)
@@ -572,6 +641,19 @@ def main(argv=None) -> int:
     sp.add_argument("--phase", default="", help="filter by TRAIN/TEST")
     sp.add_argument("--batch", type=int, default=0, help="zoo batch override")
     sp.set_defaults(fn=cmd_draw)
+
+    sp = sub.add_parser("classify", help="top-N labels for images (deploy net)")
+    sp.add_argument("--model", required=True, help="deploy prototxt")
+    sp.add_argument("--weights", default="", help=".caffemodel / .h5")
+    sp.add_argument("--mean", default="", help="mean .binaryproto or .npy")
+    sp.add_argument("--labels", default="", help="one label per line")
+    sp.add_argument("--top", type=int, default=5)
+    sp.add_argument("--raw-scale", type=float, default=255.0)
+    sp.add_argument("--bgr", action="store_true", help="swap channels RGB->BGR")
+    sp.add_argument("--center-only", action="store_true",
+                    help="center crop instead of 10-crop oversampling")
+    sp.add_argument("images", nargs="+")
+    sp.set_defaults(fn=cmd_classify)
 
     sp = sub.add_parser("pull_shards", help="stage tar shards into a directory")
     sp.add_argument("--store", required=True, help="directory of .tar shards")

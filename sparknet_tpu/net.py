@@ -136,10 +136,12 @@ def copy_caffemodel_params(
 
 
 def copy_hdf5_params(
-    params: dict[str, list], path: str
+    params: dict[str, list], path: str, strict_shapes: bool = True
 ) -> tuple[dict[str, list], list[str]]:
     """HDF5 variant of :func:`copy_caffemodel_params` (Caffe's
-    ``data/<layer>/<i>`` group layout, ref: net.cpp:926+)."""
+    ``data/<layer>/<i>`` group layout, ref: net.cpp:926+), with the same
+    shape semantics: same-size blobs reshape (legacy fc layouts), a size
+    mismatch raises when ``strict_shapes`` else skips the layer."""
     import h5py
 
     params = {k: list(v) for k, v in params.items()}
@@ -156,11 +158,25 @@ def copy_hdf5_params(
                     f"layer {lname!r}: snapshot has {len(arrs)} blobs, "
                     f"net expects {len(target)}"
                 )
-            params[lname] = [
-                # zero-size placeholder = shared alias; owner's copy wins
-                p if p.size == 0 else jnp.asarray(a.reshape(p.shape), p.dtype)
-                for a, p in zip(arrs, target)
-            ]
+            new = []
+            ok = True
+            for a, p in zip(arrs, target):
+                if p.size == 0:
+                    # zero-size placeholder = shared alias; owner's copy wins
+                    new.append(p)
+                    continue
+                if a.size != p.size:
+                    if strict_shapes:
+                        raise ValueError(
+                            f"layer {lname!r}: blob shape {a.shape} "
+                            f"!= net {tuple(p.shape)}"
+                        )
+                    ok = False  # PERMISSIVE: skip the incompatible layer
+                    break
+                new.append(jnp.asarray(a.reshape(p.shape), p.dtype))
+            if not ok:
+                continue
+            params[lname] = new
             loaded.append(lname)
     return params, loaded
 

@@ -119,3 +119,38 @@ class TestDetector:
         det = Detector(deploy_param)
         dets = det.detect_windows([(p, [(0, 0, 12, 12)])])
         assert dets[0]["filename"] == p
+
+
+def test_cli_classify(tmp_path, capsys, rng):
+    """`tpunet classify` — the cpp_classification example tool
+    (ref: examples/cpp_classification/classification.cpp)."""
+    import json
+
+    from PIL import Image
+
+    from sparknet_tpu.cli import main
+    from sparknet_tpu.data.io_utils import save_mean_binaryproto
+
+    model = tmp_path / "deploy.prototxt"
+    model.write_text(DEPLOY)
+    labels = tmp_path / "labels.txt"
+    labels.write_text("\n".join(f"class_{i}" for i in range(5)))
+    mean = tmp_path / "mean.binaryproto"
+    save_mean_binaryproto(str(mean), np.full((3, 8, 8), 120, np.float32))
+    imgs = []
+    for i in range(2):
+        p = tmp_path / f"im{i}.png"
+        Image.fromarray((rng.rand(16, 16, 3) * 255).astype(np.uint8)).save(p)
+        imgs.append(str(p))
+
+    assert main([
+        "classify", "--model", str(model), "--mean", str(mean),
+        "--labels", str(labels), "--top", "3", "--bgr", *imgs,
+    ]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert len(out) == 2
+    for rec in out:
+        assert len(rec["predictions"]) == 3
+        assert rec["predictions"][0]["label"].startswith("class_")
+        probs = [p["prob"] for p in rec["predictions"]]
+        assert probs == sorted(probs, reverse=True)

@@ -104,3 +104,33 @@ def test_hdf5_minibatches_too_small_loop_raises(tmp_path):
     (tmp_path / "s.txt").write_text("t.h5\n")
     with pytest.raises(ValueError, match="spin forever"):
         next(hdf5_minibatches(str(tmp_path / "s.txt"), 3, loop=True))
+
+
+def test_copy_hdf5_params_permissive_skips_mismatched_layer(tmp_path):
+    """strict_shapes=False skips a size-mismatched layer (the finetune
+    changed-head case) instead of raising — parity with the caffemodel
+    loader's permissive mode."""
+    import jax
+    import pytest
+
+    from sparknet_tpu import models
+    from sparknet_tpu.net import TPUNet, copy_hdf5_params
+    from sparknet_tpu.solvers.solver import SolverConfig
+
+    donor = TPUNet(SolverConfig(), models.lenet(4, num_classes=10))
+    path = str(tmp_path / "donor.h5")
+    donor.save_hdf5(path)
+
+    target = TPUNet(SolverConfig(), models.lenet(4, num_classes=3))
+    with pytest.raises(ValueError, match="ip2"):
+        copy_hdf5_params(target.solver.variables.params, path)
+    params, loaded = copy_hdf5_params(
+        target.solver.variables.params, path, strict_shapes=False
+    )
+    assert "conv1" in loaded and "ip2" not in loaded
+    assert np.array_equal(
+        np.asarray(params["conv1"][0]),
+        np.asarray(donor.solver.variables.params["conv1"][0]),
+    )
+    # the skipped head keeps its fresh init shape
+    assert params["ip2"][0].shape == target.solver.variables.params["ip2"][0].shape

@@ -403,3 +403,39 @@ def test_cli_train_profile(tmp_path, monkeypatch):
     assert rc == 0
     found = [f for root, _, fs in os.walk(tmp_path / "prof") for f in fs]
     assert found, "no profiler artifacts written"
+
+
+def test_cli_train_finetune_weights(tmp_path, capsys, monkeypatch):
+    """`tpunet train --weights model.caffemodel` copies params by layer
+    name before training (ref: caffe.cpp:184-189 CopyLayers /
+    finetune_flickr_style)."""
+    import json as _json
+
+    from sparknet_tpu import models
+    from sparknet_tpu.cli import main
+    from sparknet_tpu.net import TPUNet, copy_caffemodel_params
+    from sparknet_tpu.solvers.solver import SolverConfig
+
+    monkeypatch.chdir(tmp_path)  # cmd_train writes its event log to cwd
+    donor = TPUNet(SolverConfig(), models.lenet(4))
+    weights = str(tmp_path / "donor.caffemodel")
+    donor.save_caffemodel(weights)
+    w_donor = np.asarray(donor.solver.variables.params["conv1"][0])
+
+    out_prefix = str(tmp_path / "ft")
+    assert main([
+        "train", "--solver", "zoo:lenet", "--batch", "4",
+        "--iterations", "1", "--weights", weights, "--output", out_prefix,
+    ]) == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    meta = _json.loads(lines[0])
+    assert meta["finetune_from"] == weights
+    assert "conv1" in meta["layers_loaded"]
+    # the copy itself delivers the donor's values (not just metadata):
+    # a fresh net finetuned from the file starts at w_donor exactly
+    fresh = TPUNet(SolverConfig(), models.lenet(4))
+    params, loaded = copy_caffemodel_params(
+        fresh.solver.variables.params, weights
+    )
+    assert "conv1" in loaded
+    assert np.array_equal(np.asarray(params["conv1"][0]), w_donor)
