@@ -103,6 +103,10 @@ def cmd_train(args) -> int:
 
     net_param, solver_cfg = _build_net_and_solver(args)
     solver = Solver(solver_cfg, net_param)
+    if args.snapshot and getattr(args, "weights", ""):
+        # ref: caffe.cpp:161-163 "Give a snapshot to resume training or
+        # weights to finetune but not both."
+        raise SystemExit("--snapshot and --weights are mutually exclusive")
     if args.snapshot:
         solver.restore(args.snapshot)
     elif getattr(args, "weights", ""):
@@ -413,6 +417,8 @@ def cmd_classify(args) -> int:
         from sparknet_tpu.data.transform import load_mean_file
 
         m = load_mean_file(args.mean)
+        if m.ndim == 2:  # (H, W) grayscale mean
+            m = m[None]
         # cpp_classification collapses the mean image to per-channel values
         # (classification.cpp SetMean: channel_mean)
         mean = m.reshape(m.shape[0], -1).mean(axis=1)
@@ -421,9 +427,14 @@ def cmd_classify(args) -> int:
         with open(args.labels) as f:
             labels = [line.strip() for line in f if line.strip()]
 
+    image_dims = None
+    if args.images_dim:
+        h, w = args.images_dim.split(",")
+        image_dims = (int(h), int(w))
     clf = Classifier(
         args.model,
         args.weights or None,
+        image_dims=image_dims,
         mean=mean,
         raw_scale=args.raw_scale if args.raw_scale else None,
         channel_swap=(2, 1, 0) if args.bgr else None,
@@ -432,7 +443,9 @@ def cmd_classify(args) -> int:
     # get grayscale loads (pycaffe classify.py's --gray, auto-detected)
     channels = clf.feed_shapes[clf.inputs[0]][1]
     images = [load_image(p, color=channels != 1) for p in args.images]
-    probs = clf.predict(images, oversample=not args.center_only)
+    # single center pass by default like cpp_classification; --oversample
+    # needs --images-dim larger than the crop to cut distinct crops
+    probs = clf.predict(images, oversample=args.oversample)
     results = []
     for path, p in zip(args.images, probs):
         top = np.argsort(p)[::-1][: args.top]
@@ -650,8 +663,12 @@ def main(argv=None) -> int:
     sp.add_argument("--top", type=int, default=5)
     sp.add_argument("--raw-scale", type=float, default=255.0)
     sp.add_argument("--bgr", action="store_true", help="swap channels RGB->BGR")
-    sp.add_argument("--center-only", action="store_true",
-                    help="center crop instead of 10-crop oversampling")
+    sp.add_argument("--oversample", action="store_true",
+                    help="average 10-crop predictions (pycaffe classify.py); "
+                    "pair with --images-dim > net input for distinct crops")
+    sp.add_argument("--images-dim", default="",
+                    help='resize target "H,W" before cropping '
+                    "(pycaffe classify.py --images_dim)")
     sp.add_argument("images", nargs="+")
     sp.set_defaults(fn=cmd_classify)
 

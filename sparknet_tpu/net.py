@@ -102,10 +102,12 @@ def copy_caffemodel_params(
             continue
         target = params[layer.name]
         if len(layer.blobs) != len(target):
-            raise ValueError(
-                f"layer {layer.name!r}: snapshot has {len(layer.blobs)} "
-                f"blobs, net expects {len(target)}"
-            )
+            if strict_shapes:
+                raise ValueError(
+                    f"layer {layer.name!r}: snapshot has {len(layer.blobs)} "
+                    f"blobs, net expects {len(target)}"
+                )
+            continue  # PERMISSIVE: e.g. donor changed bias_term
         new = []
         ok = True
         for src, dst in zip(layer.blobs, target):
@@ -154,10 +156,12 @@ def copy_hdf5_params(
             target = params[lname]
             arrs = [np.asarray(g[str(i)]) for i in range(len(g))]
             if len(arrs) != len(target):
-                raise ValueError(
-                    f"layer {lname!r}: snapshot has {len(arrs)} blobs, "
-                    f"net expects {len(target)}"
-                )
+                if strict_shapes:
+                    raise ValueError(
+                        f"layer {lname!r}: snapshot has {len(arrs)} blobs, "
+                        f"net expects {len(target)}"
+                    )
+                continue  # PERMISSIVE: e.g. donor changed bias_term
             new = []
             ok = True
             for a, p in zip(arrs, target):

@@ -145,7 +145,8 @@ def test_cli_classify(tmp_path, capsys, rng):
 
     assert main([
         "classify", "--model", str(model), "--mean", str(mean),
-        "--labels", str(labels), "--top", "3", "--bgr", *imgs,
+        "--labels", str(labels), "--top", "3", "--bgr",
+        "--oversample", "--images-dim", "12,12", *imgs,
     ]) == 0
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert len(out) == 2
@@ -154,3 +155,40 @@ def test_cli_classify(tmp_path, capsys, rng):
         assert rec["predictions"][0]["label"].startswith("class_")
         probs = [p["prob"] for p in rec["predictions"]]
         assert probs == sorted(probs, reverse=True)
+
+
+def test_cli_classify_grayscale_mean_and_exclusive_flags(tmp_path, capsys, rng):
+    """2-D grayscale .npy means collapse correctly; --snapshot/--weights
+    are mutually exclusive in train (ref: caffe.cpp:161-163)."""
+    import json
+
+    import pytest
+    from PIL import Image
+
+    from sparknet_tpu.cli import main
+
+    model = tmp_path / "gray_deploy.prototxt"
+    model.write_text(
+        'name: "g"\ninput: "data"\n'
+        "input_dim: 2 input_dim: 1 input_dim: 8 input_dim: 8\n"
+        'layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"\n'
+        "  inner_product_param { num_output: 3\n"
+        '    weight_filler { type: "gaussian" std: 0.1 } } }\n'
+        'layer { name: "prob" type: "Softmax" bottom: "ip" top: "prob" }\n'
+    )
+    mean = tmp_path / "mean.npy"
+    np.save(mean, np.full((8, 8), 100, np.float32))  # 2-D grayscale mean
+    img = tmp_path / "g.png"
+    Image.fromarray((rng.rand(8, 8) * 255).astype(np.uint8), mode="L").save(img)
+
+    assert main([
+        "classify", "--model", str(model), "--mean", str(mean),
+        "--top", "2", str(img),
+    ]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert len(out[0]["predictions"]) == 2
+
+    with pytest.raises(SystemExit, match="mutually exclusive"):
+        main(["train", "--solver", "zoo:lenet", "--batch", "4",
+              "--iterations", "1", "--snapshot", "x.npz",
+              "--weights", "y.caffemodel"])

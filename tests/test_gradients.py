@@ -283,3 +283,109 @@ def test_partial_kernel_hw_rejected():
             "convolution_param { num_output: 2 kernel_h: 3 } }"
         )
         layer.init(jax.random.key(0), [(1, 3, 8, 8)])
+
+
+# ---- coverage widening: remaining differentiable op set ----------------
+
+
+def test_embed_param_grad(rng):
+    idx = jnp.asarray(rng.randint(0, 6, (4,)), jnp.int32)
+    layer = make_layer(
+        'layer { name: "e" type: "Embed" bottom: "i" top: "y" '
+        "embed_param { input_dim: 6 num_output: 3 bias_term: true "
+        'weight_filler { type: "uniform" min: -1 max: 1 } } }'
+    )
+    params, state = layer.init(jax.random.key(0), [(4,)])
+    check_layer_grad(layer, [idx], params, state, wrt="param")
+
+
+def test_scale_grad_param_and_input(rng, x44):
+    layer = make_layer(
+        'layer { name: "s" type: "Scale" bottom: "x" top: "y" '
+        "scale_param { bias_term: true } }"
+    )
+    params, state = layer.init(jax.random.key(0), [x44.shape])
+    check_layer_grad(layer, [x44], params, state, wrt="input")
+    check_layer_grad(layer, [x44], params, state, wrt="param")
+
+
+def test_bias_grad(rng, x44):
+    layer = make_layer('layer { name: "b" type: "Bias" bottom: "x" top: "y" }')
+    params, state = layer.init(jax.random.key(0), [x44.shape])
+    check_layer_grad(layer, [x44], params, state, wrt="param")
+
+
+def test_mvn_grad(rng, x44):
+    for extra in ("", "mvn_param { normalize_variance: false }",
+                  "mvn_param { across_channels: true }"):
+        layer = make_layer(
+            f'layer {{ name: "m" type: "MVN" bottom: "x" top: "y" {extra} }}'
+        )
+        check_layer_grad(layer, [x44])
+
+
+def test_log_grad(rng):
+    x = jnp.asarray(np.abs(rng.randn(2, 3, 4, 4)) + 0.5, jnp.float32)
+    layer = make_layer(
+        'layer { name: "l" type: "Log" bottom: "x" top: "y" '
+        "log_param { base: 10.0 scale: 2.0 shift: 0.5 } }"
+    )
+    check_layer_grad(layer, [x])
+
+
+def test_tile_grad(rng, x44):
+    layer = make_layer(
+        'layer { name: "t" type: "Tile" bottom: "x" top: "y" '
+        "tile_param { axis: 1 tiles: 3 } }"
+    )
+    check_layer_grad(layer, [x44])
+
+
+@pytest.mark.parametrize("op", ["SUM", "MEAN", "ASUM", "SUMSQ"])
+def test_reduction_grads(rng, op, x44):
+    layer = make_layer(
+        f'layer {{ name: "r" type: "Reduction" bottom: "x" top: "y" '
+        f"reduction_param {{ operation: {op} coeff: 0.5 }} }}"
+    )
+    # ASUM is non-smooth at 0 — keep inputs away from the kink, like the
+    # reference's GradientChecker kink handling
+    x = jnp.asarray(np.sign(np.asarray(x44)) * (np.abs(np.asarray(x44)) + 0.3),
+                    jnp.float32)
+    check_layer_grad(layer, [x])
+
+
+def test_concat_slice_grads(rng, x44):
+    x2 = jnp.asarray(rng.randn(2, 2, 4, 4), jnp.float32)
+    concat = make_layer(
+        'layer { name: "c" type: "Concat" bottom: "a" bottom: "b" top: "y" }'
+    )
+    check_layer_grad(concat, [x44, x2])
+    sl = make_layer(
+        'layer { name: "s" type: "Slice" bottom: "x" top: "y1" top: "y2" '
+        "slice_param { axis: 1 slice_point: 1 } }"
+    )
+    check_layer_grad(sl, [x44])
+
+
+def test_multinomial_logistic_loss_grad(rng):
+    # probabilities in, like the reference layer (post-softmax)
+    p = np.abs(rng.rand(4, 5)) + 0.1
+    p = jnp.asarray(p / p.sum(1, keepdims=True), jnp.float32)
+    y = jnp.asarray(rng.randint(0, 5, (4,)), jnp.int32)
+    layer = make_layer(
+        'layer { name: "m" type: "MultinomialLogisticLoss" '
+        'bottom: "p" bottom: "y" top: "l" }'
+    )
+    check_layer_grad(layer, [p, y])
+
+
+def test_infogain_loss_grad(rng):
+    p = np.abs(rng.rand(3, 4)) + 0.1
+    p = jnp.asarray(p / p.sum(1, keepdims=True), jnp.float32)
+    y = jnp.asarray(rng.randint(0, 4, (3,)), jnp.int32)
+    H = jnp.asarray(np.eye(4) + 0.1, jnp.float32)
+    layer = make_layer(
+        'layer { name: "i" type: "InfogainLoss" '
+        'bottom: "p" bottom: "y" bottom: "H" top: "l" }'
+    )
+    check_layer_grad(layer, [p, y, H])

@@ -184,3 +184,34 @@ def test_db_minibatches_remainder_kept(tmp_path):
     batches = list(db_minibatches(p, 2, drop_remainder=False))
     assert [len(b["label"]) for b in batches] == [2, 2, 1]
     assert sum(len(b["label"]) for b in batches) == 5
+
+
+def test_augmenter_concurrent_callers_match_serial():
+    """Race stress: the multithreaded C++ augmenter must be reentrant —
+    concurrent transform_batch calls (each itself multithreaded) produce
+    exactly the serial results (SURVEY §5: thread safety by construction;
+    the reference relies on BlockingQueue/InternalThread isolation)."""
+    import concurrent.futures
+
+    from sparknet_tpu import native
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+
+    rs = np.random.RandomState(3)
+    batches = [
+        (rs.rand(8, 3, 16, 16) * 255).astype(np.uint8) for _ in range(12)
+    ]
+
+    def run(i):
+        return native.transform_batch(
+            batches[i], mean=None, mean_values=(10.0, 20.0, 30.0),
+            scale=0.5, crop=12, mirror=True, train=True,
+            seed=(i + 1) << 32,
+        )
+
+    serial = [run(i) for i in range(len(batches))]
+    with concurrent.futures.ThreadPoolExecutor(max_workers=6) as ex:
+        parallel = list(ex.map(run, range(len(batches))))
+    for s, p in zip(serial, parallel):
+        assert np.array_equal(s, p)
