@@ -35,9 +35,13 @@ def main() -> None:
     iters = 20 if on_accel else 2
     warmup = 3 if on_accel else 1
 
-    # SPARKNET_BENCH_DTYPE=bf16 runs activations in bfloat16 (master params
-    # f32) — the TPU-native design point; default matches the baseline's f32.
-    if os.environ.get("SPARKNET_BENCH_DTYPE", "f32") in ("bf16", "bfloat16"):
+    # Mixed precision is the TPU-native design point: bf16 activations /
+    # conv+matmul FLOPs (full MXU rate on v5e; f32 matmuls are emulated at
+    # a fraction of peak), f32 master params and optimizer state.  Default
+    # to it on accelerators; SPARKNET_BENCH_DTYPE=f32 forces the baseline's
+    # full-f32 arithmetic for an apples-to-apples run.
+    dtype_env = os.environ.get("SPARKNET_BENCH_DTYPE", "bf16" if on_accel else "f32")
+    if dtype_env in ("bf16", "bfloat16"):
         from sparknet_tpu.common import set_config
 
         set_config(compute_dtype=jnp.bfloat16)

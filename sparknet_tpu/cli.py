@@ -101,12 +101,12 @@ def cmd_train(args) -> int:
     from sparknet_tpu.solvers.solver import Solver
     from sparknet_tpu.utils import EventLogger, SignalHandler, SolverAction
 
-    net_param, solver_cfg = _build_net_and_solver(args)
-    solver = Solver(solver_cfg, net_param)
     if args.snapshot and getattr(args, "weights", ""):
         # ref: caffe.cpp:161-163 "Give a snapshot to resume training or
-        # weights to finetune but not both."
+        # weights to finetune but not both." — fail before building the net
         raise SystemExit("--snapshot and --weights are mutually exclusive")
+    net_param, solver_cfg = _build_net_and_solver(args)
+    solver = Solver(solver_cfg, net_param)
     if args.snapshot:
         solver.restore(args.snapshot)
     elif getattr(args, "weights", ""):
@@ -429,8 +429,13 @@ def cmd_classify(args) -> int:
 
     image_dims = None
     if args.images_dim:
-        h, w = args.images_dim.split(",")
-        image_dims = (int(h), int(w))
+        try:
+            h, w = (int(v) for v in args.images_dim.split(","))
+        except ValueError:
+            raise SystemExit(
+                f'--images-dim must be "H,W" (got {args.images_dim!r})'
+            ) from None
+        image_dims = (h, w)
     clf = Classifier(
         args.model,
         args.weights or None,
@@ -439,13 +444,21 @@ def cmd_classify(args) -> int:
         raw_scale=args.raw_scale if args.raw_scale else None,
         channel_swap=(2, 1, 0) if args.bgr else None,
     )
+    crop_h, crop_w = clf.feed_shapes[clf.inputs[0]][2:]
+    if image_dims and (image_dims[0] < crop_h or image_dims[1] < crop_w):
+        raise SystemExit(
+            f"--images-dim {image_dims} is smaller than the net input "
+            f"({crop_h}, {crop_w}); crops would be out of bounds"
+        )
     # match the deploy net's channel count: 1-channel nets (LeNet-style)
     # get grayscale loads (pycaffe classify.py's --gray, auto-detected)
     channels = clf.feed_shapes[clf.inputs[0]][1]
     images = [load_image(p, color=channels != 1) for p in args.images]
     # single center pass by default like cpp_classification; --oversample
     # needs --images-dim larger than the crop to cut distinct crops
-    probs = clf.predict(images, oversample=args.oversample)
+    probs = clf.predict(
+        images, oversample=args.oversample and not args.center_only
+    )
     results = []
     for path, p in zip(args.images, probs):
         top = np.argsort(p)[::-1][: args.top]
@@ -669,6 +682,8 @@ def main(argv=None) -> int:
     sp.add_argument("--images-dim", default="",
                     help='resize target "H,W" before cropping '
                     "(pycaffe classify.py --images_dim)")
+    sp.add_argument("--center-only", action="store_true",
+                    help="deprecated: single center pass is now the default")
     sp.add_argument("images", nargs="+")
     sp.set_defaults(fn=cmd_classify)
 

@@ -192,3 +192,24 @@ def test_cli_classify_grayscale_mean_and_exclusive_flags(tmp_path, capsys, rng):
         main(["train", "--solver", "zoo:lenet", "--batch", "4",
               "--iterations", "1", "--snapshot", "x.npz",
               "--weights", "y.caffemodel"])
+
+
+def test_cli_classify_images_dim_validation(tmp_path, rng):
+    import pytest
+    from PIL import Image
+
+    from sparknet_tpu.cli import main
+
+    model = tmp_path / "deploy.prototxt"
+    model.write_text(DEPLOY)
+    img = tmp_path / "im.png"
+    Image.fromarray((rng.rand(16, 16, 3) * 255).astype(np.uint8)).save(img)
+    with pytest.raises(SystemExit, match="must be"):
+        main(["classify", "--model", str(model), "--images-dim", "224",
+              str(img)])
+    with pytest.raises(SystemExit, match="smaller than the net input"):
+        main(["classify", "--model", str(model), "--images-dim", "4,4",
+              str(img)])
+    # deprecated --center-only still accepted (no-op; center is default)
+    assert main(["classify", "--model", str(model), "--center-only",
+                 str(img)]) == 0
