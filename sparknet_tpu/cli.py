@@ -454,11 +454,11 @@ def cmd_classify(args) -> int:
     # get grayscale loads (pycaffe classify.py's --gray, auto-detected)
     channels = clf.feed_shapes[clf.inputs[0]][1]
     images = [load_image(p, color=channels != 1) for p in args.images]
+    if args.oversample and args.center_only:
+        raise SystemExit("--oversample and --center-only are mutually exclusive")
     # single center pass by default like cpp_classification; --oversample
     # needs --images-dim larger than the crop to cut distinct crops
-    probs = clf.predict(
-        images, oversample=args.oversample and not args.center_only
-    )
+    probs = clf.predict(images, oversample=args.oversample)
     results = []
     for path, p in zip(args.images, probs):
         top = np.argsort(p)[::-1][: args.top]

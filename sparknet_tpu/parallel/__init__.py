@@ -32,3 +32,4 @@ from sparknet_tpu.parallel.sharding import (  # noqa: F401
     ShardingRules,
 )
 from sparknet_tpu.parallel.trainer import ParallelTrainer  # noqa: F401
+from sparknet_tpu.parallel.ulysses import ulysses_self_attention  # noqa: F401

@@ -213,3 +213,6 @@ def test_cli_classify_images_dim_validation(tmp_path, rng):
     # deprecated --center-only still accepted (no-op; center is default)
     assert main(["classify", "--model", str(model), "--center-only",
                  str(img)]) == 0
+    with pytest.raises(SystemExit, match="mutually exclusive"):
+        main(["classify", "--model", str(model), "--center-only",
+              "--oversample", str(img)])
