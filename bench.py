@@ -31,7 +31,17 @@ def main() -> None:
 
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
-    batch = 256 if on_accel else 16
+    batch_env = os.environ.get("SPARKNET_BENCH_BATCH", "")
+    try:
+        batch = int(batch_env) if batch_env else 0
+    except ValueError:
+        raise SystemExit(
+            f"SPARKNET_BENCH_BATCH must be an integer (got {batch_env!r})"
+        ) from None
+    if batch < 0:
+        raise SystemExit(f"SPARKNET_BENCH_BATCH must be positive (got {batch})")
+    if not batch:
+        batch = 256 if on_accel else 16
     iters = 20 if on_accel else 2
     warmup = 3 if on_accel else 1
 

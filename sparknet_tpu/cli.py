@@ -427,6 +427,8 @@ def cmd_classify(args) -> int:
         with open(args.labels) as f:
             labels = [line.strip() for line in f if line.strip()]
 
+    if args.oversample and args.center_only:
+        raise SystemExit("--oversample and --center-only are mutually exclusive")
     image_dims = None
     if args.images_dim:
         try:
@@ -454,8 +456,6 @@ def cmd_classify(args) -> int:
     # get grayscale loads (pycaffe classify.py's --gray, auto-detected)
     channels = clf.feed_shapes[clf.inputs[0]][1]
     images = [load_image(p, color=channels != 1) for p in args.images]
-    if args.oversample and args.center_only:
-        raise SystemExit("--oversample and --center-only are mutually exclusive")
     # single center pass by default like cpp_classification; --oversample
     # needs --images-dim larger than the crop to cut distinct crops
     probs = clf.predict(images, oversample=args.oversample)

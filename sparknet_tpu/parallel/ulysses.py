@@ -16,7 +16,7 @@ Trade-off vs the ring (why both exist):
   full-sequence scores — scales to sequences where even one head's full
   attention would not fit.
 
-Requires ``num_heads %% mesh_size == 0`` (each device owns H/n heads).
+Requires ``num_heads % mesh_size == 0`` (each device owns H/n heads).
 """
 
 from __future__ import annotations
