@@ -38,7 +38,7 @@ def main() -> None:
         raise SystemExit(
             f"SPARKNET_BENCH_BATCH must be an integer (got {batch_env!r})"
         ) from None
-    if batch < 0:
+    if batch_env and batch <= 0:
         raise SystemExit(f"SPARKNET_BENCH_BATCH must be positive (got {batch})")
     if not batch:
         batch = 256 if on_accel else 16

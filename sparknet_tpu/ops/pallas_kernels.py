@@ -130,3 +130,141 @@ def lrn_across_channels(x, size, alpha, beta, k, force: str | None = None):
     if force == "pallas" and x.ndim == 4:
         return _lrn_diff(x, size, alpha, beta, k, False)
     return lrn_across_channels_xla(x, size, alpha, beta, k)
+
+
+# ---------------------------------------------------------------------------
+# Flash attention (blocked online-softmax), the long-context MXU kernel.
+# ---------------------------------------------------------------------------
+
+_BQ = 128  # query rows per block (sublane-friendly)
+_BK = 128  # key rows per inner step
+
+
+def _flash_kernel(causal: bool, sm_scale: float, num_kb: int, s_real: int,
+                  q_ref, k_ref, v_ref, o_ref):
+    """One (batch*head, q-block) cell: q_ref [1, BQ, D]; k/v refs hold the
+    full [1, S, D] fiber in VMEM; the [BQ, S] score matrix is never
+    materialized — K is walked in BK-wide steps with a running max and
+    denominator (the flash-attention recurrence)."""
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # [BQ, D]
+    D = q.shape[-1]
+
+    def step(j, carry):
+        o_acc, m, l = carry
+        k = k_ref[0, pl.dslice(j * _BK, _BK), :].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(j * _BK, _BK), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [BQ, BK]
+        cols = j * _BK + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        # padded key columns (beyond the true sequence) never participate
+        s = jnp.where(cols < s_real, s, -1e30)
+        if causal:
+            rows = qi * _BQ + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            s = jnp.where(rows >= cols, s, -1e30)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=1)
+        o_new = o_acc * corr[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((q.shape[0], D), jnp.float32)
+    m0 = jnp.full((q.shape[0],), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((q.shape[0],), jnp.float32)
+    if causal:
+        # blocks strictly above the diagonal contribute nothing; stop after
+        # the q block's own diagonal block
+        upper = jnp.minimum((qi + 1) * _BQ + _BK - 1, num_kb * _BK) // _BK
+    else:
+        upper = num_kb
+    o_acc, m, l = jax.lax.fori_loop(0, upper, step, (o0, m0, l0))
+    o_ref[0] = (o_acc / l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_pallas(q, k, v, causal: bool, interpret: bool = False):
+    B, H, S, D = q.shape
+    pad_q = (-S) % _BQ
+    pad_k = (-S) % _BK
+    qf = q.reshape(B * H, S, D)
+    kf = k.reshape(B * H, S, D)
+    vf = v.reshape(B * H, S, D)
+    if pad_q:
+        qf = jnp.pad(qf, ((0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        # zero-pad K/V; the kernel masks padded columns by index
+        kf = jnp.pad(kf, ((0, 0), (0, pad_k), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, pad_k), (0, 0)))
+    Sq, Sk = S + pad_q, S + pad_k
+    kernel = functools.partial(
+        _flash_kernel, causal, 1.0 / float(D) ** 0.5, Sk // _BK, S
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+        grid=(B * H, Sq // _BQ),
+        in_specs=[
+            pl.BlockSpec((1, _BQ, D), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, Sk, D), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, Sk, D), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, _BQ, D), lambda bh, i: (bh, i, 0)),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out[:, :S].reshape(B, H, S, D)
+
+
+def attention_xla(q, k, v, causal: bool = False):
+    """Unblocked stable-softmax attention (the oracle + backward path)."""
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        S = q.shape[2]
+        mask = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
+        s = jnp.where(mask, s, -1e30)
+    return jnp.einsum(
+        "bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1),
+        v.astype(jnp.float32),
+    ).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_diff(q, k, v, causal, interpret):
+    return _flash_pallas(q, k, v, causal, interpret=interpret)
+
+
+def _flash_diff_fwd(q, k, v, causal, interpret):
+    return _flash_pallas(q, k, v, causal, interpret=interpret), (q, k, v)
+
+
+def _flash_diff_bwd(causal, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda a, b, c: attention_xla(a, b, c, causal), q, k, v)
+    return vjp(g)
+
+
+_flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False, force: str | None = None):
+    """Blocked attention for [B, H, S, D]; ``force`` = 'pallas' |
+    'interpret' | 'xla' | None (None consults ``SPARKNET_ATTN_IMPL``,
+    default xla).  Differentiable on every path; the pallas forward pairs
+    with an XLA-derived backward like the LRN kernel."""
+    import os
+
+    if force is None:
+        force = os.environ.get("SPARKNET_ATTN_IMPL", "xla")
+    if force == "xla" or not _HAS_PALLAS:
+        return attention_xla(q, k, v, causal)
+    if force == "interpret":
+        return _flash_diff(q, k, v, causal, True)
+    if force == "pallas":
+        return _flash_diff(q, k, v, causal, False)
+    return attention_xla(q, k, v, causal)

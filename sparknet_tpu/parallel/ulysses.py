@@ -27,7 +27,6 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from sparknet_tpu.parallel.mesh import shard_map as _shard_map
-from sparknet_tpu.parallel.ring_attention import reference_attention
 
 
 def ulysses_attention(q, k, v, axis_name: str, causal: bool = False):
@@ -37,10 +36,14 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False):
     full attention per head group; all_to_all #2: scatter sequence /
     gather heads -> [B, H, S/n, D].
     """
+    from sparknet_tpu.ops.pallas_kernels import flash_attention
+
     a2a = partial(jax.lax.all_to_all, axis_name=axis_name, tiled=True)
     # split the head axis across devices, concatenate the sequence axis
     qh, kh, vh = (a2a(x, split_axis=1, concat_axis=2) for x in (q, k, v))
-    oh = reference_attention(qh, kh, vh, causal=causal)
+    # local attention is pluggable: SPARKNET_ATTN_IMPL=pallas runs the
+    # blocked flash kernel on the MXU; default is the XLA formulation
+    oh = flash_attention(qh, kh, vh, causal=causal)
     # inverse: split sequence back out, concatenate heads home
     return a2a(oh, split_axis=2, concat_axis=1)
 
@@ -71,11 +74,18 @@ def ulysses_self_attention(
             f"{seq_axis!r} mesh axis size ({n})"
         )
     spec = P(None, None, seq_axis, None)
+    # a pallas_call inside the body can't annotate varying-mesh-axes on its
+    # out_shape, which jax's vma check requires — disable the check ONLY
+    # when the flash kernel is routed in; the default XLA path keeps it
+    import os
+
+    attn_impl = os.environ.get("SPARKNET_ATTN_IMPL", "xla")
     fn = _shard_map(
         partial(ulysses_attention, axis_name=seq_axis, causal=causal),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        check_vma=attn_impl == "xla",
     )
     sharding = NamedSharding(mesh, spec)
     q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))

@@ -33,12 +33,19 @@ def run_variant(dtype: str, batch: int, timeout: int = 560) -> dict:
     except subprocess.TimeoutExpired:
         return {"dtype": dtype, "batch": batch, "error": "timeout"}
     if out.returncode != 0:
+        tail = (out.stderr or out.stdout).strip().splitlines()
         return {
             "dtype": dtype, "batch": batch,
-            "error": (out.stderr or out.stdout).strip().splitlines()[-1][:200],
+            "error": tail[-1][:200] if tail else f"exit {out.returncode}",
         }
-    line = out.stdout.strip().splitlines()[-1]
-    rec = json.loads(line)
+    lines = out.stdout.strip().splitlines()
+    try:
+        rec = json.loads(lines[-1]) if lines else {}
+    except json.JSONDecodeError:
+        rec = {}
+    if "value" not in rec:
+        return {"dtype": dtype, "batch": batch,
+                "error": f"no JSON result in output: {lines[-1][:200] if lines else ''}"}
     rec.update({"dtype": dtype, "batch": batch})
     return rec
 
