@@ -567,6 +567,32 @@ def cmd_upgrade_net_proto_text(args) -> int:
     return 0
 
 
+def cmd_upgrade_net_proto_binary(args) -> int:
+    """Legacy binary NetParameter (V1LayerParameter records) -> current
+    schema (ref: caffe/tools/upgrade_net_proto_binary.cpp).  Wire-level
+    field remapping: connectivity, include/exclude rules, typed params,
+    loss weights, and blobs all pass through byte-identically; the type
+    enum becomes the V2 string and blobs_lr/weight_decay fold into
+    ParamSpec messages."""
+    from sparknet_tpu.proto.binary import loads_caffemodel, upgrade_net_binary
+
+    with open(args.input, "rb") as f:
+        raw = f.read()
+    out_bytes, upgraded = upgrade_net_binary(raw)
+    model = loads_caffemodel(out_bytes)
+    if not model.layers:
+        raise SystemExit(f"no layers decoded from {args.input}")
+    with open(args.output, "wb") as f:
+        f.write(out_bytes)
+    print(json.dumps({
+        "out": args.output,
+        "layers": len(model.layers),
+        "upgraded_v1_records": upgraded,
+        "blobs": sum(len(l.blobs) for l in model.layers),
+    }))
+    return 0
+
+
 def cmd_upgrade_solver_proto_text(args) -> int:
     """Deprecated solver_type enum -> type string (ref:
     caffe/tools/upgrade_solver_proto_text.cpp)."""
@@ -700,11 +726,15 @@ def main(argv=None) -> int:
     sp.add_argument("outfile")
     sp.set_defaults(fn=cmd_create_labelfile)
 
-    for cmd, fn in (
-        ("upgrade_net_proto_text", cmd_upgrade_net_proto_text),
-        ("upgrade_solver_proto_text", cmd_upgrade_solver_proto_text),
+    for cmd, fn, help_ in (
+        ("upgrade_net_proto_text", cmd_upgrade_net_proto_text,
+         "migrate a legacy net prototxt (V0/V1 -> current)"),
+        ("upgrade_net_proto_binary", cmd_upgrade_net_proto_binary,
+         "migrate a legacy binary NetParameter/caffemodel (V1 -> current)"),
+        ("upgrade_solver_proto_text", cmd_upgrade_solver_proto_text,
+         "migrate a legacy solver prototxt (solver_type enum -> type)"),
     ):
-        sp = sub.add_parser(cmd, help="migrate a legacy prototxt")
+        sp = sub.add_parser(cmd, help=help_)
         sp.add_argument("input")
         sp.add_argument("output")
         sp.set_defaults(fn=fn)

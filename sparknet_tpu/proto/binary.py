@@ -110,18 +110,19 @@ def _decode_blob(buf: bytes) -> np.ndarray:
 
 
 _V1_TYPE_NAMES = {
-    # V1LayerParameter.LayerType enum values needed to name imported params
-    # (ref: caffe.proto:1051-1092); only param-carrying types matter here.
-    4: "Convolution", 14: "InnerProduct", 39: "Deconvolution",
-    13: "ImageData", 12: "HDF5Data", 5: "Data", 24: "WindowData",
-    18: "Pooling", 15: "LRN", 19: "ReLU", 6: "Dropout",
-    21: "SoftmaxWithLoss", 1: "Accuracy", 3: "Concat", 33: "Slice",
-    36: "Split", 8: "Flatten", 17: "MVN", 25: "Eltwise", 30: "ArgMax",
-    2: "BNLL", 26: "Power", 22: "Sigmoid", 23: "TanH", 35: "AbsVal",
-    7: "EuclideanLoss", 28: "HingeLoss", 29: "MemoryData",
-    9: "InfogainLoss", 10: "Im2col", 16: "MultinomialLogisticLoss",
-    20: "Softmax", 27: "SigmoidCrossEntropyLoss", 31: "Threshold",
-    32: "Window", 34: "TanH", 40: "ContrastiveLoss",
+    # V1LayerParameter.LayerType enum, verbatim from the reference schema
+    # (ref: caffe.proto "enum LayerType" inside V1LayerParameter), mapped
+    # to the V2 type strings (UpgradeV1LayerType).
+    1: "Accuracy", 2: "BNLL", 3: "Concat", 4: "Convolution", 5: "Data",
+    6: "Dropout", 7: "EuclideanLoss", 8: "Flatten", 9: "HDF5Data",
+    10: "HDF5Output", 11: "Im2col", 12: "ImageData", 13: "InfogainLoss",
+    14: "InnerProduct", 15: "LRN", 16: "MultinomialLogisticLoss",
+    17: "Pooling", 18: "ReLU", 19: "Sigmoid", 20: "Softmax",
+    21: "SoftmaxWithLoss", 22: "Split", 23: "TanH", 24: "WindowData",
+    25: "Eltwise", 26: "Power", 27: "SigmoidCrossEntropyLoss",
+    28: "HingeLoss", 29: "MemoryData", 30: "ArgMax", 31: "Threshold",
+    32: "DummyData", 33: "Slice", 34: "MVN", 35: "AbsVal", 36: "Silence",
+    37: "ContrastiveLoss", 38: "Exp", 39: "Deconvolution",
 }
 
 
@@ -229,3 +230,131 @@ def dumps_caffemodel(model: CaffeModel) -> bytes:
 def save_caffemodel(path: str, model: CaffeModel) -> None:
     with open(path, "wb") as f:
         f.write(dumps_caffemodel(model))
+
+
+# ---------------------------------------------------------------------------
+# Binary V1 -> V2 NetParameter upgrade (ref: tools/upgrade_net_proto_binary
+# + UpgradeV1LayerParameter): field-number remapping over the raw wire, so
+# every layer field — connectivity, include/exclude rules, typed params,
+# loss weights, blobs — survives byte-identically.
+# ---------------------------------------------------------------------------
+
+# V1LayerParameter field -> LayerParameter field for fields whose payload
+# is wire-compatible (same sub-message type or same scalar type).
+_V1_TO_V2_FIELDS = {
+    2: 3,    # bottom
+    3: 4,    # top
+    4: 1,    # name
+    32: 8,   # include
+    33: 9,   # exclude
+    6: 7,    # blobs
+    35: 5,   # loss_weight
+    36: 100,  # transform_param
+    42: 101,  # loss_param
+    27: 102,  # accuracy_param
+    23: 103,  # argmax_param
+    9: 104,   # concat_param
+    40: 105,  # contrastive_loss_param
+    10: 106,  # convolution_param
+    11: 107,  # data_param
+    12: 108,  # dropout_param
+    26: 109,  # dummy_data_param
+    24: 110,  # eltwise_param
+    41: 111,  # exp_param
+    13: 112,  # hdf5_data_param
+    14: 113,  # hdf5_output_param
+    29: 114,  # hinge_loss_param
+    15: 115,  # image_data_param
+    16: 116,  # infogain_loss_param
+    17: 117,  # inner_product_param
+    18: 118,  # lrn_param
+    22: 119,  # memory_data_param
+    34: 120,  # mvn_param
+    19: 121,  # pooling_param
+    21: 122,  # power_param
+    30: 123,  # relu_param
+    38: 124,  # sigmoid_param
+    39: 125,  # softmax_param
+    31: 126,  # slice_param
+    37: 127,  # tanh_param
+    25: 128,  # threshold_param
+    20: 129,  # window_data_param
+}
+
+
+def _emit(field: int, wt: int, val) -> bytes:
+    if wt == _LEN:
+        return _len_field(field, val)
+    if wt == _VARINT:
+        return _tag(field, _VARINT) + _varint(val)
+    if wt == _I32:
+        return _tag(field, _I32) + struct.pack("<i", val)
+    return _tag(field, _I64) + struct.pack("<q", val)
+
+
+def upgrade_v1_layer_record(rec: bytes) -> bytes:
+    """One serialized V1LayerParameter -> serialized LayerParameter.
+
+    The enum ``type`` becomes the V2 string; repeated ``param`` (share
+    names) / ``blobs_lr`` / ``weight_decay`` fold into ParamSpec messages
+    (name=1, lr_mult=3, decay_mult=4); everything else remaps field
+    numbers with the payload untouched."""
+    out = b""
+    names: list[bytes] = []
+    lrs: list[int] = []      # raw fixed32 bit patterns
+    decays: list[int] = []
+    for field, wt, val in _scan(rec):
+        if field == 5 and wt == _VARINT:  # type enum -> string
+            tname = _V1_TYPE_NAMES.get(val)
+            if tname is None:
+                raise ValueError(f"unknown V1 LayerType enum value {val}")
+            out += _len_field(2, tname.encode())
+        elif field == 1001 and wt == _LEN:  # param share name
+            names.append(val)
+        elif field in (7, 8):  # blobs_lr / weight_decay (repeated float,
+            # possibly packed): collect raw fixed32 bit patterns
+            dst = lrs if field == 7 else decays
+            if wt == _LEN:
+                for off in range(0, len(val), 4):
+                    dst.append(struct.unpack_from("<i", val, off)[0])
+            else:
+                dst.append(val)
+        elif field == 1 and wt == _LEN:
+            raise ValueError(
+                "nested V0LayerParameter found — upgrade the model through "
+                "the text path (upgrade_net_proto_text) first"
+            )
+        elif field == 1002:
+            continue  # blob_share_mode: no V2 equivalent on this path
+        else:
+            v2 = _V1_TO_V2_FIELDS.get(field)
+            if v2 is not None:
+                out += _emit(v2, wt, val)
+            # unknown/unmapped fields are dropped (the reference's protobuf
+            # would keep them as unknown fields; none exist in the schema)
+    n = max(len(names), len(lrs), len(decays))
+    for i in range(n):
+        pm = b""
+        if i < len(names) and names[i]:
+            pm += _len_field(1, names[i])
+        if i < len(lrs):
+            pm += _tag(3, _I32) + struct.pack("<i", lrs[i])
+        if i < len(decays):
+            pm += _tag(4, _I32) + struct.pack("<i", decays[i])
+        out += _len_field(6, pm)
+    return out
+
+
+def upgrade_net_binary(buf: bytes) -> tuple[bytes, int]:
+    """Serialized NetParameter with V1 ``layers`` (field 2) -> current
+    schema (``layer`` field 100).  Net-level fields pass through.
+    Returns (upgraded bytes, number of upgraded V1 records)."""
+    out = b""
+    upgraded = 0
+    for field, wt, val in _scan(buf):
+        if field == 2 and wt == _LEN:
+            out += _len_field(100, upgrade_v1_layer_record(val))
+            upgraded += 1
+        else:
+            out += _emit(field, wt, val)
+    return out, upgraded
