@@ -299,16 +299,17 @@ def upgrade_v1_layer_record(rec: bytes) -> bytes:
     names) / ``blobs_lr`` / ``weight_decay`` fold into ParamSpec messages
     (name=1, lr_mult=3, decay_mult=4); everything else remaps field
     numbers with the payload untouched."""
-    out = b""
+    parts: list[bytes] = []
     names: list[bytes] = []
     lrs: list[int] = []      # raw fixed32 bit patterns
     decays: list[int] = []
+    share_modes: list[int] = []
     for field, wt, val in _scan(rec):
         if field == 5 and wt == _VARINT:  # type enum -> string
             tname = _V1_TYPE_NAMES.get(val)
             if tname is None:
                 raise ValueError(f"unknown V1 LayerType enum value {val}")
-            out += _len_field(2, tname.encode())
+            parts.append(_len_field(2, tname.encode()))
         elif field == 1001 and wt == _LEN:  # param share name
             names.append(val)
         elif field in (7, 8):  # blobs_lr / weight_decay (repeated float,
@@ -324,37 +325,42 @@ def upgrade_v1_layer_record(rec: bytes) -> bytes:
                 "nested V0LayerParameter found — upgrade the model through "
                 "the text path (upgrade_net_proto_text) first"
             )
-        elif field == 1002:
-            continue  # blob_share_mode: no V2 equivalent on this path
+        elif field == 1002:  # blob_share_mode -> ParamSpec.share_mode
+            if wt == _LEN:
+                share_modes.extend(_packed_varints(val))
+            else:
+                share_modes.append(val)
         else:
             v2 = _V1_TO_V2_FIELDS.get(field)
             if v2 is not None:
-                out += _emit(v2, wt, val)
+                parts.append(_emit(v2, wt, val))
             # unknown/unmapped fields are dropped (the reference's protobuf
             # would keep them as unknown fields; none exist in the schema)
-    n = max(len(names), len(lrs), len(decays))
+    n = max(len(names), len(lrs), len(decays), len(share_modes))
     for i in range(n):
-        pm = b""
+        pm: list[bytes] = []
         if i < len(names) and names[i]:
-            pm += _len_field(1, names[i])
+            pm.append(_len_field(1, names[i]))
+        if i < len(share_modes):
+            pm.append(_tag(2, _VARINT) + _varint(share_modes[i]))
         if i < len(lrs):
-            pm += _tag(3, _I32) + struct.pack("<i", lrs[i])
+            pm.append(_tag(3, _I32) + struct.pack("<i", lrs[i]))
         if i < len(decays):
-            pm += _tag(4, _I32) + struct.pack("<i", decays[i])
-        out += _len_field(6, pm)
-    return out
+            pm.append(_tag(4, _I32) + struct.pack("<i", decays[i]))
+        parts.append(_len_field(6, b"".join(pm)))
+    return b"".join(parts)
 
 
 def upgrade_net_binary(buf: bytes) -> tuple[bytes, int]:
     """Serialized NetParameter with V1 ``layers`` (field 2) -> current
     schema (``layer`` field 100).  Net-level fields pass through.
     Returns (upgraded bytes, number of upgraded V1 records)."""
-    out = b""
+    parts: list[bytes] = []
     upgraded = 0
     for field, wt, val in _scan(buf):
         if field == 2 and wt == _LEN:
-            out += _len_field(100, upgrade_v1_layer_record(val))
+            parts.append(_len_field(100, upgrade_v1_layer_record(val)))
             upgraded += 1
         else:
-            out += _emit(field, wt, val)
-    return out, upgraded
+            parts.append(_emit(field, wt, val))
+    return b"".join(parts), upgraded

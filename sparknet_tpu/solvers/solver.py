@@ -338,7 +338,14 @@ class Solver:
     # ------------------------------------------------------------------
     # Snapshot/restore (ref: Solver::Snapshot/Restore solver.cpp:447-519 +
     # SGDSolver history snapshot sgd_solver.cpp:242+).
-    def save(self, prefix: str) -> str:
+    def save(self, prefix: str, format: str = "npz") -> str:
+        """``format="npz"``: single-host flat archive. ``format="orbax"``:
+        sharded pod-scale checkpoint (each process writes its own shards;
+        restores with the live shardings)."""
+        if format == "orbax":
+            from sparknet_tpu.solvers.orbax_io import save_orbax
+
+            return save_orbax(self, prefix)
         path = f"{prefix}.solverstate.npz"
         flat: dict[str, np.ndarray] = {"__iter__": np.asarray(self.iter)}
         flat["__meta__"] = np.frombuffer(
@@ -358,6 +365,11 @@ class Solver:
         return path
 
     def restore(self, path: str) -> None:
+        if path.endswith(".orbax") or os.path.isdir(path):
+            from sparknet_tpu.solvers.orbax_io import restore_orbax
+
+            restore_orbax(self, path)
+            return
         data = np.load(path)
         meta = json.loads(bytes(data["__meta__"]).decode()) if "__meta__" in data.files else {}
         saved_type = meta.get("solver_type")

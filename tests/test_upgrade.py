@@ -291,6 +291,8 @@ class TestBinaryUpgrade:
             + _tag(7, 5) + _struct.pack("<f", 2.0)
             + _tag(8, 5) + _struct.pack("<f", 1.0)
             + _tag(8, 5) + _struct.pack("<f", 0.0)
+            + _tag(1002, 0) + _varint(0)   # blob_share_mode STRICT
+            + _tag(1002, 0) + _varint(1)   # blob_share_mode PERMISSIVE
             + _len_field(10, conv_param)
             + _len_field(32, include_rule)
         )
@@ -338,6 +340,11 @@ class TestBinaryUpgrade:
         assert len(pspecs) == 2
         lr2 = [v for f, _, v in _scan(pspecs[1]) if f == 3][0]
         assert _struct.unpack("<f", _struct.pack("<i", lr2))[0] == 2.0
+        # blob_share_mode folded to ParamSpec.share_mode (field 2)
+        modes = [
+            [v for f, _, v in _scan(pm) if f == 2] for pm in pspecs
+        ]
+        assert modes == [[0], [1]]
         pool_fields = {f: v for f, _, v in _scan(layers[1])}
         assert 121 in pool_fields                # pooling_param moved
 
