@@ -1,0 +1,78 @@
+"""In-graph multi-head self-attention — the long-context layer type.
+
+The reference is CNN-only (SURVEY §5: attention/sequence work absent;
+RNNs were future work, ROADMAP.md:12), but this framework treats
+long-context as first-class: beyond the sequence-parallel primitives
+(`parallel/ring_attention.py`, `parallel/ulysses.py`), this layer makes
+attention available through the ordinary prototxt/DSL -> compiler path so
+sequence models build, train, and snapshot exactly like the CNN zoo.
+
+Prototxt surface::
+
+    layer {
+      name: "attn" type: "MultiHeadAttention" bottom: "x" top: "y"
+      attention_param { num_heads: 8 causal: true }
+    }
+
+Input/output blobs are [B, S, E].  Params follow Caffe blob order:
+[W_qkv (3E, E), b_qkv (3E), W_out (E, E), b_out (E)] — importable/
+exportable through every weight path (caffemodel, HDF5, orbax).  The
+attention core routes through :func:`flash_attention`, so
+``SPARKNET_ATTN_IMPL=pallas`` drops the blocked MXU kernel in unchanged.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from sparknet_tpu.ops.base import Layer, LayerOutput
+from sparknet_tpu.ops.fillers import fill
+from sparknet_tpu.ops.pallas_kernels import flash_attention
+from sparknet_tpu.ops.registry import register
+from sparknet_tpu.proto.text_format import Message
+
+
+@register
+class MultiHeadAttentionLayer(Layer):
+    TYPE = "MultiHeadAttention"
+
+    def __init__(self, lp, phase):
+        super().__init__(lp, phase)
+        p = lp.get_msg("attention_param")
+        self.num_heads = p.get_int("num_heads", 1)
+        self.causal = p.get_bool("causal", False)
+        self.weight_filler = (
+            p.get_msg("weight_filler")
+            if p.has("weight_filler")
+            else Message().set("type", "xavier")
+        )
+
+    def init(self, key, in_shapes):
+        (B, S, E) = in_shapes[0]
+        if E % self.num_heads != 0:
+            raise ValueError(
+                f"attention embed dim ({E}) must be divisible by "
+                f"num_heads ({self.num_heads})"
+            )
+        k1, k2 = jax.random.split(key)
+        w_qkv = fill(self.weight_filler, k1, (3 * E, E))
+        b_qkv = jnp.zeros((3 * E,), jnp.float32)
+        w_out = fill(self.weight_filler, k2, (E, E))
+        b_out = jnp.zeros((E,), jnp.float32)
+        return [w_qkv, b_qkv, w_out, b_out], {}
+
+    def apply(self, params, state, inputs, *, train, rng=None) -> LayerOutput:
+        x = inputs[0]  # [B, S, E]
+        w_qkv, b_qkv, w_out, b_out = params
+        B, S, E = x.shape
+        H = self.num_heads
+        D = E // H
+        qkv = jnp.einsum("bse,fe->bsf", x, w_qkv) + b_qkv  # [B, S, 3E]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        # [B, S, E] -> [B, H, S, D]
+        split = lambda t: t.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+        o = flash_attention(split(q), split(k), split(v), causal=self.causal)
+        o = o.transpose(0, 2, 1, 3).reshape(B, S, E)
+        y = jnp.einsum("bse,fe->bsf", o, w_out) + b_out
+        return LayerOutput(outputs=[y])

@@ -346,6 +346,8 @@ class Solver:
             from sparknet_tpu.solvers.orbax_io import save_orbax
 
             return save_orbax(self, prefix)
+        if format != "npz":
+            raise ValueError(f"unknown snapshot format {format!r} (npz|orbax)")
         path = f"{prefix}.solverstate.npz"
         flat: dict[str, np.ndarray] = {"__iter__": np.asarray(self.iter)}
         flat["__meta__"] = np.frombuffer(
