@@ -161,8 +161,14 @@ def cmd_train(args) -> int:
                         break
             trainer.sync_to_solver()
         else:
+            display = solver_cfg.display
             with SignalHandler() as sig:
                 def hook(it, loss):
+                    # mirror the solver's display cadence into the event log
+                    # so parse_log gets train-table rows (the reference's
+                    # single glog stream carries both)
+                    if display and it % display == 0:
+                        log(f"loss: {loss:.5f}", i=it)
                     action = sig.check()
                     if action is SolverAction.SNAPSHOT:
                         solver.save(f"tpunet_iter_{it}")
@@ -175,7 +181,7 @@ def cmd_train(args) -> int:
                     log("stopped by signal", i=solver.iter)
     if args.test_iters:
         scores = solver.test(args.test_iters, test_fn)
-        log(f"scores: {scores}")
+        log(f"scores: {scores}", i=solver.iter)
     out = solver.save(args.output or "tpunet_final")
     log(f"saved {out}")
     return 0
@@ -606,6 +612,26 @@ def cmd_upgrade_solver_proto_text(args) -> int:
     return 0
 
 
+def cmd_parse_log(args) -> int:
+    """ref: tools/extra/parse_log.py — training log -> .train/.test CSVs."""
+    from sparknet_tpu.utils.log_parse import parse_log_to_csv
+
+    train_path, test_path = parse_log_to_csv(
+        args.logfile, args.out_dir, delimiter=args.delimiter
+    )
+    print(json.dumps({"train": train_path, "test": test_path}))
+    return 0
+
+
+def _cmd_deprecated(replacement):
+    def fn(args) -> int:
+        # ref: tools/{train,test,finetune}_net.cpp, net_speed_benchmark.cpp —
+        # LOG(FATAL) stubs pointing at the brew subcommand
+        raise SystemExit(f"Deprecated. Use tpunet {replacement} instead.")
+
+    return fn
+
+
 def cmd_device_query(args) -> int:
     """ref: caffe.cpp:110-150 device_query()."""
     import jax
@@ -738,6 +764,23 @@ def main(argv=None) -> int:
         sp.add_argument("input")
         sp.add_argument("output")
         sp.set_defaults(fn=fn)
+
+    sp = sub.add_parser("parse_log", help="training log -> .train/.test CSVs")
+    sp.add_argument("logfile")
+    sp.add_argument("out_dir", nargs="?", default=None,
+                    help="output directory (default: next to the log)")
+    sp.add_argument("--delimiter", default=",")
+    sp.set_defaults(fn=cmd_parse_log)
+
+    for cmd, repl in (
+        ("train_net", "train --solver=... [--snapshot=...]"),
+        ("finetune_net", "train --solver=... [--weights=...]"),
+        ("test_net", "test --solver=... [--snapshot=...]"),
+        ("net_speed_benchmark", "time --solver=... [--iterations=50]"),
+    ):
+        sp = sub.add_parser(cmd, help=f"deprecated: use tpunet {repl.split()[0]}")
+        sp.add_argument("ignored", nargs="*")
+        sp.set_defaults(fn=_cmd_deprecated(repl))
 
     sp = sub.add_parser("device_query", help="show devices")
     sp.set_defaults(fn=cmd_device_query)

@@ -1,5 +1,6 @@
 """Aux subsystems: event logging, signal control, timing/profiling."""
 
 from sparknet_tpu.utils.event_log import EventLogger  # noqa: F401
+from sparknet_tpu.utils.log_parse import parse_log, parse_log_to_csv, save_csv  # noqa: F401
 from sparknet_tpu.utils.signals import SignalHandler, SolverAction  # noqa: F401
 from sparknet_tpu.utils.timing import Timer, time_layers  # noqa: F401

@@ -439,3 +439,82 @@ def test_cli_train_finetune_weights(tmp_path, capsys, monkeypatch):
     )
     assert "conv1" in loaded
     assert np.array_equal(np.asarray(params["conv1"][0]), w_donor)
+
+
+def test_parse_log_tables(tmp_path):
+    """ref: tools/extra/parse_log.py — train/test tables from a mixed log."""
+    from sparknet_tpu.utils.log_parse import parse_log, parse_log_to_csv
+
+    log = tmp_path / "tpunet_train_123.txt"
+    log.write_text(
+        "start 123\n"
+        "0.100: profiling -> /tmp/x\n"
+        "Iteration 100, loss = 2.2984, lr = 0.001\n"
+        "1.500: loss: 2.10000, i = 150\n"
+        "Iteration 200, loss = 0.68188, lr = 0.0005\n"
+        "2.750: scores: {'accuracy': 0.727, 'loss': 0.6228}, i = 200\n"
+        "3.000: scores: {'accuracy': 0.939, 'loss': 0.2027}\n"
+        "garbage line that matches nothing\n"
+        "192.168.0.1: connection refused\n"
+    )
+    train_rows, test_rows = parse_log(str(log))
+    assert [r["NumIters"] for r in train_rows] == [100, 150, 200]
+    assert train_rows[0]["LearningRate"] == 0.001
+    assert train_rows[1] == {"NumIters": 150, "loss": 2.1, "Seconds": 1.5}
+    assert train_rows[2]["loss"] == 0.68188
+    assert [r["NumIters"] for r in test_rows] == [200, 200]
+    assert test_rows[0]["accuracy"] == 0.727
+    assert test_rows[1]["Seconds"] == 3.0
+
+    train_csv, test_csv = parse_log_to_csv(str(log))
+    header = open(train_csv).readline().strip().split(",")
+    assert header[0] == "NumIters" and "loss" in header
+    rows = open(test_csv).read().strip().splitlines()
+    assert len(rows) == 3  # header + 2
+    assert rows[0].startswith("NumIters,Seconds,accuracy")
+
+    # stdout captures carry both the display line and its event-log mirror:
+    # one merged row per iteration, display fields winning
+    log2 = tmp_path / "stdout_capture.log"
+    log2.write_text(
+        "Iteration 100, loss = 2.0, lr = 0.001\n"
+        "5.000: loss: 2.10000, i = 100\n"
+    )
+    merged, _ = parse_log(str(log2))
+    assert merged == [
+        {"NumIters": 100, "loss": 2.0, "LearningRate": 0.001, "Seconds": 5.0}
+    ]
+
+    # out_dir that does not exist yet is created
+    t2, _ = parse_log_to_csv(str(log2), str(tmp_path / "results"))
+    assert open(t2).readline().startswith("NumIters")
+
+
+def test_cli_parse_log_roundtrip(tmp_path, monkeypatch, capsys):
+    """End to end: tpunet train writes a log parse_log can tabulate."""
+    import glob
+
+    from sparknet_tpu.cli import main
+
+    monkeypatch.chdir(tmp_path)
+    assert main([
+        "train", "--solver", "zoo:lenet", "--batch", "8",
+        "--data", "synthetic", "--iterations", "3",
+        "--test-iters", "2", "--output", "final",
+    ]) == 0
+    (logfile,) = glob.glob("tpunet_train_*.txt")
+    capsys.readouterr()
+    assert main(["parse_log", logfile, str(tmp_path)]) == 0
+    paths = json.loads(capsys.readouterr().out.strip())
+    test_rows = open(paths["test"]).read().strip().splitlines()
+    assert len(test_rows) == 2  # header + the --test-iters scores line
+    assert "accuracy" in test_rows[0]
+    assert test_rows[1].startswith("3,")  # scores stamped with i=<final iter>
+
+
+def test_cli_deprecated_tools():
+    from sparknet_tpu.cli import main
+
+    for cmd in ("train_net", "finetune_net", "test_net", "net_speed_benchmark"):
+        with pytest.raises(SystemExit, match="Deprecated"):
+            main([cmd, "whatever.prototxt"])
