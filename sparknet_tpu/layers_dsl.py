@@ -215,6 +215,36 @@ def AccuracyLayer(
     return m
 
 
+def MultiHeadAttentionLayer(
+    name: str,
+    bottoms: Sequence[str],
+    num_heads: int,
+    causal: bool = False,
+    top: str | None = None,
+) -> Message:
+    """Sequence-model extra (no reference analog; ops/attention.py)."""
+    m = _layer(name, "MultiHeadAttention", bottoms, [top] if top else None)
+    p = Message().set("num_heads", num_heads)
+    if causal:
+        p.set("causal", True)
+    return m.set("attention_param", p)
+
+
+def MoELayer(
+    name: str,
+    bottoms: Sequence[str],
+    num_experts: int,
+    hidden_dim: int = 0,
+    top: str | None = None,
+) -> Message:
+    """Mixture-of-experts extra (no reference analog; ops/moe.py)."""
+    m = _layer(name, "MoE", bottoms, [top] if top else None)
+    p = Message().set("num_experts", num_experts)
+    if hidden_dim:
+        p.set("hidden_dim", hidden_dim)
+    return m.set("moe_param", p)
+
+
 def NetParam(name: str, *layers: Message) -> Message:
     """Aggregate layers into a NetParameter (ref: Layers.scala:130-137)."""
     net = Message().set("name", name)

@@ -16,3 +16,4 @@ from sparknet_tpu.ops import blocks  # noqa: F401
 from sparknet_tpu.ops import loss  # noqa: F401
 from sparknet_tpu.ops import python_layer  # noqa: F401
 from sparknet_tpu.ops import attention  # noqa: F401
+from sparknet_tpu.ops import moe  # noqa: F401

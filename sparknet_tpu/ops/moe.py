@@ -1,0 +1,98 @@
+"""In-graph mixture-of-experts FFN — the expert-parallel layer type.
+
+The reference has no MoE or expert parallelism (ref: SURVEY §2.3.5 —
+its parallelism inventory ends at data parallelism); like
+`MultiHeadAttention`, this is a TPU-first-class extra wired through the
+ordinary prototxt/DSL -> compiler path so expert models build, train and
+snapshot like the CNN zoo.  The distributed dispatch lives in
+`parallel/expert.py` (tokens `all_to_all` over an ``expert`` mesh axis);
+this layer is the single-program dense form of the same math, and the
+two agree exactly when no token overflows capacity.
+
+Prototxt surface::
+
+    layer {
+      name: "moe" type: "MoE" bottom: "x" top: "y"
+      moe_param { num_experts: 8 hidden_dim: 256 }
+    }
+
+Input/output blobs are [..., D].  Top-1 (switch) gating: each token is
+processed by its argmax expert, scaled by that expert's softmax gate
+probability.  Params in Caffe blob order:
+[W_gate (E, D), W1 (E, H, D), b1 (E, H), W2 (E, D, H), b2 (E, D)].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from sparknet_tpu.ops.base import Layer, LayerOutput
+from sparknet_tpu.ops.fillers import fill
+from sparknet_tpu.ops.registry import register
+from sparknet_tpu.proto.text_format import Message
+
+
+def gate_top1(w_gate, x):
+    """Softmax gate -> (expert index, gate probability) per token.
+
+    ``x``: [T, D] tokens; returns ([T] int32, [T] float)."""
+    logits = x @ w_gate.T  # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    idx = jnp.argmax(logits, axis=-1)
+    return idx, jnp.take_along_axis(probs, idx[:, None], axis=1)[:, 0]
+
+
+def expert_ffn(params_e, x):
+    """One expert's FFN on its tokens: ReLU(x W1ᵀ + b1) W2ᵀ + b2.
+
+    ``params_e``: (W1 [H, D], b1 [H], W2 [D, H], b2 [D]); ``x``: [T, D]."""
+    w1, b1, w2, b2 = params_e
+    return jax.nn.relu(x @ w1.T + b1) @ w2.T + b2
+
+
+def moe_dense(params, x):
+    """Dense top-1 MoE on [T, D] tokens: every expert computes every
+    token, a one-hot combine keeps the chosen one.  The oracle for the
+    expert-parallel dispatch, and the in-graph layer's compute."""
+    w_gate, w1, b1, w2, b2 = params
+    idx, prob = gate_top1(w_gate, x)
+    # [E, T, D]: expert-major dense compute (MXU-friendly batched matmuls)
+    h = jax.nn.relu(jnp.einsum("td,ehd->eth", x, w1) + b1[:, None, :])
+    y_all = jnp.einsum("eth,edh->etd", h, w2) + b2[:, None, :]
+    onehot = jax.nn.one_hot(idx, w1.shape[0], dtype=x.dtype)  # [T, E]
+    return jnp.einsum("etd,te->td", y_all, onehot) * prob[:, None]
+
+
+@register
+class MoELayer(Layer):
+    TYPE = "MoE"
+
+    def __init__(self, lp, phase):
+        super().__init__(lp, phase)
+        p = lp.get_msg("moe_param")
+        self.num_experts = p.get_int("num_experts", 1)
+        self.hidden_dim = p.get_int("hidden_dim", 0)
+        self.weight_filler = (
+            p.get_msg("weight_filler")
+            if p.has("weight_filler")
+            else Message().set("type", "xavier")
+        )
+
+    def init(self, key, in_shapes):
+        D = in_shapes[0][-1]
+        H = self.hidden_dim or 4 * D
+        E = self.num_experts
+        kg, k1, k2 = jax.random.split(key, 3)
+        w_gate = fill(self.weight_filler, kg, (E, D))
+        w1 = fill(self.weight_filler, k1, (E, H, D))
+        b1 = jnp.zeros((E, H), jnp.float32)
+        w2 = fill(self.weight_filler, k2, (E, D, H))
+        b2 = jnp.zeros((E, D), jnp.float32)
+        return [w_gate, w1, b1, w2, b2], {}
+
+    def apply(self, params, state, inputs, *, train, rng=None) -> LayerOutput:
+        x = inputs[0]
+        tokens = x.reshape(-1, x.shape[-1])
+        y = moe_dense(params, tokens)
+        return LayerOutput(outputs=[y.reshape(x.shape)])

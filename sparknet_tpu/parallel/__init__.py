@@ -33,3 +33,11 @@ from sparknet_tpu.parallel.sharding import (  # noqa: F401
 )
 from sparknet_tpu.parallel.trainer import ParallelTrainer  # noqa: F401
 from sparknet_tpu.parallel.ulysses import ulysses_self_attention  # noqa: F401
+from sparknet_tpu.parallel.ring_attention import ring_self_attention  # noqa: F401
+from sparknet_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline_blocks,
+    sequential_blocks,
+    stack_stage_params,
+    stage_sharding,
+)
+from sparknet_tpu.parallel.expert import expert_parallel_moe  # noqa: F401

@@ -1,0 +1,131 @@
+"""Pipeline parallelism: GPipe microbatch schedule over a mesh axis.
+
+The reference has no pipeline parallelism (ref: SURVEY §2.3.5 "Not
+present"); this is a TPU-first-class extra alongside tensor and sequence
+parallelism.  The design is the SPMD pipelining pattern: one pipeline
+stage per device along a ``stage`` mesh axis, per-stage parameters are
+the leading-axis shards of a stacked parameter pytree, and activations
+flow stage→stage with ``lax.ppermute`` while ``lax.scan`` walks the
+microbatch schedule.  There is no scheduler process and no P2P send/recv
+backend — the whole schedule is one jitted XLA program and the hops ride
+ICI (contrast: GPU pipelines hand-schedule NCCL send/recv).
+
+Constraints (the classic SPMD-pipeline shape): every stage applies the
+same ``block_fn`` (homogeneous blocks, e.g. a transformer stack) and
+activations keep one shape across stages.  ``num_stages`` must equal the
+mesh axis size; microbatches ``M >= 1`` fill the pipeline over
+``M + S - 1`` ticks (bubble fraction ``(S-1)/(M+S-1)`` — raise M to
+amortize).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sparknet_tpu.parallel.mesh import shard_map
+
+
+def pipeline_blocks(
+    mesh: Mesh,
+    block_fn,
+    stacked_params,
+    x,
+    *,
+    axis_name: str = "stage",
+):
+    """Apply ``S`` homogeneous blocks as an ``S``-deep pipeline.
+
+    Args:
+      mesh: mesh containing ``axis_name`` of size S.
+      block_fn: ``block_fn(params_slice, activation) -> activation``; the
+        per-stage compute.  Activation shape must be preserved.
+      stacked_params: pytree whose leaves have leading axis S (stage-major
+        stack); leaf ``i`` of stage ``s`` is ``leaf[s]``.  Sharded over
+        ``axis_name`` so each device holds only its stage's weights.
+      x: ``[M, ...]`` microbatch-major input (M microbatches).
+
+    Returns:
+      ``[M, ...]`` output, equal (up to float assoc.) to sequentially
+      applying the S blocks to every microbatch.
+    """
+    S = mesh.shape[axis_name]
+    M = x.shape[0]
+    T = M + S - 1  # schedule length
+
+    def stage_prog(params_local, x_all):
+        # params_local: leaves [1, ...] (this stage's slice); x_all: [M, ...]
+        params_local = jax.tree_util.tree_map(lambda a: a[0], params_local)
+        sidx = lax.axis_index(axis_name)
+        # carries become device-varying on the first tick; mark them so
+        # from the start (shard_map's varying-axes type system)
+        if hasattr(lax, "pcast"):
+            varying = lambda a: lax.pcast(a, (axis_name,), to="varying")
+        else:  # pragma: no cover - pre-vma jax has no pcast and needs none
+            varying = lambda a: a
+        zero = varying(jnp.zeros_like(x_all[0]))
+        out_buf = varying(jnp.zeros_like(x_all))
+
+        def tick(carry, t):
+            hold, out_buf = carry
+            # stage 0 ingests microbatch t (while it exists); other stages
+            # consume the activation ppermuted from stage s-1 last tick
+            feed = lax.dynamic_index_in_dim(
+                x_all, jnp.minimum(t, M - 1), keepdims=False
+            )
+            my_in = jnp.where(sidx == 0, feed, hold)
+            out = block_fn(params_local, my_in)
+            # the last stage retires microbatch t - (S-1)
+            m = t - (S - 1)
+            updated = lax.dynamic_update_index_in_dim(
+                out_buf, out, jnp.maximum(m, 0), axis=0
+            )
+            out_buf = jnp.where((sidx == S - 1) & (m >= 0), updated, out_buf)
+            hold = lax.ppermute(
+                out, axis_name, [(i, (i + 1) % S) for i in range(S)]
+            )
+            return (hold, out_buf), None
+
+        (_, out_buf), _ = lax.scan(tick, (zero, out_buf), jnp.arange(T))
+        # only the last stage holds real outputs; make the result replicated
+        out_buf = jnp.where(sidx == S - 1, out_buf, jnp.zeros_like(out_buf))
+        return lax.psum(out_buf, axis_name)
+
+    pspec = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
+    return shard_map(
+        stage_prog,
+        mesh=mesh,
+        in_specs=(pspec, P()),
+        out_specs=P(),
+    )(stacked_params, x)
+
+
+def stack_stage_params(param_trees):
+    """Stack S per-stage parameter pytrees into the leading-axis layout
+    ``pipeline_blocks`` expects."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *param_trees
+    )
+
+
+def stage_sharding(mesh: Mesh, stacked_params, axis_name: str = "stage"):
+    """NamedShardings placing each stage's slice on its device."""
+    return jax.tree_util.tree_map(
+        lambda _: NamedSharding(mesh, P(axis_name)), stacked_params
+    )
+
+
+def sequential_blocks(block_fn, stacked_params, x):
+    """Oracle: the same computation without the pipeline (scan over
+    stages applied to every microbatch)."""
+
+    def body(act, params_slice):
+        return block_fn(params_slice, act), None
+
+    def one(xm):
+        out, _ = lax.scan(body, xm, stacked_params)
+        return out
+
+    return jax.vmap(one)(x)

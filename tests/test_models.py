@@ -415,3 +415,39 @@ def test_siamese_bias_lr_mult_matches_reference():
     specs = net.param_specs_for(variables)
     assert specs["conv1"][0].lr_mult == 1.0
     assert specs["conv1"][1].lr_mult == 2.0
+
+
+def test_dsl_attention_and_moe_builders():
+    """DSL builders agree with the prototxt path for the extra layer
+    types (key names + value types reach the op-side readers)."""
+    import jax
+
+    from sparknet_tpu.common import Phase
+    from sparknet_tpu.compiler.graph import Network
+    from sparknet_tpu.layers_dsl import (
+        MoELayer,
+        MultiHeadAttentionLayer,
+        NetParam,
+    )
+    from sparknet_tpu.proto.text_format import Message
+
+    net_param = NetParam(
+        "dsl_extras",
+        MultiHeadAttentionLayer("attn", ["x"], num_heads=2, causal=True, top="h"),
+        MoELayer("moe", ["h"], num_experts=4, hidden_dim=32, top="y"),
+    )
+    net_param.add("input", "x")
+    net_param.add(
+        "input_shape", Message().add("dim", 2).add("dim", 6).add("dim", 8)
+    )
+    net = Network(net_param, Phase.TEST)
+    attn, moe = net.layers[-2], net.layers[-1]
+    assert attn.num_heads == 2 and attn.causal is True
+    assert moe.num_experts == 4 and moe.hidden_dim == 32
+    variables = net.init(jax.random.PRNGKey(0))
+    shapes = [tuple(p.shape) for p in variables.params["moe"]]
+    assert shapes == [(4, 8), (4, 32, 8), (4, 32), (4, 8, 32), (4, 8)]
+    blobs, _, _ = net.apply(
+        variables, {"x": jnp.zeros((2, 6, 8), jnp.float32)}, rng=None, train=False
+    )
+    assert blobs["y"].shape == (2, 6, 8)

@@ -235,3 +235,84 @@ def test_tau_convergence_parity():
     acc_tau = run(tau=4, rounds=5)     # 5 rounds x 4 local steps
     assert acc_sync > 0.9, acc_sync
     assert acc_tau > 0.9, acc_tau
+
+
+def test_pipeline_blocks_match_sequential():
+    """GPipe schedule over a 4-stage mesh == sequential block stack,
+    including bubble-dominated cases (M < S)."""
+    from jax.sharding import Mesh
+
+    from sparknet_tpu.parallel.pipeline import (
+        pipeline_blocks,
+        sequential_blocks,
+        stack_stage_params,
+        stage_sharding,
+    )
+
+    S, D = 4, 16
+    mesh = Mesh(np.array(jax.devices()[:S]), ("stage",))
+    rs = np.random.RandomState(0)
+    stacked = stack_stage_params([
+        {
+            "w": jnp.asarray(rs.randn(D, D) * 0.3, jnp.float32),
+            "b": jnp.asarray(rs.randn(D) * 0.1, jnp.float32),
+        }
+        for _ in range(S)
+    ])
+
+    def block(p, a):
+        return jnp.tanh(a @ p["w"] + p["b"])
+
+    # place each stage's weight slice on its own device up front
+    stacked = jax.tree_util.tree_map(
+        jax.device_put, stacked, stage_sharding(mesh, stacked)
+    )
+
+    for M in (1, 2, 6):
+        x = jnp.asarray(rs.randn(M, 3, D), jnp.float32)
+        out = pipeline_blocks(mesh, block, stacked, x)
+        ref = sequential_blocks(block, stacked, x)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=1e-5, err_msg=f"M={M}"
+        )
+
+
+def test_expert_parallel_matches_dense():
+    """all_to_all MoE dispatch == the dense oracle at full capacity;
+    tight capacity drops tokens to zero instead of corrupting others."""
+    from jax.sharding import Mesh
+
+    from sparknet_tpu.ops.moe import moe_dense
+    from sparknet_tpu.parallel.expert import expert_parallel_moe
+
+    E, T, D, H = 8, 64, 16, 32
+    mesh = Mesh(np.array(jax.devices()[:E]), ("expert",))
+    rs = np.random.RandomState(0)
+    params = (
+        jnp.asarray(rs.randn(E, D) * 0.5, jnp.float32),
+        jnp.asarray(rs.randn(E, H, D) * 0.3, jnp.float32),
+        jnp.asarray(rs.randn(E, H) * 0.1, jnp.float32),
+        jnp.asarray(rs.randn(E, D, H) * 0.3, jnp.float32),
+        jnp.asarray(rs.randn(E, D) * 0.1, jnp.float32),
+    )
+    x = jnp.asarray(rs.randn(T, D), jnp.float32)
+    ref = np.asarray(moe_dense(params, x))
+    out = np.asarray(expert_parallel_moe(mesh, params, x))
+    np.testing.assert_allclose(out, ref, atol=2e-5)
+
+    tight = np.asarray(expert_parallel_moe(mesh, params, x, capacity_factor=1.0))
+    dropped = np.all(tight == 0, axis=1)
+    kept = ~dropped
+    assert dropped.any()  # this seed overflows some expert
+    np.testing.assert_allclose(tight[kept], ref[kept], atol=2e-5)
+
+
+def test_expert_parallel_validations():
+    from jax.sharding import Mesh
+
+    from sparknet_tpu.parallel.expert import expert_parallel_moe
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("expert",))
+    params = (jnp.zeros((8, 4)),) + tuple(jnp.zeros((8, 2, 2)) for _ in range(4))
+    with pytest.raises(ValueError, match="num_experts"):
+        expert_parallel_moe(mesh, params, jnp.zeros((8, 4)))
