@@ -81,6 +81,7 @@ class SolverConfig:
     average_loss: int = 1
     snapshot: int = 0
     snapshot_prefix: str = ""
+    snapshot_after_train: bool = True
 
     @classmethod
     def from_proto(cls, m: Message) -> "SolverConfig":
@@ -122,6 +123,7 @@ class SolverConfig:
             average_loss=m.get_int("average_loss", 1),
             snapshot=m.get_int("snapshot", 0),
             snapshot_prefix=m.get_str("snapshot_prefix", ""),
+            snapshot_after_train=m.get_bool("snapshot_after_train", True),
         )
 
 
@@ -295,6 +297,72 @@ class Solver:
                 self.save(f"{cfg.snapshot_prefix}_iter_{self.iter}")
         self.smoothed_loss = self._smoothed()
         return self.smoothed_loss
+
+    def solve(
+        self,
+        train_fn: DataFn,
+        test_fns=None,
+        resume_file: str | None = None,
+        callback=None,
+    ) -> float:
+        """Full optimization run (ref: Solver::Solve solver.cpp:285-326):
+        optional restore -> ``Step(max_iter - iter)`` -> snapshot unless
+        ``snapshot_after_train`` is off or the last iter already snapshot
+        -> final forward-only display pass -> final ``TestAll`` when
+        ``max_iter`` lands on a ``test_interval`` boundary.
+
+        In-loop testing during Step stays disabled, matching the
+        reference fork's deliberate change (solver.cpp:204-212) — drive
+        periodic eval from the app loop instead.  A ``callback`` raising
+        ``KeyboardInterrupt`` is the early-exit path (SolverAction.STOP):
+        the snapshot still happens, the final display/test passes don't.
+
+        Returns the final display loss (or the smoothed loss when
+        ``display`` is off).
+        """
+        cfg = self.config
+        early_exit = False
+        if resume_file:
+            self.restore(resume_file)
+        try:
+            self.step(max(cfg.max_iter - self.iter, 0), train_fn, callback)
+        except KeyboardInterrupt:
+            early_exit = True
+            self.smoothed_loss = self._smoothed()
+        # skip the final save only when Step itself just wrote one (it
+        # does so at snapshot boundaries AND only with a prefix set)
+        step_just_snapshot = (
+            cfg.snapshot
+            and cfg.snapshot_prefix
+            and self.iter % cfg.snapshot == 0
+            and self.iter > 0
+        )
+        if cfg.snapshot_after_train and not step_just_snapshot:
+            prefix = cfg.snapshot_prefix or "solver"
+            self.save(f"{prefix}_iter_{self.iter}")
+        if early_exit:
+            return self.smoothed_loss
+        loss = self.smoothed_loss
+        if cfg.display and self.iter % cfg.display == 0:
+            # forward-only pass to display the post-update loss
+            feeds = train_fn(self.iter)
+            if cfg.iter_size > 1:
+                # train feeds carry a leading [iter_size] micro-batch
+                # axis; a single forward takes one micro-batch
+                feeds = {k: v[0] for k, v in feeds.items()}
+            _, _, loss_arr = self.train_net.apply(
+                self.variables, feeds, rng=step_key(self._key, self.iter),
+                train=True,
+            )
+            loss = float(loss_arr)
+            print(f"Iteration {self.iter}, loss = {loss:.6g}")
+        if (
+            test_fns is not None
+            and cfg.test_interval
+            and self.iter % cfg.test_interval == 0
+        ):
+            self.test_all(test_fns)
+        return loss
 
     def _smoothed(self) -> float:
         if not self._loss_window:

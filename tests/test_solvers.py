@@ -405,3 +405,118 @@ def test_orbax_snapshot_sharded_arrays(tmp_path):
     restored = s2.variables.params["ip1"][0]
     assert restored.sharding == sh
     np.testing.assert_allclose(np.asarray(restored), np.asarray(w))
+
+
+def test_solve_full_run(tmp_path, capsys):
+    """Solver.solve: Step to max_iter, snapshot_after_train, final
+    forward display, resume path (ref: Solver::Solve solver.cpp:285-326)."""
+    cfg = SolverConfig(
+        base_lr=0.02, momentum=0.9, max_iter=30, display=10,
+        snapshot_prefix=str(tmp_path / "s"),
+    )
+    solver = _make_solver(cfg)
+    data_fn, _ = _linreg_data_fn()
+    loss = solver.solve(data_fn)
+    assert solver.iter == 30
+    assert os.path.exists(str(tmp_path / "s_iter_30.solverstate.npz"))
+    # final display pass printed the post-update loss
+    assert "Iteration 30, loss" in capsys.readouterr().out
+    assert loss < 1.0
+
+    # resume: restores iter then runs the remaining iterations
+    cfg2 = SolverConfig(
+        base_lr=0.02, momentum=0.9, max_iter=40,
+        snapshot_prefix=str(tmp_path / "r"),
+    )
+    solver2 = _make_solver(cfg2)
+    solver2.solve(data_fn, resume_file=str(tmp_path / "s_iter_30.solverstate.npz"))
+    assert solver2.iter == 40
+
+
+def test_solve_early_exit_and_no_snapshot(tmp_path):
+    """Early exit (STOP action) still snapshots but skips the final
+    passes; snapshot_after_train=False skips the snapshot; a max_iter
+    aligned with the snapshot interval does not double-snapshot."""
+    data_fn, _ = _linreg_data_fn()
+
+    cfg = SolverConfig(
+        base_lr=0.02, max_iter=20, snapshot_prefix=str(tmp_path / "e"),
+    )
+    solver = _make_solver(cfg)
+
+    def stop_at_5(it, loss):
+        if it >= 5:
+            raise KeyboardInterrupt
+
+    solver.solve(data_fn, callback=stop_at_5)
+    assert solver.iter == 5
+    assert os.path.exists(str(tmp_path / "e_iter_5.solverstate.npz"))
+
+    cfg2 = SolverConfig(base_lr=0.02, max_iter=5, snapshot_after_train=False,
+                        snapshot_prefix=str(tmp_path / "n"))
+    solver2 = _make_solver(cfg2)
+    solver2.solve(data_fn)
+    assert not os.path.exists(str(tmp_path / "n_iter_5.solverstate.npz"))
+
+    # snapshot interval lands exactly on max_iter -> Step already saved it;
+    # solve must not overwrite (ref: the `iter_ % snapshot != 0` guard)
+    cfg3 = SolverConfig(base_lr=0.02, max_iter=6, snapshot=3,
+                        snapshot_prefix=str(tmp_path / "a"))
+    solver3 = _make_solver(cfg3)
+    p = str(tmp_path / "a_iter_6.solverstate.npz")
+    solver3.solve(data_fn)
+    assert os.path.exists(p)
+
+
+def test_solve_final_testall(capsys):
+    """max_iter on a test_interval boundary triggers the final TestAll."""
+    cfg = SolverConfig(
+        base_lr=0.02, max_iter=10, test_interval=5, test_iter=(2,),
+        snapshot_after_train=False,
+    )
+    solver = _make_solver(cfg)
+    data_fn, _ = _linreg_data_fn()
+    results = []
+    orig = solver.test_all
+    solver.test_all = lambda fns: results.append(orig(fns))
+    solver.solve(data_fn, test_fns=[lambda b: data_fn(b)])
+    assert len(results) == 1 and len(results[0]) == 1
+
+
+def test_solve_iter_size_display_and_early_loss(tmp_path):
+    """solve() final display handles iter_size>1 feeds; early exit
+    returns the live smoothed loss, not a stale 0.0."""
+    data_fn, _ = _linreg_data_fn()
+
+    def stacked_fn(it):
+        a, b = data_fn(2 * it), data_fn(2 * it + 1)
+        return {k: np.stack([a[k], b[k]]) for k in a}
+
+    cfg = SolverConfig(base_lr=0.02, max_iter=10, display=5, iter_size=2,
+                       snapshot_after_train=False)
+    solver = _make_solver(cfg)
+    loss = solver.solve(stacked_fn)
+    assert np.isfinite(loss) and loss < 10.0
+
+    cfg2 = SolverConfig(base_lr=0.02, max_iter=50, snapshot_after_train=False)
+    solver2 = _make_solver(cfg2)
+
+    def stop(it, loss):
+        if it >= 10:
+            raise KeyboardInterrupt
+
+    got = solver2.solve(data_fn, callback=stop)
+    assert got > 0.0  # live smoothed loss, not the stale init value
+
+    # empty snapshot_prefix + interval dividing max_iter: Step wrote
+    # nothing, so solve must still write the final snapshot
+    import os
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        cfg3 = SolverConfig(base_lr=0.02, max_iter=6, snapshot=3)
+        solver3 = _make_solver(cfg3)
+        solver3.solve(data_fn)
+        assert os.path.exists("solver_iter_6.solverstate.npz")
+    finally:
+        os.chdir(cwd)
