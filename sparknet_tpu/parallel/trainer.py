@@ -157,12 +157,35 @@ class ParallelTrainer:
     # ------------------------------------------------------------------
     def _put_feeds(self, feeds, with_tau_axis: bool):
         """Batch axis -> 'data' axis.  tau-mode arrays are [tau, B, ...]
-        and shard axis 1."""
+        and shard axis 1.
+
+        Single process: the whole global batch is addressable and one
+        device_put scatters it.  Multi-host (``jax.process_count() > 1``,
+        DCN bring-up via ``initialize_distributed``): each process feeds
+        only its own shard — the per-worker stream shape of the reference
+        (each Spark executor reads its partition, ref:
+        CifarApp.scala:118-130) — and the global array is assembled
+        process-locally without any cross-host data motion."""
         spec = (
             NamedSharding(self.mesh, P(None, self.data_axis))
             if with_tau_axis
             else batch_sharding(self.mesh)
         )
+        # count the processes the MESH actually spans — a process-local
+        # sub-mesh inside a distributed job still takes the single-host path
+        mesh_procs = len({d.process_index for d in self.mesh.devices.flat})
+        if mesh_procs > 1:
+            out = {}
+            bax = 1 if with_tau_axis else 0
+            for k, v in feeds.items():
+                v = np.asarray(v)
+                gshape = (
+                    v.shape[:bax]
+                    + (v.shape[bax] * mesh_procs,)
+                    + v.shape[bax + 1:]
+                )
+                out[k] = jax.make_array_from_process_local_data(spec, v, gshape)
+            return out
         return {k: jax.device_put(jnp.asarray(v), spec) for k, v in feeds.items()}
 
     # ------------------------------------------------------------------
@@ -171,9 +194,11 @@ class ParallelTrainer:
 
         tau == 1: data_fn(it) -> feeds [B_global, ...]; one sync-SGD step.
         tau  > 1: data_fn(it) -> feeds [tau, B_global, ...]; tau local steps
-        on every worker, then model averaging.  Returns mean loss (device
-        value materialized — call sites that care about overlap should batch
-        rounds)."""
+        on every worker, then model averaging.  On a multi-process mesh
+        the batch axis is the PER-PROCESS shard instead of B_global —
+        each host feeds only its own partition (see _put_feeds).  Returns
+        mean loss (device value materialized — call sites that care about
+        overlap should batch rounds)."""
         if self.tau == 1:
             feeds = self._put_feeds(data_fn(self.iter), with_tau_axis=False)
             self.variables, self.slots, loss = self._train(

@@ -1,0 +1,92 @@
+"""Subprocess body for the multi-host (DCN) distributed test.
+
+Two of these processes form a 2-process x 4-device CPU cluster — the
+in-CI stand-in for two TPU hosts on DCN (ref: SURVEY §2.4: multi-host
+orchestration via jax.distributed.initialize; the reference's analog is
+Spark driver + executors over TCP).  Each process feeds only its own
+batch shard, runs sync-DP and tau-averaging rounds through
+ParallelTrainer, and prints a parameter digest the parent test compares
+across processes (replicas must agree bit-for-bit).
+
+Usage: python multihost_worker.py <process_id> <coordinator_port>
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+def main() -> None:
+    pid, port = int(sys.argv[1]), int(sys.argv[2])
+
+    from sparknet_tpu.parallel.mesh import (
+        data_parallel_mesh,
+        initialize_distributed,
+    )
+
+    initialize_distributed(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+    )
+    assert jax.process_count() == 2
+    assert len(jax.devices()) == 8  # 2 hosts x 4 local devices
+
+    from sparknet_tpu import models
+    from sparknet_tpu.parallel.trainer import ParallelTrainer
+    from sparknet_tpu.solvers.solver import Solver
+
+    mesh = data_parallel_mesh()
+    per_proc = 8  # global batch 16, 2 per device
+    rs = np.random.RandomState(100 + pid)  # different data per host
+
+    def batch(b):
+        return {
+            "data": (rs.randn(b, 3, 32, 32) * 40).astype(np.float32),
+            "label": rs.randint(0, 10, b).astype(np.int32),
+        }
+
+    # Mode 1: tau=1 sync DP, global batch assembled from per-process shards.
+    solver = Solver(models.cifar10_quick_solver(), models.cifar10_quick(16))
+    trainer = ParallelTrainer(solver, mesh=mesh, tau=1)
+    loss = trainer.train(3, lambda it: batch(per_proc))
+    assert np.isfinite(loss), loss
+
+    # Mode 2: tau=2 local SGD + model averaging.
+    tau = 2
+    solver2 = Solver(models.cifar10_quick_solver(), models.cifar10_quick(2))
+    trainer2 = ParallelTrainer(solver2, mesh=mesh, tau=tau)
+    feeds = [batch(per_proc) for _ in range(tau)]
+    stacked = {k: np.stack([f[k] for f in feeds]) for k in feeds[0]}
+    loss2 = trainer2.train_round(lambda it: stacked)
+    assert np.isfinite(loss2), loss2
+
+    # Parameter digest: replicas must be identical on every host.  Reduce
+    # on device with a replicated output — parameter arrays span both
+    # processes, so host-side np.asarray would be non-addressable.
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def digest_of(tree):
+        leaves = jax.tree_util.tree_leaves(tree)
+        fn = jax.jit(
+            lambda ls: sum(jnp.sum(l) for l in ls),
+            out_shardings=NamedSharding(mesh, P()),
+        )
+        return float(fn(leaves))
+
+    digest = digest_of(trainer.variables.params)
+    digest2 = digest_of(trainer2.variables.params)
+    print(f"DIGEST {pid} {digest:.10e} {digest2:.10e} {loss:.6f} {loss2:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

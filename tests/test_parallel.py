@@ -316,3 +316,61 @@ def test_expert_parallel_validations():
     params = (jnp.zeros((8, 4)),) + tuple(jnp.zeros((8, 2, 2)) for _ in range(4))
     with pytest.raises(ValueError, match="num_experts"):
         expert_parallel_moe(mesh, params, jnp.zeros((8, 4)))
+
+
+def test_multihost_two_process_cluster():
+    """Real multi-process bring-up over the DCN path: 2 processes x 4
+    CPU devices via initialize_distributed; per-process feed shards;
+    sync-DP and tau-averaging rounds; replica params must agree
+    bit-for-bit across hosts (the P2PSync-equivalence analog, ref:
+    test_gradient_based_solver.cpp:197-208, upgraded to actual
+    multi-process)."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(root, "tests", "multihost_worker.py")
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+
+    def run_cluster():
+        # bind-then-close port allocation can race other suites; the
+        # retry below absorbs a stolen port
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        procs = [
+            subprocess.Popen(
+                [sys.executable, worker, str(pid), str(port)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+                env=env,
+            )
+            for pid in range(2)
+        ]
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=420)
+                outs.append(out)
+        except subprocess.TimeoutExpired:
+            return None
+        finally:
+            for p in procs:
+                p.poll() is None and p.kill()
+        if any(p.returncode != 0 for p in procs):
+            return None
+        return outs
+
+    outs = run_cluster() or run_cluster()
+    assert outs is not None, "multihost cluster failed twice"
+
+    digests = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("DIGEST"):
+                _, pid, d1, d2, l1, l2 = line.split()
+                digests[pid] = (d1, d2)
+    assert set(digests) == {"0", "1"}, outs
+    assert digests["0"] == digests["1"], digests
