@@ -75,6 +75,9 @@ class ParallelTrainer:
         self.tau = int(tau)
         self.data_axis = cfg.data_axis
         self.num_workers = self.mesh.shape.get(cfg.data_axis, 1)
+        # processes the mesh spans: >1 switches _put_feeds to per-process
+        # shard assembly; a process-local sub-mesh stays single-host
+        self._mesh_procs = len({d.process_index for d in self.mesh.devices.flat})
         self.iter = 0
         self._step_fn = solver._make_train_step()
         self._rules = rules or ShardingRules()
@@ -171,9 +174,7 @@ class ParallelTrainer:
             if with_tau_axis
             else batch_sharding(self.mesh)
         )
-        # count the processes the MESH actually spans — a process-local
-        # sub-mesh inside a distributed job still takes the single-host path
-        mesh_procs = len({d.process_index for d in self.mesh.devices.flat})
+        mesh_procs = self._mesh_procs
         if mesh_procs > 1:
             out = {}
             bax = 1 if with_tau_axis else 0

@@ -69,19 +69,18 @@ def main() -> None:
     loss2 = trainer2.train_round(lambda it: stacked)
     assert np.isfinite(loss2), loss2
 
-    # Parameter digest: replicas must be identical on every host.  Reduce
-    # on device with a replicated output — parameter arrays span both
-    # processes, so host-side np.asarray would be non-addressable.
-    import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    # Parameter digest: replicas must be identical on every host.  Sum
+    # THIS process's local shard data only (addressable_shards) so each
+    # host's digest provably reflects its own replica — a global reduce
+    # could be satisfied from either host's copy.
 
     def digest_of(tree):
-        leaves = jax.tree_util.tree_leaves(tree)
-        fn = jax.jit(
-            lambda ls: sum(jnp.sum(l) for l in ls),
-            out_shardings=NamedSharding(mesh, P()),
-        )
-        return float(fn(leaves))
+        total = 0.0
+        for leaf in jax.tree_util.tree_leaves(tree):
+            total += float(
+                np.sum(np.asarray(leaf.addressable_shards[0].data, np.float64))
+            )
+        return total
 
     digest = digest_of(trainer.variables.params)
     digest2 = digest_of(trainer2.variables.params)
