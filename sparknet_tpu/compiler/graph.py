@@ -333,6 +333,14 @@ class Network:
         return self._blob_info
 
     # ------------------------------------------------------------------
+    def layer_index(self, name: str) -> int:
+        for i, layer in enumerate(self.layers):
+            if layer.name == name:
+                return i
+        raise KeyError(
+            f"no layer named {name!r}; layers: {[l.name for l in self.layers]}"
+        )
+
     def apply(
         self,
         variables: NetVars,
@@ -340,12 +348,28 @@ class Network:
         rng: jax.Array | None = None,
         *,
         train: bool | None = None,
+        start: str | None = None,
+        end: str | None = None,
     ) -> tuple[dict[str, jax.Array], State, jax.Array]:
         """Forward pass. Returns (all blobs, updated state, total weighted loss).
+
+        ``start``/``end`` name the first/last layer to run — the partial
+        execution of Net::ForwardFromTo (net.cpp:565-583; pycaffe's
+        ``net.forward(start=..., end=...)``).  A partial run takes its
+        inputs from ``feeds`` (feed the start layer's bottom blobs).
+        Loss accumulates over the executed range only.
 
         ref: Net::ForwardFromTo (net.cpp:565-583) + loss accumulation
         (layer.hpp Forward loss() * loss_weight)."""
         train = (self.phase == Phase.TRAIN) if train is None else train
+        si = 0 if start is None else self.layer_index(start)
+        ei = len(self.layers) - 1 if end is None else self.layer_index(end)
+        if si > ei:
+            raise ValueError(
+                f"start layer {start!r} (#{si}) comes after end layer "
+                f"{end!r} (#{ei})"
+            )
+        partial = start is not None or end is not None
         # Mixed precision (Config.compute_dtype, default f32): master params
         # and optimizer state stay in param_dtype; activations and the conv/
         # matmul FLOPs run in compute_dtype (bf16 keeps the MXU at full
@@ -362,13 +386,23 @@ class Network:
             )
 
         blob: dict[str, jax.Array] = {}
-        for name in self.feed_blobs:
-            if name not in feeds:
-                raise ValueError(f"missing feed for input blob {name!r}")
-            blob[name] = _cast(feeds[name], cdt) if mixed else feeds[name]
+        if si > 0:
+            # mid-graph starts are primed with whatever the caller
+            # supplies (the start layer's bottoms — possibly intermediate
+            # blobs); end-only runs still begin at layer 0 and keep the
+            # strict input-feed contract below
+            for name, val in feeds.items():
+                blob[name] = _cast(val, cdt) if mixed else val
+        else:
+            for name in self.feed_blobs:
+                if name not in feeds:
+                    raise ValueError(f"missing feed for input blob {name!r}")
+                blob[name] = _cast(feeds[name], cdt) if mixed else feeds[name]
         new_state: State = {}
         total_loss = jnp.zeros((), jnp.float32)
         for idx, layer in enumerate(self.layers):
+            if idx < si or idx > ei:
+                continue
             sub = layer_key(rng, idx) if rng is not None else None
             if isinstance(layer, InputLayer):
                 if getattr(layer, "SELF_FEEDING", False):
@@ -379,6 +413,12 @@ class Network:
                 layer, variables.params.get(layer.name, []), variables.params
             )
             s = variables.state.get(layer.name, {})
+            missing = [b for b in layer.bottoms if b not in blob]
+            if missing:
+                raise ValueError(
+                    f"layer {layer.name!r} needs blob(s) {missing}; feed "
+                    "them or start the run at an earlier layer"
+                )
             ins = [blob[b] for b in layer.bottoms]
             if mixed:
                 if layer.IS_LOSS:

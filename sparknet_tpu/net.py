@@ -205,6 +205,7 @@ class TPUNet:
         self._forward_fn = jax.jit(
             lambda variables, feeds: self.test_net.apply(variables, feeds, rng=None, train=False)[0]
         )
+        self._partial_fns: dict = {}  # (start, end) -> jitted partial forward
 
     # -- data hookup (ref: Net.scala setTrainData/setTestData :78-100) ----
     def set_train_data(self, batches: Iterator[dict] | Callable[[int], dict]):
@@ -232,11 +233,31 @@ class TPUNet:
         return self.solver.test(self._test_len, data_fn)
 
     # -- inference (ref: Net.scala forward :121-123 + getData :173-191) ---
-    def forward(self, feeds: dict[str, Any]) -> dict[str, jax.Array]:
+    def forward(
+        self,
+        feeds: dict[str, Any],
+        start: str | None = None,
+        end: str | None = None,
+    ) -> dict[str, jax.Array]:
         """Forward on the TEST-phase graph; returns ALL blobs (the getData
-        dump the Featurizer uses, ref: FeaturizerApp.scala:88-102)."""
+        dump the Featurizer uses, ref: FeaturizerApp.scala:88-102).
+
+        ``start``/``end`` run a sub-range of layers (ref:
+        Net::ForwardFromTo net.cpp:565-583; pycaffe
+        ``net.forward(start=..., end=...)``) — feed the start layer's
+        bottom blobs, read any blob the range produces."""
         feeds = {k: jnp.asarray(v) for k, v in feeds.items()}
-        return self._forward_fn(self.solver.variables, feeds)
+        if start is None and end is None:
+            return self._forward_fn(self.solver.variables, feeds)
+        key = (start, end)
+        if key not in self._partial_fns:
+            self._partial_fns[key] = jax.jit(
+                lambda variables, feeds: self.test_net.apply(
+                    variables, feeds, rng=None, train=False,
+                    start=start, end=end,
+                )[0]
+            )
+        return self._partial_fns[key](self.solver.variables, feeds)
 
     def backward(self, feeds: dict[str, Any]) -> dict[str, list[jax.Array]]:
         """Gradient of the total loss wrt every param blob. On TPU the

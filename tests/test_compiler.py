@@ -208,3 +208,54 @@ def test_mixed_precision_bf16_compute():
         assert l5 < l0 + 1e-3
     finally:
         set_config(compute_dtype=jnp.float32)
+
+
+def test_forward_from_to_partial_execution():
+    """Partial forward (ref: Net::ForwardFromTo net.cpp:565-583):
+    end-only prefix runs, resume-from-intermediate matches the full
+    pass, and helpful errors for bad ranges/missing blobs."""
+    from sparknet_tpu import models
+    from sparknet_tpu.net import TPUNet
+    from sparknet_tpu.solvers.solver import SolverConfig
+
+    net = TPUNet(SolverConfig(), models.lenet(4))
+    rs = np.random.RandomState(0)
+    feeds = {
+        "data": rs.randn(4, 1, 28, 28).astype(np.float32) * 40,
+        "label": rs.randint(0, 10, 4).astype(np.int32),
+    }
+    full = net.forward(feeds)
+
+    # prefix: stop after conv1 — later blobs absent
+    prefix = net.forward(feeds, end="conv1")
+    assert "conv1" in prefix and "ip2" not in prefix
+    np.testing.assert_allclose(
+        np.asarray(prefix["conv1"]), np.asarray(full["conv1"]), atol=1e-5
+    )
+
+    # resume from an intermediate blob: pool1 onward reproduces the full run
+    resumed = net.forward(
+        {"pool1": full["pool1"], "label": feeds["label"]}, start="conv2"
+    )
+    np.testing.assert_allclose(
+        np.asarray(resumed["ip2"]), np.asarray(full["ip2"]), atol=1e-4
+    )
+
+    # end-only runs still start at layer 0: the strict input contract holds
+    with pytest.raises(ValueError, match="missing feed"):
+        net.test_net.apply(
+            net.solver.variables, {"data": feeds["data"]},
+            train=False, end="conv1",
+        )
+
+    with pytest.raises(KeyError, match="no layer named"):
+        net.forward(feeds, end="nope")
+    with pytest.raises(ValueError, match="comes after"):
+        net.test_net.apply(
+            net.solver.variables, feeds, train=False, start="ip2", end="conv1"
+        )
+    with pytest.raises(ValueError, match="needs blob"):
+        net.test_net.apply(
+            net.solver.variables, {"label": feeds["label"]},
+            train=False, start="conv2", end="ip2",
+        )
