@@ -46,10 +46,18 @@ def _feed_shapes(net):
 
 
 def _data_fns(args, net):
-    """(train_fn, test_fn) from --data."""
+    """(train_fn, test_fn) from --data.
+
+    In a multi-process job each process must stream DIFFERENT data (its
+    own partition, ref: CifarApp.scala:118-130 per-executor RDD
+    partitions): batch indices interleave by process id and the
+    synthetic stream seeds per process."""
+    import jax
+
     shapes = _feed_shapes(net)
     data_shape = shapes["data"]
     batch = data_shape[0]
+    pid, nproc = jax.process_index(), jax.process_count()
 
     if args.data.startswith("cifar:"):
         from sparknet_tpu.data import CifarLoader, DataTransformer, TransformConfig
@@ -64,14 +72,14 @@ def _data_fns(args, net):
                 f"--batch {batch} exceeds dataset size {min(len(ytr), len(yte))}")
 
         def train_fn(it):
-            lo = (it * batch) % (len(ytr) - batch + 1)
+            lo = ((it * nproc + pid) * batch) % (len(ytr) - batch + 1)
             return {
                 "data": xform(xtr[lo : lo + batch], True),
                 "label": ytr[lo : lo + batch].astype(np.int32),
             }
 
         def test_fn(b):
-            lo = (b * batch) % (len(yte) - batch + 1)
+            lo = ((b * nproc + pid) * batch) % (len(yte) - batch + 1)
             return {
                 "data": xform(xte[lo : lo + batch], False),
                 "label": yte[lo : lo + batch].astype(np.int32),
@@ -80,7 +88,7 @@ def _data_fns(args, net):
         return train_fn, test_fn
 
     if args.data == "synthetic":
-        rs = np.random.RandomState(0)
+        rs = np.random.RandomState(pid)
         num_classes = 10
 
         def synth(it):
@@ -105,6 +113,24 @@ def cmd_train(args) -> int:
         # ref: caffe.cpp:161-163 "Give a snapshot to resume training or
         # weights to finetune but not both." — fail before building the net
         raise SystemExit("--snapshot and --weights are mutually exclusive")
+    if getattr(args, "num_processes", 0):
+        # multi-host bring-up (ref: SURVEY §2.4 — the Spark driver/executor
+        # topology's replacement).  Must precede the first jax backend
+        # touch, i.e. before the net builds; each process then feeds only
+        # its own batch shards.
+        from sparknet_tpu.parallel.mesh import initialize_distributed
+
+        if not args.coordinator:
+            raise SystemExit("--num-processes requires --coordinator host:port")
+        if not (args.distributed or args.tau > 1):
+            # without the mesh trainer each process would train a full
+            # independent model with no gradient sync — never intended
+            raise SystemExit("--num-processes requires --distributed or --tau > 1")
+        initialize_distributed(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
     net_param, solver_cfg = _build_net_and_solver(args)
     solver = Solver(solver_cfg, net_param)
     if args.snapshot:
@@ -141,16 +167,18 @@ def cmd_train(args) -> int:
     iters = args.iterations or solver_cfg.max_iter
     with profile_ctx:
         if args.tau > 1 or args.distributed:
+            if getattr(args, "num_processes", 0):
+                log(f"distributed: process {args.process_id}/{args.num_processes}")
             trainer = ParallelTrainer(solver, tau=args.tau)
             outer = -(-iters // max(args.tau, 1))  # ceil: run >= requested
-            tau_fn = _stack_tau(train_fn, args.tau, trainer.num_workers)
+            tau_fn = _stack_tau(train_fn, args.tau, trainer.num_local_workers)
             with SignalHandler() as sig:
                 for o in range(outer):
                     if args.tau > 1:
                         loss = trainer.train_round(tau_fn)
                     else:
                         loss = trainer.train_round(
-                            _widen_batch(train_fn, trainer.num_workers)
+                            _widen_batch(train_fn, trainer.num_local_workers)
                         )
                     log(f"loss: {loss:.5f}", i=trainer.iter)
                     action = sig.check()
@@ -675,6 +703,12 @@ def main(argv=None) -> int:
                     ".caffemodel/.h5 (fresh optimizer state)")
     sp.add_argument("--tau", type=int, default=1, help="model-averaging interval")
     sp.add_argument("--distributed", action="store_true", help="use the device mesh")
+    sp.add_argument("--coordinator", default="",
+                    help="multi-host: coordination service host:port")
+    sp.add_argument("--num-processes", type=int, default=0,
+                    help="multi-host: total process count")
+    sp.add_argument("--process-id", type=int, default=0,
+                    help="multi-host: this process's id")
     sp.add_argument("--test-iters", type=int, default=0)
     sp.add_argument("--output", help="snapshot prefix for the final model")
     sp.add_argument("--profile", help="capture a jax.profiler trace into DIR")

@@ -259,22 +259,59 @@ class TPUNet:
             )
         return self._partial_fns[key](self.solver.variables, feeds)
 
-    def backward(self, feeds: dict[str, Any]) -> dict[str, list[jax.Array]]:
-        """Gradient of the total loss wrt every param blob. On TPU the
+    def backward(
+        self,
+        feeds: dict[str, Any],
+        start: str | None = None,
+        end: str | None = None,
+        wrt: str = "params",
+    ) -> dict[str, Any]:
+        """Gradient of the executed range's loss. On TPU the
         forward+backward is one fused XLA program; this exposes the
-        gradient pytree (ref: Net.scala backward :125-127)."""
+        gradient pytree (ref: Net.scala backward :125-127).
+
+        ``start``/``end`` restrict the differentiated range (ref:
+        Net::BackwardFromTo net.cpp:635-646 — there, backward over a
+        layer sub-range; here, grad of the sub-range's loss).
+        ``wrt="params"`` (default) returns d(loss)/d(param blobs);
+        ``wrt="inputs"`` returns d(loss)/d(each fed blob) — the bottom
+        diffs a mid-graph BackwardFromTo hands back."""
+        if wrt not in ("params", "inputs"):
+            raise ValueError(f"wrt must be 'params' or 'inputs', got {wrt!r}")
         net = self.train_net
-        variables = self.solver.variables
+        arrs = {k: jnp.asarray(v) for k, v in feeds.items()}
 
-        def loss_fn(params):
-            _, _, loss = net.apply(
-                NetVars(params=params, state=variables.state),
-                {k: jnp.asarray(v) for k, v in feeds.items()},
-                rng=jax.random.key(0),
-            )
-            return loss
+        key = ("backward", start, end, wrt)
+        if key not in self._partial_fns:
+            if wrt == "params":
+                def grad_fn(variables, arrs):
+                    def loss_fn(params):
+                        _, _, loss = net.apply(
+                            NetVars(params=params, state=variables.state),
+                            arrs, rng=jax.random.key(0), start=start, end=end,
+                        )
+                        return loss
 
-        return jax.grad(loss_fn)(variables.params)
+                    return jax.grad(loss_fn)(variables.params)
+            else:
+                def grad_fn(variables, arrs):
+                    diff = {
+                        k: v for k, v in arrs.items()
+                        if jnp.issubdtype(v.dtype, jnp.floating)
+                    }
+                    rest = {k: v for k, v in arrs.items() if k not in diff}
+
+                    def loss_fn(d):
+                        _, _, loss = net.apply(
+                            variables, {**d, **rest},
+                            rng=jax.random.key(0), start=start, end=end,
+                        )
+                        return loss
+
+                    return jax.grad(loss_fn)(diff)
+
+            self._partial_fns[key] = jax.jit(grad_fn)
+        return self._partial_fns[key](self.solver.variables, arrs)
 
     # -- weight exchange (ref: Net.scala:131-171) --------------------------
     def get_weights(self) -> WeightCollection:

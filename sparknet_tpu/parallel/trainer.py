@@ -78,6 +78,9 @@ class ParallelTrainer:
         # processes the mesh spans: >1 switches _put_feeds to per-process
         # shard assembly; a process-local sub-mesh stays single-host
         self._mesh_procs = len({d.process_index for d in self.mesh.devices.flat})
+        # data-axis width THIS process feeds (the per-host worker count a
+        # driver loop should build batches for)
+        self.num_local_workers = max(self.num_workers // self._mesh_procs, 1)
         self.iter = 0
         self._step_fn = solver._make_train_step()
         self._rules = rules or ShardingRules()

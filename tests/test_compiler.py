@@ -259,3 +259,39 @@ def test_forward_from_to_partial_execution():
             net.solver.variables, {"label": feeds["label"]},
             train=False, start="conv2", end="ip2",
         )
+
+
+def test_backward_from_to_and_wrt_inputs():
+    """Partial backward (ref: Net::BackwardFromTo net.cpp:635-646):
+    range-restricted grads, and bottom-diffs via wrt='inputs'."""
+    from sparknet_tpu import models
+    from sparknet_tpu.net import TPUNet
+    from sparknet_tpu.solvers.solver import SolverConfig
+
+    net = TPUNet(SolverConfig(), models.lenet(4))
+    rs = np.random.RandomState(0)
+    feeds = {
+        "data": rs.randn(4, 1, 28, 28).astype(np.float32) * 40,
+        "label": rs.randint(0, 10, 4).astype(np.int32),
+    }
+    full_g = net.backward(feeds)
+    assert any(float(jnp.abs(g).sum()) > 0 for g in full_g["conv1"])
+
+    # head-only range: grads flow to head params, conv trunk untouched
+    blobs = net.forward(feeds)
+    head_g = net.backward(
+        {"pool2": blobs["pool2"], "label": feeds["label"]}, start="ip1"
+    )
+    assert any(float(jnp.abs(g).sum()) > 0 for g in head_g["ip1"])
+    assert all(float(jnp.abs(g).sum()) == 0 for g in head_g["conv1"])
+
+    # bottom diffs: d(loss)/d(fed blob)
+    in_g = net.backward(
+        {"pool2": blobs["pool2"], "label": feeds["label"]},
+        start="ip1", wrt="inputs",
+    )
+    assert set(in_g) == {"pool2"}
+    assert float(jnp.abs(in_g["pool2"]).sum()) > 0
+
+    with pytest.raises(ValueError, match="wrt must be"):
+        net.backward(feeds, wrt="blobs")

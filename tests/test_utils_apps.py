@@ -518,3 +518,45 @@ def test_cli_deprecated_tools():
     for cmd in ("train_net", "finetune_net", "test_net", "net_speed_benchmark"):
         with pytest.raises(SystemExit, match="Deprecated"):
             main([cmd, "whatever.prototxt"])
+
+
+def test_cli_train_multihost_two_processes(tmp_path):
+    """tpunet train --distributed across 2 processes: DCN bring-up via
+    CLI flags, per-process synthetic shards, both exit clean."""
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["JAX_PLATFORMS"] = "cpu"
+
+    def spawn(pid):
+        return subprocess.Popen(
+            [sys.executable, "-m", "sparknet_tpu.cli", "--platform", "cpu",
+             "train", "--solver", "zoo:lenet", "--batch", "8",
+             "--data", "synthetic", "--iterations", "2", "--distributed",
+             "--coordinator", f"127.0.0.1:{port}", "--num-processes", "2",
+             "--process-id", str(pid), "--output", str(tmp_path / f"out{pid}")],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=str(tmp_path),
+        )
+
+    procs = [spawn(0), spawn(1)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.poll() is None and p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-2000:]
+    assert any("distributed: process" in o for o in outs)
