@@ -1,0 +1,80 @@
+"""Casting an InnerProduct classifier to a Convolution — the reference's
+net_surgery notebook (ref: caffe/examples/net_surgery.ipynb +
+net_surgery/bvlc_caffenet_full_conv.prototxt): reshape fc weights into
+1x1-or-larger conv kernels so the net runs on larger inputs and emits a
+score MAP instead of a single prediction.
+
+Run:  python examples/net_surgery.py  [--platform cpu]
+"""
+
+import sys
+
+import numpy as np
+
+if "--platform" in sys.argv:
+    import jax
+
+    jax.config.update("jax_platforms", sys.argv[sys.argv.index("--platform") + 1])
+
+import jax.numpy as jnp
+
+from sparknet_tpu.common import Phase
+from sparknet_tpu.compiler.graph import Network
+from sparknet_tpu.proto import parse
+
+FC_NET = """
+name: "tiny_fc"
+input: "data" input_shape { dim: 1 dim: 3 dim: 8 dim: 8 }
+layer { name: "conv" type: "Convolution" bottom: "data" top: "conv"
+        convolution_param { num_output: 4 kernel_size: 3 stride: 1
+          weight_filler { type: "xavier" } } }
+layer { name: "fc" type: "InnerProduct" bottom: "conv" top: "fc"
+        inner_product_param { num_output: 5 weight_filler { type: "xavier" } } }
+"""
+
+CONV_NET = """
+name: "tiny_full_conv"
+input: "data" input_shape { dim: 1 dim: 3 dim: 12 dim: 12 }
+layer { name: "conv" type: "Convolution" bottom: "data" top: "conv"
+        convolution_param { num_output: 4 kernel_size: 3 stride: 1
+          weight_filler { type: "xavier" } } }
+layer { name: "fc_conv" type: "Convolution" bottom: "conv" top: "fc_conv"
+        convolution_param { num_output: 5 kernel_size: 6
+          weight_filler { type: "xavier" } } }
+"""
+
+
+def main():
+    import jax as _jax
+
+    fc_net = Network(parse(FC_NET), Phase.TEST)
+    fc_vars = fc_net.init(_jax.random.PRNGKey(0))
+
+    conv_net = Network(parse(CONV_NET), Phase.TEST)
+    conv_vars = conv_net.init(_jax.random.PRNGKey(1))
+
+    # the surgery: fc (5, 4*6*6) -> conv kernel (5, 4, 6, 6)
+    w_fc, b_fc = fc_vars.params["fc"]
+    conv_vars.params["conv"][:] = fc_vars.params["conv"]
+    conv_vars.params["fc_conv"][:] = [w_fc.reshape(5, 4, 6, 6), b_fc]
+
+    rs = np.random.RandomState(0)
+    small = rs.randn(1, 3, 8, 8).astype(np.float32)
+    big = np.zeros((1, 3, 12, 12), np.float32)
+    big[:, :, :8, :8] = small  # the small input sits in the corner
+
+    fc_out, _, _ = fc_net.apply(fc_vars, {"data": jnp.asarray(small)}, rng=None)
+    conv_out, _, _ = conv_net.apply(conv_vars, {"data": jnp.asarray(big)}, rng=None)
+
+    # corner of the score map == the fc net's prediction
+    map_scores = np.asarray(conv_out["fc_conv"])[0, :, 0, 0]
+    fc_scores = np.asarray(fc_out["fc"])[0]
+    print("fc scores:  ", fc_scores)
+    print("map corner: ", map_scores)
+    np.testing.assert_allclose(map_scores, fc_scores, atol=1e-4)
+    print("score map shape:", np.asarray(conv_out["fc_conv"]).shape)  # (1,5,5,5)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

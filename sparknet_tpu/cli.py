@@ -79,7 +79,10 @@ def _data_fns(args, net):
             }
 
         def test_fn(b):
-            lo = ((b * nproc + pid) * batch) % (len(yte) - batch + 1)
+            # eval streams stay IDENTICAL across processes (only training
+            # shards): every host then computes the same score, keeping
+            # the sum-then-normalize semantics well-defined
+            lo = (b * batch) % (len(yte) - batch + 1)
             return {
                 "data": xform(xte[lo : lo + batch], False),
                 "label": yte[lo : lo + batch].astype(np.int32),
@@ -91,13 +94,21 @@ def _data_fns(args, net):
         rs = np.random.RandomState(pid)
         num_classes = 10
 
-        def synth(it):
+        def synth_train(it):
             return {
                 "data": (rs.randn(*data_shape) * 50).astype(np.float32),
                 "label": rs.randint(0, num_classes, batch).astype(np.int32),
             }
 
-        return synth, synth
+        def synth_test(b):
+            # stateless per-batch seed, identical on every process
+            rs2 = np.random.RandomState(100_000 + b)
+            return {
+                "data": (rs2.randn(*data_shape) * 50).astype(np.float32),
+                "label": rs2.randint(0, num_classes, batch).astype(np.int32),
+            }
+
+        return synth_train, synth_test
 
     raise SystemExit(f"unknown --data source {args.data!r}")
 
@@ -105,6 +116,8 @@ def _data_fns(args, net):
 # ---------------------------------------------------------------------------
 def cmd_train(args) -> int:
     """ref: caffe.cpp:153-218 train()."""
+    import jax
+
     from sparknet_tpu.parallel.trainer import ParallelTrainer
     from sparknet_tpu.solvers.solver import Solver
     from sparknet_tpu.utils import EventLogger, SignalHandler, SolverAction
@@ -113,6 +126,10 @@ def cmd_train(args) -> int:
         # ref: caffe.cpp:161-163 "Give a snapshot to resume training or
         # weights to finetune but not both." — fail before building the net
         raise SystemExit("--snapshot and --weights are mutually exclusive")
+    if getattr(args, "coordinator", "") and not getattr(args, "num_processes", 0):
+        # a lone --coordinator would silently skip the whole multi-host
+        # block and train unsynced independent models on every host
+        raise SystemExit("--coordinator requires --num-processes")
     if getattr(args, "num_processes", 0):
         # multi-host bring-up (ref: SURVEY §2.4 — the Spark driver/executor
         # topology's replacement).  Must precede the first jax backend
@@ -184,7 +201,11 @@ def cmd_train(args) -> int:
                     action = sig.check()
                     if action is SolverAction.SNAPSHOT:
                         trainer.sync_to_solver()
-                        solver.save(f"tpunet_iter_{trainer.iter}")
+                        # process 0 owns snapshots (replicated params are
+                        # identical; concurrent same-path writes from
+                        # every host would corrupt the file)
+                        if jax.process_index() == 0:
+                            solver.save(f"tpunet_iter_{trainer.iter}")
                     elif action is SolverAction.STOP:
                         break
             trainer.sync_to_solver()
@@ -210,8 +231,9 @@ def cmd_train(args) -> int:
     if args.test_iters:
         scores = solver.test(args.test_iters, test_fn)
         log(f"scores: {scores}", i=solver.iter)
-    out = solver.save(args.output or "tpunet_final")
-    log(f"saved {out}")
+    if jax.process_index() == 0:
+        out = solver.save(args.output or "tpunet_final")
+        log(f"saved {out}")
     return 0
 
 

@@ -299,6 +299,12 @@ class TPUNet:
                         k: v for k, v in arrs.items()
                         if jnp.issubdtype(v.dtype, jnp.floating)
                     }
+                    if not diff:
+                        raise ValueError(
+                            "wrt='inputs' needs at least one floating-point "
+                            f"feed to differentiate; got {list(arrs)} (cast "
+                            "integer image blobs to float first)"
+                        )
                     rest = {k: v for k, v in arrs.items() if k not in diff}
 
                     def loss_fn(d):
@@ -322,8 +328,9 @@ class TPUNet:
 
     # -- zoo interchange (ref: Net::ToProto net.cpp:911 + Snapshot; shim
     # save/load_weights_to/from_file ccaffe.cpp:261-269) -------------------
-    def save_caffemodel(self, path: str) -> None:
-        """Write params as a wire-compatible binary NetParameter."""
+    def save_caffemodel(self, path: str) -> str:
+        """Write params as a wire-compatible binary NetParameter;
+        returns ``path`` (like ``Solver.save``)."""
         from sparknet_tpu.proto.binary import (
             CaffeModel,
             CaffeModelLayer,
@@ -347,6 +354,7 @@ class TPUNet:
                 CaffeModelLayer(lname, type_by_name.get(lname, ""), blobs)
             )
         save_caffemodel(path, CaffeModel(self.train_net.net_param.get_str("name", ""), layers))
+        return path
 
     def load_caffemodel(self, path: str, strict_shapes: bool = True) -> list[str]:
         """Copy params by layer name (CopyTrainedLayersFrom semantics,

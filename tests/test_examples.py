@@ -1,0 +1,31 @@
+"""Smoke-run the examples/ scripts (the reference ships its workflows as
+examples/ notebooks; ours are runnable scripts — ref:
+caffe/examples/01-learning-lenet.ipynb et al., mapped in
+docs/EXAMPLES.md).  Each runs as a subprocess the way a user would."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SCRIPTS = [
+    "01_learning_lenet.py",
+    "02_brewing_logreg.py",
+    "03_fine_tuning.py",
+    "net_surgery.py",
+]
+
+
+@pytest.mark.parametrize("script", SCRIPTS)
+def test_example_runs(script):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "examples", script),
+         "--platform", "cpu"],
+        capture_output=True, text=True, timeout=560, env=env,
+    )
+    assert out.returncode == 0, (out.stdout + out.stderr)[-2000:]
