@@ -53,6 +53,11 @@ class ParallelTrainer:
     tau == 1: synchronous DP (+ optional tensor parallelism via rules).
     tau  > 1: SparkNet periodic model averaging; every `train_round()` runs
     tau local steps per data-shard then averages params+state over the mesh.
+    elastic_alpha > 0: EASGD — workers elastically couple to a replicated
+    center variable every round instead of hard-averaging (the reference's
+    unrealized ROADMAP.md:11 "elastic SGD"; Zhang et al. 2015).  Use
+    alpha ≈ 0.9 / num_workers (moving rate β = p·α ≤ 1); eval/get_weights
+    expose the center.
     """
 
     def __init__(
@@ -61,6 +66,7 @@ class ParallelTrainer:
         mesh=None,
         tau: int = 1,
         rules: ShardingRules | None = None,
+        elastic_alpha: float = 0.0,
     ):
         cfg = get_config()
         if solver.config.iter_size > 1:
@@ -88,7 +94,20 @@ class ParallelTrainer:
             solver.train_net, solver.variables, self.mesh, self._rules
         )
 
-        if self.tau == 1:
+        self.elastic_alpha = float(elastic_alpha)
+        self._elastic = elastic_alpha > 0.0
+        if elastic_alpha and not (
+            0.0 < elastic_alpha * self.num_workers <= 1.0
+        ):
+            # EASGD stability: the center's moving rate is beta = p*alpha
+            # and must stay in (0, 1] (Zhang et al. 2015 use beta = 0.9)
+            raise ValueError(
+                f"elastic_alpha={elastic_alpha} violates the stability "
+                f"bound alpha*num_workers <= 1 with "
+                f"{self.num_workers} workers; use ~0.9/{self.num_workers}"
+            )
+
+        if self.tau == 1 and not self._elastic:
             self.variables = place(solver.variables, self._pshard)
             self.slots = self._place_slots(solver.slots)
             self._train = jax.jit(self._step_fn, donate_argnums=(0, 1))
@@ -105,7 +124,21 @@ class ParallelTrainer:
             )
             self.variables = put(stack(solver.variables))
             self.slots = put(stack(solver.slots))
-            self._train = jax.jit(self._make_tau_round(), donate_argnums=(0, 1))
+            if self._elastic:
+                # EASGD (Zhang, Choromanska, LeCun 2015 — the reference's
+                # unrealized ROADMAP.md:11 item): workers couple to a
+                # replicated CENTER variable instead of hard-averaging
+                rep = NamedSharding(self.mesh, P())
+                self.center = jax.tree_util.tree_map(
+                    lambda x: jax.device_put(x, rep), solver.variables.params
+                )
+                self._train = jax.jit(
+                    self._make_elastic_round(), donate_argnums=(0, 1, 2)
+                )
+            else:
+                self._train = jax.jit(
+                    self._make_tau_round(), donate_argnums=(0, 1)
+                )
 
         # tau>1 keeps per-replica params; average once per test() call (not
         # per batch) and feed the solver's own jitted eval step — one shared
@@ -127,28 +160,36 @@ class ParallelTrainer:
         return out
 
     # ------------------------------------------------------------------
+    def _local_tau_steps(self, v_blk, s_blk, it_, feeds_blk, key_):
+        """Per-worker leg shared by both stacked rounds: unstack this
+        worker's replica, run tau local solver steps over the feed slots."""
+        step, axis = self._step_fn, self.data_axis
+        sq = lambda t: jax.tree_util.tree_map(lambda x: x[0], t)
+        v, sl = sq(v_blk), sq(s_blk)
+        wkey = jax.random.fold_in(key_, jax.lax.axis_index(axis))
+
+        def one(carry, feed):
+            v, sl, i = carry
+            v, sl, loss = step(v, sl, i, feed, wkey)
+            return (v, sl, i + 1), loss
+
+        (v, sl, _), losses = jax.lax.scan(one, (v, sl, it_), feeds_blk)
+        return v, sl, jax.lax.pmean(jnp.mean(losses), axis)
+
     def _make_tau_round(self):
-        step, tau, axis = self._step_fn, self.tau, self.data_axis
+        axis = self.data_axis
         in_specs = (P(axis), P(axis), P(), P(None, axis), P())
         out_specs = (P(axis), P(axis), P())
+        ex = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
 
         def round_fn(variables, slots, it, feeds, key):
             def body(v_blk, s_blk, it_, feeds_blk, key_):
-                sq = lambda t: jax.tree_util.tree_map(lambda x: x[0], t)
-                v, sl = sq(v_blk), sq(s_blk)
-                wkey = jax.random.fold_in(key_, jax.lax.axis_index(axis))
-
-                def one(carry, feed):
-                    v, sl, i = carry
-                    v, sl, loss = step(v, sl, i, feed, wkey)
-                    return (v, sl, i + 1), loss
-
-                (v, sl, _), losses = jax.lax.scan(one, (v, sl, it_), feeds_blk)
+                v, sl, loss = self._local_tau_steps(
+                    v_blk, s_blk, it_, feeds_blk, key_
+                )
                 # THE sync: collect+average over workers == pmean over ICI
                 # (ref: CifarApp.scala:132-134 reduce(add)/scalarDivide)
                 v = jax.tree_util.tree_map(lambda x: jax.lax.pmean(x, axis), v)
-                ex = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
-                loss = jax.lax.pmean(jnp.mean(losses), axis)
                 return ex(v), ex(sl), loss
 
             return shard_map(
@@ -157,6 +198,48 @@ class ParallelTrainer:
                 in_specs=in_specs,
                 out_specs=out_specs,
             )(variables, slots, it, feeds, key)
+
+        return round_fn
+
+    # ------------------------------------------------------------------
+    def _make_elastic_round(self):
+        """EASGD round: tau local steps per worker, then the elastic
+        update  x_i -= α(x_i - x̃);  x̃ += α·Σ_i(x_i - x̃)  (moving rate
+        β = p·α).  Workers stay DISTINCT replicas — exploration — while
+        the center integrates them; β = p·α ≤ 1 for stability (choose
+        α ≈ 0.9/p).  BatchNorm-style state is hard-averaged."""
+        axis = self.data_axis
+        alpha = self.elastic_alpha
+        in_specs = (P(axis), P(axis), P(), P(), P(None, axis), P())
+        out_specs = (P(axis), P(axis), P(), P())
+        ex = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
+
+        def round_fn(variables, slots, center, it, feeds, key):
+            def body(v_blk, s_blk, center_, it_, feeds_blk, key_):
+                v, sl, loss = self._local_tau_steps(
+                    v_blk, s_blk, it_, feeds_blk, key_
+                )
+                diff = jax.tree_util.tree_map(
+                    lambda x, c: x - c, v.params, center_
+                )
+                new_params = jax.tree_util.tree_map(
+                    lambda x, d: x - alpha * d, v.params, diff
+                )
+                new_center = jax.tree_util.tree_map(
+                    lambda c, d: c + alpha * jax.lax.psum(d, axis), center_, diff
+                )
+                new_state = jax.tree_util.tree_map(
+                    lambda x: jax.lax.pmean(x, axis), v.state
+                )
+                v = NetVars(params=new_params, state=new_state)
+                return ex(v), ex(sl), new_center, loss
+
+            return shard_map(
+                body,
+                mesh=self.mesh,
+                in_specs=in_specs,
+                out_specs=out_specs,
+            )(variables, slots, center, it, feeds, key)
 
         return round_fn
 
@@ -198,12 +281,21 @@ class ParallelTrainer:
 
         tau == 1: data_fn(it) -> feeds [B_global, ...]; one sync-SGD step.
         tau  > 1: data_fn(it) -> feeds [tau, B_global, ...]; tau local steps
-        on every worker, then model averaging.  On a multi-process mesh
-        the batch axis is the PER-PROCESS shard instead of B_global —
-        each host feeds only its own partition (see _put_feeds).  Returns
-        mean loss (device value materialized — call sites that care about
-        overlap should batch rounds)."""
-        if self.tau == 1:
+        on every worker, then model averaging.  elastic_alpha > 0 always
+        takes the tau-shaped feed contract ([tau, B_global, ...], tau may
+        be 1) and applies the EASGD elastic update instead of averaging.
+        On a multi-process mesh the batch axis is the PER-PROCESS shard
+        instead of B_global — each host feeds only its own partition (see
+        _put_feeds).  Returns mean loss (device value materialized — call
+        sites that care about overlap should batch rounds)."""
+        if self._elastic:
+            feeds = self._put_feeds(data_fn(self.iter), with_tau_axis=True)
+            self.variables, self.slots, self.center, loss = self._train(
+                self.variables, self.slots, self.center, self.iter, feeds,
+                self.solver._key,
+            )
+            self.iter += self.tau
+        elif self.tau == 1:
             feeds = self._put_feeds(data_fn(self.iter), with_tau_axis=False)
             self.variables, self.slots, loss = self._train(
                 self.variables, self.slots, self.iter, feeds, self.solver._key
@@ -241,6 +333,12 @@ class ParallelTrainer:
 
     # ------------------------------------------------------------------
     def _averaged_variables(self) -> NetVars:
+        if self._elastic:
+            # EASGD evaluates the CENTER variable (consensus model);
+            # worker-local BN-style state is averaged (params skipped —
+            # the center already is the consensus)
+            state = self._average(self.variables.state)
+            return NetVars(params=self.center, state=state)
         if self.tau == 1:
             return self.variables
         return self._average(self.variables)
@@ -251,7 +349,7 @@ class ParallelTrainer:
 
     def set_weights(self, wc: WeightCollection) -> None:
         v = collection_to_variables(wc, self.solver.variables)
-        if self.tau == 1:
+        if self.tau == 1 and not self._elastic:
             self.variables = place(v, self._pshard)
         else:
             R = self.num_workers
@@ -262,6 +360,11 @@ class ParallelTrainer:
                 ),
                 v,
             )
+            if self._elastic:
+                rep = NamedSharding(self.mesh, P())
+                self.center = jax.tree_util.tree_map(
+                    lambda x: jax.device_put(x, rep), v.params
+                )
 
     def sync_to_solver(self) -> None:
         """Pull the averaged model AND optimizer history back into the
@@ -272,6 +375,7 @@ class ParallelTrainer:
         self.solver.variables = jax.tree_util.tree_map(
             np.asarray, self._averaged_variables()
         )
-        slots = self.slots if self.tau == 1 else self._average(self.slots)
+        stacked = self.tau > 1 or self._elastic
+        slots = self._average(self.slots) if stacked else self.slots
         self.solver.slots = jax.tree_util.tree_map(np.asarray, slots)
         self.solver.iter = self.iter

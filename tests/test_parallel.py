@@ -374,3 +374,54 @@ def test_multihost_two_process_cluster():
                 digests[pid] = (d1, d2)
     assert set(digests) == {"0", "1"}, outs
     assert digests["0"] == digests["1"], digests
+
+
+def test_elastic_averaging_easgd():
+    """EASGD mode: converges on the synthetic task, keeps workers as
+    DISTINCT replicas exploring around the center, and the center (the
+    consensus model exposed by get_weights/test) tracks them."""
+    cfg = SolverConfig(base_lr=0.05, momentum=0.9, solver_type="SGD")
+    solver = Solver(cfg, small_net())
+    R = len(jax.devices())
+    trainer = ParallelTrainer(solver, tau=2, elastic_alpha=0.9 / R)
+
+    imgs, labels = synth(BATCH * R * 2)
+
+    def data_fn(it):
+        f = feeds_of(imgs, labels)
+        return {k: np.stack([v, v]) for k, v in f.items()}  # [tau=2, B*R, ...]
+
+    l0 = trainer.train_round(data_fn)
+    for _ in range(20):
+        loss = trainer.train_round(data_fn)
+    assert loss < l0, (l0, loss)
+
+    # workers differ from the center (exploration), but are coupled to it
+    leaves = jax.tree_util.tree_leaves(trainer.variables.params)
+    centers = jax.tree_util.tree_leaves(trainer.center)
+    gaps = [
+        float(jnp.max(jnp.abs(w - c[None]))) for w, c in zip(leaves, centers)
+    ]
+    assert max(gaps) > 0.0
+    scale = max(float(jnp.max(jnp.abs(c))) for c in centers)
+    assert max(gaps) < max(scale, 1.0)  # bounded: the elastic force works
+
+    # eval + weight exchange go through the center
+    scores = trainer.test(2, lambda b: feeds_of(imgs, labels))
+    assert np.isfinite(scores["accuracy"])
+    wc = trainer.get_weights()
+    np.testing.assert_allclose(
+        wc[list(wc.layers())[0]][0],
+        np.asarray(jax.tree_util.tree_leaves(trainer.center)[0]),
+        rtol=1e-6,
+    )
+
+    # snapshot path: solver sees the consensus model
+    trainer.sync_to_solver()
+    assert trainer.solver.variables.params.keys() == solver.variables.params.keys()
+
+    with pytest.raises(ValueError, match="elastic_alpha"):
+        ParallelTrainer(solver, tau=1, elastic_alpha=1.5)
+    # alpha in (0,1) but violating alpha*(1+p) < 1 on this mesh: rejected
+    with pytest.raises(ValueError, match="stability"):
+        ParallelTrainer(solver, tau=1, elastic_alpha=0.5)
