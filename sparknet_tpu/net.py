@@ -185,6 +185,50 @@ def copy_hdf5_params(
     return params, loaded
 
 
+def export_caffemodel(network: Network, params: dict[str, list], path: str) -> str:
+    """Write a params pytree as a wire-compatible binary NetParameter
+    (ref: Net::ToProto net.cpp:911 + Solver::SnapshotToBinaryProto).
+    Shared-param aliases write the owner's values, matching Caffe's
+    per-layer duplication of shared blobs."""
+    from sparknet_tpu.proto.binary import (
+        CaffeModel,
+        CaffeModelLayer,
+        save_caffemodel,
+    )
+
+    layers = []
+    type_by_name = {l.name: l.TYPE for l in network.layers}
+    aliases = network.param_aliases
+    for lname, plist in params.items():
+        blobs = []
+        for i, p in enumerate(plist):
+            owner = aliases.get((lname, i))
+            if owner is not None:
+                p = params[owner[0]][owner[1]]
+            blobs.append(np.asarray(p))
+        layers.append(CaffeModelLayer(lname, type_by_name.get(lname, ""), blobs))
+    save_caffemodel(path, CaffeModel(network.net_param.get_str("name", ""), layers))
+    return path
+
+
+def export_hdf5(network: Network, params: dict[str, list], path: str) -> str:
+    """HDF5 variant (ref: Net::ToHDF5 net.cpp:926+): group
+    ``data/<layer>/<i>`` per blob; shared aliases write the owner."""
+    import h5py
+
+    aliases = network.param_aliases
+    with h5py.File(path, "w") as f:
+        data = f.create_group("data")
+        for lname, plist in params.items():
+            g = data.create_group(lname)
+            for i, p in enumerate(plist):
+                owner = aliases.get((lname, i))
+                if owner is not None:
+                    p = params[owner[0]][owner[1]]
+                g.create_dataset(str(i), data=np.asarray(p))
+    return path
+
+
 class TPUNet:
     """The CaffeNet-equivalent handle (ref: Net.scala:67-250): owns the
     compiled train/test programs, the solver state, and the data hookups."""
@@ -331,30 +375,9 @@ class TPUNet:
     def save_caffemodel(self, path: str) -> str:
         """Write params as a wire-compatible binary NetParameter;
         returns ``path`` (like ``Solver.save``)."""
-        from sparknet_tpu.proto.binary import (
-            CaffeModel,
-            CaffeModelLayer,
-            save_caffemodel,
+        return export_caffemodel(
+            self.train_net, self.solver.variables.params, path
         )
-
-        layers = []
-        type_by_name = {l.name: l.TYPE for l in self.train_net.layers}
-        aliases = self.train_net.param_aliases
-        all_params = self.solver.variables.params
-        for lname, plist in all_params.items():
-            blobs = []
-            for i, p in enumerate(plist):
-                owner = aliases.get((lname, i))
-                if owner is not None:
-                    # write the owner's (current) array, matching Caffe's
-                    # per-layer duplication of shared blobs in ToProto
-                    p = all_params[owner[0]][owner[1]]
-                blobs.append(np.asarray(p))
-            layers.append(
-                CaffeModelLayer(lname, type_by_name.get(lname, ""), blobs)
-            )
-        save_caffemodel(path, CaffeModel(self.train_net.net_param.get_str("name", ""), layers))
-        return path
 
     def load_caffemodel(self, path: str, strict_shapes: bool = True) -> list[str]:
         """Copy params by layer name (CopyTrainedLayersFrom semantics,
@@ -374,19 +397,7 @@ class TPUNet:
         """Layout mirrors Caffe's: group ``data/<layer>/<i>`` per blob.
         Shared-param aliases write the owner's values (Caffe duplicates
         shared blobs per layer)."""
-        import h5py
-
-        aliases = self.train_net.param_aliases
-        all_params = self.solver.variables.params
-        with h5py.File(path, "w") as f:
-            data = f.create_group("data")
-            for lname, plist in all_params.items():
-                g = data.create_group(lname)
-                for i, p in enumerate(plist):
-                    owner = aliases.get((lname, i))
-                    if owner is not None:
-                        p = all_params[owner[0]][owner[1]]
-                    g.create_dataset(str(i), data=np.asarray(p))
+        export_hdf5(self.train_net, self.solver.variables.params, path)
 
     def load_hdf5(self, path: str) -> list[str]:
         """Copy-by-layer-name with the same semantics as load_caffemodel."""

@@ -82,6 +82,10 @@ class SolverConfig:
     snapshot: int = 0
     snapshot_prefix: str = ""
     snapshot_after_train: bool = True
+    # BINARYPROTO -> <prefix>.caffemodel, HDF5 -> <prefix>.caffemodel.h5
+    # written alongside the solver state (ref: Solver::Snapshot
+    # solver.cpp:447-466 model + state pair); "" skips the model file
+    snapshot_format: str = "BINARYPROTO"
 
     @classmethod
     def from_proto(cls, m: Message) -> "SolverConfig":
@@ -124,6 +128,7 @@ class SolverConfig:
             snapshot=m.get_int("snapshot", 0),
             snapshot_prefix=m.get_str("snapshot_prefix", ""),
             snapshot_after_train=m.get_bool("snapshot_after_train", True),
+            snapshot_format=m.get_str("snapshot_format", "BINARYPROTO"),
         )
 
 
@@ -165,6 +170,12 @@ class Solver:
         self.config = (
             solver if isinstance(solver, SolverConfig) else SolverConfig.from_proto(solver)
         )
+        if self.config.snapshot_format.upper() not in ("", "BINARYPROTO", "HDF5"):
+            # fail at construction, not hours later at the first snapshot
+            raise ValueError(
+                f"unknown snapshot_format {self.config.snapshot_format!r} "
+                "(BINARYPROTO|HDF5|'')"
+            )
         self.net_param = net_param
         self.train_net = Network(net_param, Phase.TRAIN, batch_override)
         # one TEST net per test_state (ref: Solver::InitTestNets
@@ -413,10 +424,13 @@ class Solver:
         if format == "orbax":
             from sparknet_tpu.solvers.orbax_io import save_orbax
 
-            return save_orbax(self, prefix)
+            out = save_orbax(self, prefix)
+            self._export_model_pair(prefix)
+            return out
         if format != "npz":
             raise ValueError(f"unknown snapshot format {format!r} (npz|orbax)")
         path = f"{prefix}.solverstate.npz"
+        self._export_model_pair(prefix)
         flat: dict[str, np.ndarray] = {"__iter__": np.asarray(self.iter)}
         flat["__meta__"] = np.frombuffer(
             json.dumps({"solver_type": self.config.solver_type}).encode(), dtype=np.uint8
@@ -433,6 +447,38 @@ class Solver:
                     flat[f"hist/{lname}/{i}/{j}"] = np.asarray(h)
         np.savez(path, **flat)
         return path
+
+    def _export_model_pair(self, prefix: str) -> None:
+        """The model file beside the state, like the reference's
+        .caffemodel/.solverstate pair (ref: Solver::Snapshot
+        solver.cpp:447-466); ``snapshot_format`` picks the wire format."""
+        fmt = self.config.snapshot_format.upper()
+        if not fmt:
+            return
+        leaves = [
+            p
+            for plist in self.variables.params.values()
+            for p in plist
+            if isinstance(p, jax.Array)
+        ]
+        if any(not p.is_fully_addressable for p in leaves):
+            # pod-scale sharded params: the host-side wire export cannot
+            # materialize them here; the orbax checkpoint is the artifact
+            print(
+                f"skipping {fmt} model export at {prefix!r}: params span "
+                "non-addressable devices (use the orbax checkpoint)"
+            )
+            return
+        from sparknet_tpu.net import export_caffemodel, export_hdf5
+
+        if fmt == "BINARYPROTO":
+            export_caffemodel(
+                self.train_net, self.variables.params, f"{prefix}.caffemodel"
+            )
+        else:  # validated to HDF5 at construction
+            export_hdf5(
+                self.train_net, self.variables.params, f"{prefix}.caffemodel.h5"
+            )
 
     def restore(self, path: str) -> None:
         if path.endswith(".orbax") or os.path.isdir(path):

@@ -422,6 +422,7 @@ def test_elastic_averaging_easgd():
 
     with pytest.raises(ValueError, match="elastic_alpha"):
         ParallelTrainer(solver, tau=1, elastic_alpha=1.5)
-    # alpha in (0,1) but violating alpha*(1+p) < 1 on this mesh: rejected
+    # alpha in (0,1) but violating alpha*p <= 1 on this mesh: rejected
+    # (1.5/R trips the bound for any worker count)
     with pytest.raises(ValueError, match="stability"):
-        ParallelTrainer(solver, tau=1, elastic_alpha=0.5)
+        ParallelTrainer(solver, tau=1, elastic_alpha=1.5 / R)

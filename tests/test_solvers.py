@@ -520,3 +520,41 @@ def test_solve_iter_size_display_and_early_loss(tmp_path):
         assert os.path.exists("solver_iter_6.solverstate.npz")
     finally:
         os.chdir(cwd)
+
+
+def test_snapshot_writes_model_file_pair(tmp_path):
+    """Snapshots produce the reference's model+state pair (ref:
+    Solver::Snapshot solver.cpp:447-466): .caffemodel (BINARYPROTO,
+    default) or .caffemodel.h5 (HDF5), loadable by the finetune path."""
+    from sparknet_tpu.net import copy_caffemodel_params, copy_hdf5_params
+
+    data_fn, _ = _linreg_data_fn()
+
+    solver = _make_solver(SolverConfig(base_lr=0.02))
+    solver.step(3, data_fn)
+    solver.save(str(tmp_path / "snap"))
+    model = tmp_path / "snap.caffemodel"
+    assert model.exists()
+    fresh = _make_solver(SolverConfig(base_lr=0.02))
+    params, loaded = copy_caffemodel_params(fresh.variables.params, str(model))
+    assert "ip" in loaded
+    np.testing.assert_allclose(
+        np.asarray(params["ip"][0]), np.asarray(solver.variables.params["ip"][0])
+    )
+
+    solver_h5 = _make_solver(
+        SolverConfig(base_lr=0.02, snapshot_format="HDF5")
+    )
+    solver_h5.save(str(tmp_path / "h5snap"))
+    h5 = tmp_path / "h5snap.caffemodel.h5"
+    assert h5.exists()
+    _, loaded = copy_hdf5_params(fresh.variables.params, str(h5))
+    assert "ip" in loaded
+
+    solver_none = _make_solver(SolverConfig(base_lr=0.02, snapshot_format=""))
+    solver_none.save(str(tmp_path / "bare"))
+    assert not (tmp_path / "bare.caffemodel").exists()
+
+    # bad values fail at construction, not at the first snapshot boundary
+    with pytest.raises(ValueError, match="snapshot_format"):
+        _make_solver(SolverConfig(base_lr=0.02, snapshot_format="npz"))
