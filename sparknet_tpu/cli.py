@@ -673,6 +673,34 @@ def cmd_parse_log(args) -> int:
     return 0
 
 
+def cmd_plot_training_log(args) -> int:
+    """ref: tools/extra/plot_training_log.py.example — chart type 0-7."""
+    from sparknet_tpu.utils.plotting import plot_chart
+
+    try:
+        out = plot_chart(args.chart_type, args.logfile, args.out)
+    except (ValueError, RuntimeError) as e:
+        raise SystemExit(str(e)) from None
+    print(json.dumps({"chart": out}))
+    return 0
+
+
+def cmd_resize_images(args) -> int:
+    """ref: tools/extra/resize_and_crop_images.py — offline dataset prep."""
+    from sparknet_tpu.data.resize_images import resize_tree
+
+    try:
+        ok, errors = resize_tree(
+            args.input_folder, args.output_folder, args.side, args.workers
+        )
+    except ValueError as e:
+        raise SystemExit(str(e)) from None
+    for path, msg in errors[:20]:
+        print(f"{path}: {msg}", file=sys.stderr)
+    print(json.dumps({"resized": ok, "errors": len(errors)}))
+    return 0 if not errors else 1
+
+
 def _cmd_deprecated(replacement):
     def fn(args) -> int:
         # ref: tools/{train,test,finetune}_net.cpp, net_speed_benchmark.cpp —
@@ -827,6 +855,23 @@ def main(argv=None) -> int:
                     help="output directory (default: next to the log)")
     sp.add_argument("--delimiter", default=",")
     sp.set_defaults(fn=cmd_parse_log)
+
+    sp = sub.add_parser("plot_training_log",
+                        help="training log -> chart PNG (types 0-7)")
+    sp.add_argument("chart_type", type=int,
+                    help="0/1 test acc, 2/3 test loss, 4/5 train lr, "
+                    "6/7 train loss (vs iters/seconds)")
+    sp.add_argument("out", help="output .png")
+    sp.add_argument("logfile")
+    sp.set_defaults(fn=cmd_plot_training_log)
+
+    sp = sub.add_parser("resize_images",
+                        help="resize-shorter-side + center-crop a tree")
+    sp.add_argument("--input-folder", required=True)
+    sp.add_argument("--output-folder", required=True)
+    sp.add_argument("--side", type=int, default=256)
+    sp.add_argument("--workers", type=int, default=0)
+    sp.set_defaults(fn=cmd_resize_images)
 
     for cmd, repl in (
         ("train_net", "train --solver=... [--snapshot=...]"),

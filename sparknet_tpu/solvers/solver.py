@@ -170,12 +170,21 @@ class Solver:
         self.config = (
             solver if isinstance(solver, SolverConfig) else SolverConfig.from_proto(solver)
         )
-        if self.config.snapshot_format.upper() not in ("", "BINARYPROTO", "HDF5"):
+        fmt = self.config.snapshot_format.upper()
+        if fmt not in ("", "BINARYPROTO", "HDF5"):
             # fail at construction, not hours later at the first snapshot
             raise ValueError(
                 f"unknown snapshot_format {self.config.snapshot_format!r} "
                 "(BINARYPROTO|HDF5|'')"
             )
+        if fmt == "HDF5":
+            try:
+                import h5py  # noqa: F401
+            except ImportError as e:
+                raise ValueError(
+                    "snapshot_format=HDF5 needs h5py (pip install "
+                    "sparknet-tpu[hdf5])"
+                ) from e
         self.net_param = net_param
         self.train_net = Network(net_param, Phase.TRAIN, batch_override)
         # one TEST net per test_state (ref: Solver::InitTestNets

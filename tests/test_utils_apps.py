@@ -560,3 +560,58 @@ def test_cli_train_multihost_two_processes(tmp_path):
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out[-2000:]
     assert any("distributed: process" in o for o in outs)
+
+
+def test_plot_training_log(tmp_path, capsys):
+    """ref: tools/extra/plot_training_log.py.example — chart types render
+    from a parsed log; missing-table requests fail clearly."""
+    from sparknet_tpu.cli import main
+
+    log = tmp_path / "run.txt"
+    log.write_text(
+        "Iteration 100, loss = 2.0, lr = 0.01\n"
+        "10.000: loss: 1.50000, i = 200\n"
+        "Iteration 300, loss = 1.0, lr = 0.005\n"
+        "20.000: scores: {'accuracy': 0.5, 'loss': 1.2}, i = 300\n"
+        "30.000: scores: {'accuracy': 0.8, 'loss': 0.6}, i = 600\n"
+    )
+    for ct in (0, 6, 4):
+        out = tmp_path / f"chart{ct}.png"
+        assert main(["plot_training_log", str(ct), str(out), str(log)]) == 0
+        assert out.exists() and out.stat().st_size > 1000
+
+    from sparknet_tpu.utils.plotting import plot_chart
+
+    with pytest.raises(ValueError, match="unknown chart type"):
+        plot_chart(9, str(log), str(tmp_path / "x.png"))
+    empty = tmp_path / "empty.txt"
+    empty.write_text("nothing here\n")
+    with pytest.raises(ValueError, match="no .*rows"):
+        plot_chart(0, str(empty), str(tmp_path / "x.png"))
+
+
+def test_resize_images_tree(tmp_path, capsys):
+    """ref: tools/extra/resize_and_crop_images.py — shorter-side resize +
+    center crop over a tree, structure preserved, broken files survive."""
+    from PIL import Image
+
+    from sparknet_tpu.cli import main
+
+    src = tmp_path / "in"
+    (src / "synset_a").mkdir(parents=True)
+    (src / "synset_b").mkdir()
+    Image.new("RGB", (100, 60), (200, 10, 10)).save(src / "synset_a" / "wide.jpg")
+    Image.new("RGB", (30, 90), (10, 200, 10)).save(src / "synset_b" / "tall.png")
+    (src / "synset_b" / "broken.jpg").write_bytes(b"not an image")
+
+    out = tmp_path / "out"
+    rc = main([
+        "resize_images", "--input-folder", str(src),
+        "--output-folder", str(out), "--side", "32", "--workers", "1",
+    ])
+    assert rc == 1  # broken.jpg reported
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec == {"resized": 2, "errors": 1}
+    for rel in ("synset_a/wide.jpg", "synset_b/tall.png"):
+        with Image.open(out / rel) as img:
+            assert img.size == (32, 32)
