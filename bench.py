@@ -28,8 +28,37 @@ BASELINE_IMG_S = 267.0  # K40 + cuDNN CaffeNet training (performance_hardware.md
 
 def main() -> None:
     import os
+    import threading
 
+    # Watchdog: a wedged remote-TPU tunnel hangs PJRT client creation
+    # forever (no timeout in the retry loop).  Fail loudly instead so
+    # the harness gets a diagnosable error, not an eternal hang.
+    # SPARKNET_BENCH_INIT_TIMEOUT: seconds; <= 0 disables.
+    timeout_env = os.environ.get("SPARKNET_BENCH_INIT_TIMEOUT", "300")
+    try:
+        init_timeout = float(timeout_env)
+    except ValueError:
+        raise SystemExit(
+            f"SPARKNET_BENCH_INIT_TIMEOUT must be a number of seconds "
+            f"(got {timeout_env!r})"
+        ) from None
+    ready = threading.Event()
+
+    def watchdog():
+        if not ready.wait(init_timeout):
+            print(
+                "bench: jax backend init exceeded timeout — the TPU "
+                "tunnel/relay looks wedged (PJRT client creation retries "
+                "forever); restart the tunnel and rerun",
+                file=sys.stderr,
+                flush=True,
+            )
+            os._exit(3)
+
+    if init_timeout > 0:
+        threading.Thread(target=watchdog, daemon=True).start()
     platform = jax.devices()[0].platform
+    ready.set()
     on_accel = platform not in ("cpu",)
     batch_env = os.environ.get("SPARKNET_BENCH_BATCH", "")
     try:

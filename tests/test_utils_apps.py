@@ -605,9 +605,11 @@ def test_resize_images_tree(tmp_path, capsys):
     (src / "synset_b" / "broken.jpg").write_bytes(b"not an image")
 
     out = tmp_path / "out"
+    # workers=2 exercises the multiprocessing.Pool path (worker fn and
+    # args must stay picklable/spawn-safe — the default CLI path)
     rc = main([
         "resize_images", "--input-folder", str(src),
-        "--output-folder", str(out), "--side", "32", "--workers", "1",
+        "--output-folder", str(out), "--side", "32", "--workers", "2",
     ])
     assert rc == 1  # broken.jpg reported
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
