@@ -366,6 +366,21 @@ class ParallelTrainer:
                     lambda x: jax.device_put(x, rep), v.params
                 )
 
+    def save(self, prefix: str) -> str:
+        """Pod-scale checkpoint of the LIVE distributed state (sharded
+        replicas + slots (+ EASGD center) + iteration): each process
+        writes only its own shards via orbax — no host gather, unlike
+        ``sync_to_solver`` + ``Solver.save``."""
+        from sparknet_tpu.solvers.orbax_io import save_trainer_orbax
+
+        return save_trainer_orbax(self, prefix)
+
+    def restore(self, path: str) -> None:
+        """Restore a :meth:`save` checkpoint with the live shardings."""
+        from sparknet_tpu.solvers.orbax_io import restore_trainer_orbax
+
+        restore_trainer_orbax(self, path)
+
     def sync_to_solver(self) -> None:
         """Pull the averaged model AND optimizer history back into the
         wrapped Solver so its snapshot/restore path (ref: solver.cpp:447-519

@@ -48,15 +48,93 @@ def save_orbax(solver, prefix: str) -> str:
     return path
 
 
-def restore_orbax(solver, path: str) -> None:
-    """Restore params/state/slots/iter in place, preserving shardings of
-    the solver's current arrays as the restore target."""
-    ocp = _tree()
+def _abstract_like(x):
+    if isinstance(x, jax.Array):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+    arr = np.asarray(x)
+    return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+
+
+def _resolve_dir(path: str) -> str:
     # accept a checkpoint dir under any name; only append the suffix when
     # the given path does not already exist (the save(prefix) convention)
     if not os.path.isdir(path) and not path.endswith(".orbax"):
         path = path + ".orbax"
-    path = os.path.abspath(path)
+    return os.path.abspath(path)
+
+
+def _trainer_payload(trainer) -> dict:
+    payload = {
+        "variables": trainer.variables,
+        "slots": trainer.slots,
+        "iter": np.asarray(trainer.iter),
+    }
+    if getattr(trainer, "_elastic", False):
+        payload["center"] = trainer.center
+    return payload
+
+
+def save_trainer_orbax(trainer, prefix: str) -> str:
+    """Checkpoint the LIVE distributed training state — sharded replica
+    params, optimizer slots, (EASGD) center — with each process writing
+    only the shards it owns.  This is the true pod-scale path: unlike
+    ``Solver.save``, nothing is gathered to one host first."""
+    ocp = _tree()
+    path = os.path.abspath(f"{prefix}.orbax")
+    with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
+        ckptr.save(path, _trainer_payload(trainer), force=True)
+    if jax.process_index() == 0:
+        with open(os.path.join(path, "sparknet_meta.json"), "w") as f:
+            json.dump(
+                {
+                    "solver_type": trainer.solver.config.solver_type,
+                    "elastic": bool(getattr(trainer, "_elastic", False)),
+                },
+                f,
+            )
+    return path
+
+
+def restore_trainer_orbax(trainer, path: str) -> None:
+    """Restore a trainer checkpoint in place with the live shardings."""
+    ocp = _tree()
+    path = _resolve_dir(path)
+    meta_path = os.path.join(path, "sparknet_meta.json")
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        saved_type = meta.get("solver_type")
+        if saved_type and saved_type != trainer.solver.config.solver_type:
+            raise ValueError(
+                f"checkpoint was taken with solver_type={saved_type!r}, "
+                f"this trainer is {trainer.solver.config.solver_type!r}"
+            )
+        saved_elastic = meta.get("elastic")
+        is_elastic = bool(getattr(trainer, "_elastic", False))
+        if saved_elastic is not None and saved_elastic != is_elastic:
+            raise ValueError(
+                "checkpoint "
+                + ("has" if saved_elastic else "lacks")
+                + " an EASGD center variable but this trainer was built "
+                + ("without" if saved_elastic else "with")
+                + " elastic_alpha — construct the trainer to match"
+            )
+    target = _trainer_payload(trainer)
+    abstract = jax.tree_util.tree_map(_abstract_like, target)
+    with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
+        restored = ckptr.restore(path, abstract)
+    trainer.variables = restored["variables"]
+    trainer.slots = restored["slots"]
+    trainer.iter = int(restored["iter"])
+    if "center" in restored:
+        trainer.center = restored["center"]
+
+
+def restore_orbax(solver, path: str) -> None:
+    """Restore params/state/slots/iter in place, preserving shardings of
+    the solver's current arrays as the restore target."""
+    ocp = _tree()
+    path = _resolve_dir(path)
     meta_path = os.path.join(path, "sparknet_meta.json")
     if os.path.exists(meta_path):
         with open(meta_path) as f:
@@ -67,19 +145,13 @@ def restore_orbax(solver, path: str) -> None:
                 f"this solver is {solver.config.solver_type!r}"
             )
 
-    def _abstract(x):
-        if isinstance(x, jax.Array):
-            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
-        arr = np.asarray(x)
-        return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
-
     target = {
         "params": solver.variables.params,
         "state": solver.variables.state,
         "slots": solver.slots,
         "iter": np.asarray(solver.iter),
     }
-    abstract = jax.tree_util.tree_map(_abstract, target)
+    abstract = jax.tree_util.tree_map(_abstract_like, target)
     with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
         restored = ckptr.restore(path, abstract)
     from sparknet_tpu.compiler.graph import NetVars

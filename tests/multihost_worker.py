@@ -8,7 +8,7 @@ batch shard, runs sync-DP and tau-averaging rounds through
 ParallelTrainer, and prints a parameter digest the parent test compares
 across processes (replicas must agree bit-for-bit).
 
-Usage: python multihost_worker.py <process_id> <coordinator_port>
+Usage: python multihost_worker.py <process_id> <coordinator_port> [ckpt_dir]
 """
 
 import os
@@ -84,6 +84,34 @@ def main() -> None:
 
     digest = digest_of(trainer.variables.params)
     digest2 = digest_of(trainer2.variables.params)
+
+    # Distributed checkpoint: every process writes its own shards, and a
+    # fresh trainer restores them with the live shardings.
+    try:
+        import orbax.checkpoint  # noqa: F401
+
+        base = sys.argv[3] if len(sys.argv) > 3 else f"/tmp/mh_ckpt_{port}"
+        ckpt = trainer2.save(os.path.join(base, "live") if len(sys.argv) > 3 else base)
+        fresh = ParallelTrainer(
+            Solver(models.cifar10_quick_solver(), models.cifar10_quick(2)),
+            mesh=mesh,
+            tau=tau,
+        )
+        fresh.restore(ckpt)
+        assert fresh.iter == trainer2.iter
+        assert abs(digest_of(fresh.variables.params) - digest2) < 1e-6
+        # both processes finish restoring before process 0 removes the
+        # directory (standalone runs have no parent to clean up)
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("ckpt_done")
+        if pid == 0 and len(sys.argv) <= 3:
+            import shutil
+
+            shutil.rmtree(ckpt, ignore_errors=True)
+        print(f"CKPT {pid} ok", flush=True)
+    except ImportError:
+        print(f"CKPT {pid} skipped", flush=True)
     print(f"DIGEST {pid} {digest:.10e} {digest2:.10e} {loss:.6f} {loss2:.6f}", flush=True)
 
 
