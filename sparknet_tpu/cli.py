@@ -139,10 +139,13 @@ def cmd_train(args) -> int:
 
         if not args.coordinator:
             raise SystemExit("--num-processes requires --coordinator host:port")
-        if not (args.distributed or args.tau > 1):
+        if not (args.distributed or args.tau > 1 or args.elastic_alpha > 0):
             # without the mesh trainer each process would train a full
             # independent model with no gradient sync — never intended
-            raise SystemExit("--num-processes requires --distributed or --tau > 1")
+            raise SystemExit(
+                "--num-processes requires --distributed, --tau > 1, or "
+                "--elastic-alpha > 0"
+            )
         initialize_distributed(
             coordinator_address=args.coordinator,
             num_processes=args.num_processes,
@@ -183,15 +186,20 @@ def cmd_train(args) -> int:
 
     iters = args.iterations or solver_cfg.max_iter
     with profile_ctx:
-        if args.tau > 1 or args.distributed:
+        elastic = args.elastic_alpha > 0
+        if args.tau > 1 or args.distributed or elastic:
             if getattr(args, "num_processes", 0):
                 log(f"distributed: process {args.process_id}/{args.num_processes}")
-            trainer = ParallelTrainer(solver, tau=args.tau)
+            trainer = ParallelTrainer(
+                solver, tau=args.tau, elastic_alpha=args.elastic_alpha
+            )
             outer = -(-iters // max(args.tau, 1))  # ceil: run >= requested
             tau_fn = _stack_tau(train_fn, args.tau, trainer.num_local_workers)
             with SignalHandler() as sig:
                 for o in range(outer):
-                    if args.tau > 1:
+                    if args.tau > 1 or elastic:
+                        # elastic rounds always take the [tau, B, ...]
+                        # feed contract, tau may be 1
                         loss = trainer.train_round(tau_fn)
                     else:
                         loss = trainer.train_round(
@@ -753,6 +761,9 @@ def main(argv=None) -> int:
                     ".caffemodel/.h5 (fresh optimizer state)")
     sp.add_argument("--tau", type=int, default=1, help="model-averaging interval")
     sp.add_argument("--distributed", action="store_true", help="use the device mesh")
+    sp.add_argument("--elastic-alpha", type=float, default=0.0,
+                    help="EASGD coupling strength (~0.9/num_workers); "
+                    "0 = hard averaging")
     sp.add_argument("--coordinator", default="",
                     help="multi-host: coordination service host:port")
     sp.add_argument("--num-processes", type=int, default=0,

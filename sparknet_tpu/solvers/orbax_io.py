@@ -28,6 +28,46 @@ def _tree() -> Any:
     return ocp
 
 
+def _write_meta(path: str, meta: dict) -> None:
+    """Meta sidecar (strings stay out of the array pytree): one writer,
+    then a barrier so no process returns from save() — and possibly
+    races into restore's validation — before the sidecar is visible."""
+    if jax.process_index() == 0:
+        with open(os.path.join(path, "sparknet_meta.json"), "w") as f:
+            json.dump(meta, f)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("sparknet_meta:" + path)
+
+
+def _check_meta(path: str, solver, expect_elastic: bool | None = None) -> None:
+    """Validate the sidecar against the restoring object; missing sidecar
+    (foreign checkpoint) skips validation."""
+    meta_path = os.path.join(path, "sparknet_meta.json")
+    if not os.path.exists(meta_path):
+        return
+    with open(meta_path) as f:
+        meta = json.load(f)
+    saved_type = meta.get("solver_type")
+    if saved_type and saved_type != solver.config.solver_type:
+        raise ValueError(
+            f"checkpoint was taken with solver_type={saved_type!r}, "
+            f"this solver is {solver.config.solver_type!r}"
+        )
+    saved_elastic = meta.get("elastic")
+    if expect_elastic is not None and saved_elastic is not None and (
+        saved_elastic != expect_elastic
+    ):
+        raise ValueError(
+            "checkpoint "
+            + ("has" if saved_elastic else "lacks")
+            + " an EASGD center variable but this trainer was built "
+            + ("without" if saved_elastic else "with")
+            + " elastic_alpha — construct the trainer to match"
+        )
+
+
 def save_orbax(solver, prefix: str) -> str:
     """Write a snapshot; returns the checkpoint directory."""
     ocp = _tree()
@@ -40,11 +80,7 @@ def save_orbax(solver, prefix: str) -> str:
     }
     with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
         ckptr.save(path, payload, force=True)
-    # meta sidecar (strings stay out of the array pytree); one writer on
-    # multi-host pods, like orbax's own metadata
-    if jax.process_index() == 0:
-        with open(os.path.join(path, "sparknet_meta.json"), "w") as f:
-            json.dump({"solver_type": solver.config.solver_type}, f)
+    _write_meta(path, {"solver_type": solver.config.solver_type})
     return path
 
 
@@ -83,15 +119,13 @@ def save_trainer_orbax(trainer, prefix: str) -> str:
     path = os.path.abspath(f"{prefix}.orbax")
     with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
         ckptr.save(path, _trainer_payload(trainer), force=True)
-    if jax.process_index() == 0:
-        with open(os.path.join(path, "sparknet_meta.json"), "w") as f:
-            json.dump(
-                {
-                    "solver_type": trainer.solver.config.solver_type,
-                    "elastic": bool(getattr(trainer, "_elastic", False)),
-                },
-                f,
-            )
+    _write_meta(
+        path,
+        {
+            "solver_type": trainer.solver.config.solver_type,
+            "elastic": bool(getattr(trainer, "_elastic", False)),
+        },
+    )
     return path
 
 
@@ -99,26 +133,11 @@ def restore_trainer_orbax(trainer, path: str) -> None:
     """Restore a trainer checkpoint in place with the live shardings."""
     ocp = _tree()
     path = _resolve_dir(path)
-    meta_path = os.path.join(path, "sparknet_meta.json")
-    if os.path.exists(meta_path):
-        with open(meta_path) as f:
-            meta = json.load(f)
-        saved_type = meta.get("solver_type")
-        if saved_type and saved_type != trainer.solver.config.solver_type:
-            raise ValueError(
-                f"checkpoint was taken with solver_type={saved_type!r}, "
-                f"this trainer is {trainer.solver.config.solver_type!r}"
-            )
-        saved_elastic = meta.get("elastic")
-        is_elastic = bool(getattr(trainer, "_elastic", False))
-        if saved_elastic is not None and saved_elastic != is_elastic:
-            raise ValueError(
-                "checkpoint "
-                + ("has" if saved_elastic else "lacks")
-                + " an EASGD center variable but this trainer was built "
-                + ("without" if saved_elastic else "with")
-                + " elastic_alpha — construct the trainer to match"
-            )
+    _check_meta(
+        path,
+        trainer.solver,
+        expect_elastic=bool(getattr(trainer, "_elastic", False)),
+    )
     target = _trainer_payload(trainer)
     abstract = jax.tree_util.tree_map(_abstract_like, target)
     with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
@@ -135,15 +154,7 @@ def restore_orbax(solver, path: str) -> None:
     the solver's current arrays as the restore target."""
     ocp = _tree()
     path = _resolve_dir(path)
-    meta_path = os.path.join(path, "sparknet_meta.json")
-    if os.path.exists(meta_path):
-        with open(meta_path) as f:
-            saved_type = json.load(f).get("solver_type")
-        if saved_type and saved_type != solver.config.solver_type:
-            raise ValueError(
-                f"snapshot was taken with solver_type={saved_type!r}, "
-                f"this solver is {solver.config.solver_type!r}"
-            )
+    _check_meta(path, solver)
 
     target = {
         "params": solver.variables.params,

@@ -617,3 +617,20 @@ def test_resize_images_tree(tmp_path, capsys):
     for rel in ("synset_a/wide.jpg", "synset_b/tall.png"):
         with Image.open(out / rel) as img:
             assert img.size == (32, 32)
+
+
+def test_cli_train_elastic(tmp_path, monkeypatch):
+    """tpunet train --elastic-alpha: EASGD through the CLI (tau=1 and
+    tau>1 both take the stacked feed contract)."""
+    from sparknet_tpu.cli import main
+
+    monkeypatch.chdir(tmp_path)
+    n = len(jax.devices())
+    for tau in (1, 2):
+        rc = main([
+            "train", "--solver", "zoo:lenet", "--batch", "4",
+            "--data", "synthetic", "--iterations", "2", "--tau", str(tau),
+            "--elastic-alpha", str(0.9 / n), "--output", f"e{tau}",
+        ])
+        assert rc == 0
+        assert os.path.exists(f"e{tau}.solverstate.npz")
