@@ -240,3 +240,21 @@ def test_prefetcher_propagates_errors():
     pf = DevicePrefetcher(data_fn, num_iters=5)
     with pytest.raises(RuntimeError, match="boom"):
         list(pf)
+
+
+def test_feed_bench_tool_smoke():
+    """tools/feed_bench.py variants run and report sane numbers."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "feed_bench",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "tools", "feed_bench.py"),
+    )
+    fb = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fb)
+
+    rec = fb.bench_transform("numpy", batch=8, iters=2)
+    assert rec["value"] > 0 and "numpy" in rec["metric"]
+    pre = fb.bench_prefetch(batch=8, iters=3)
+    assert pre["value"] > 0

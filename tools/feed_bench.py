@@ -1,0 +1,103 @@
+"""Input-pipeline benchmark: the host feed path the reference measured.
+
+The reference's #1 measured bottleneck was its per-minibatch feed: the
+JNA callback doing crop+mean for a 256-image 227x227 AlexNet batch cost
+~1.2 s (ref: src/test/scala/apps/CallbackBenchmarkSpec.scala:3-17
+"fancy indexing very expensive").  This tool times OUR equivalent —
+the DataTransformer (mean-subtract + random 227 crop + mirror) over the
+same batch shape, numpy and multithreaded C++ backends, plus the
+prefetcher's overlap — and prints one JSON line per variant:
+
+    python tools/feed_bench.py [--batch 256] [--iters 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+REF_MS_PER_BATCH = 1200.0  # the reference's measured cost per 256-IMAGE batch
+
+
+def bench_transform(backend: str, batch: int, iters: int) -> dict:
+    from sparknet_tpu.data.transform import DataTransformer, TransformConfig
+
+    rs = np.random.RandomState(0)
+    raw = rs.randint(0, 256, (batch, 3, 256, 256), dtype=np.uint8)
+    mean = rs.rand(3, 256, 256).astype(np.float32) * 255
+    xform = DataTransformer(
+        TransformConfig(
+            mean_image=mean, crop_size=227, mirror=True, seed=1,
+            backend=backend,
+        )
+    )
+    out = xform(raw, True)  # warm (native lib load, allocator)
+    assert out.shape == (batch, 3, 227, 227), out.shape
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = xform(raw, True)
+    dt_ms = (time.perf_counter() - t0) / iters * 1e3
+    # normalize the reference cost to this batch size before comparing
+    ref_ms = REF_MS_PER_BATCH * batch / 256.0
+    return {
+        "metric": f"feed_transform_{backend}_ms_per_batch",
+        "value": round(dt_ms, 2),
+        "unit": f"ms/{batch}-img batch",
+        "vs_reference_callback": round(ref_ms / dt_ms, 1),
+    }
+
+
+def bench_prefetch(batch: int, iters: int) -> dict:
+    """Producer/consumer overlap: batches/s through the device prefetcher
+    with a 10 ms synthetic producer (the decode+augment stand-in)."""
+    from sparknet_tpu.data.prefetch import DevicePrefetcher
+
+    def data_fn(it):
+        time.sleep(0.010)
+        return {"data": np.zeros((batch, 8), np.float32)}
+
+    pre = DevicePrefetcher(data_fn, num_iters=iters + 1, depth=3)
+    it = iter(pre)
+    next(it)  # spin-up
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        next(it)
+    dt_ms = (time.perf_counter() - t0) / iters * 1e3
+    pre.close()
+    return {
+        "metric": "prefetch_ms_per_batch",
+        "value": round(dt_ms, 2),
+        "unit": "ms (10 ms producer, depth 3)",
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--platform", default="",
+                    help="force a jax platform for the prefetch leg (the "
+                    "config route wins over JAX_PLATFORMS site pins)")
+    args = ap.parse_args()
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    print(json.dumps(bench_transform("numpy", args.batch, args.iters)))
+    from sparknet_tpu import native
+
+    if native.available():
+        print(json.dumps(bench_transform("native", args.batch, args.iters)))
+    else:
+        print(json.dumps({"metric": "feed_transform_native_ms_per_batch",
+                          "skipped": "libsparknet_native unavailable"}))
+    print(json.dumps(bench_prefetch(args.batch, args.iters)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
