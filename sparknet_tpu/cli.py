@@ -113,6 +113,29 @@ def _data_fns(args, net):
     raise SystemExit(f"unknown --data source {args.data!r}")
 
 
+def _load_weights_into(solver, path: str, strict_shapes: bool) -> list[str]:
+    """Copy .caffemodel/.h5 weights into a solver's params by layer name,
+    with clean CLI errors; returns the loaded layer names."""
+    from sparknet_tpu.compiler.graph import NetVars
+    from sparknet_tpu.net import copy_caffemodel_params, copy_hdf5_params
+
+    copy = (
+        copy_hdf5_params
+        if path.endswith((".h5", ".hdf5", ".caffemodel.h5"))
+        else copy_caffemodel_params
+    )
+    try:
+        params, loaded = copy(
+            solver.variables.params, path, strict_shapes=strict_shapes
+        )
+    except (OSError, ValueError) as e:  # missing/corrupt file, bad shapes
+        raise SystemExit(str(e)) from None
+    if not loaded:
+        raise SystemExit(f"{path}: no layer names match this net")
+    solver.variables = NetVars(params=params, state=solver.variables.state)
+    return loaded
+
+
 # ---------------------------------------------------------------------------
 def cmd_train(args) -> int:
     """ref: caffe.cpp:153-218 train()."""
@@ -158,19 +181,9 @@ def cmd_train(args) -> int:
     elif getattr(args, "weights", ""):
         # finetuning: copy params by layer name from a zoo model, fresh
         # optimizer state (ref: caffe.cpp:184-189 CopyLayers / the
-        # finetune_flickr_style recipe)
-        from sparknet_tpu.compiler.graph import NetVars
-        from sparknet_tpu.net import copy_caffemodel_params, copy_hdf5_params
-
-        if args.weights.endswith((".h5", ".hdf5", ".caffemodel.h5")):
-            params, loaded = copy_hdf5_params(
-                solver.variables.params, args.weights, strict_shapes=False
-            )
-        else:
-            params, loaded = copy_caffemodel_params(
-                solver.variables.params, args.weights, strict_shapes=False
-            )
-        solver.variables = NetVars(params=params, state=solver.variables.state)
+        # finetune_flickr_style recipe); permissive shapes so changed
+        # heads are skipped
+        loaded = _load_weights_into(solver, args.weights, strict_shapes=False)
         print(json.dumps({"finetune_from": args.weights, "layers_loaded": loaded}))
     log = EventLogger(".", prefix="tpunet_train")
     train_fn, test_fn = _data_fns(args, solver.train_net)
@@ -278,13 +291,24 @@ def _widen_batch(train_fn, num_workers):
 
 
 def cmd_test(args) -> int:
-    """ref: caffe.cpp:222-287 test()."""
+    """ref: caffe.cpp:222-287 test() — score a model from --weights
+    (the reference's canonical usage: caffe test --weights m.caffemodel)
+    or from a --snapshot solver state."""
     from sparknet_tpu.solvers.solver import Solver
 
+    if args.snapshot and getattr(args, "weights", ""):
+        raise SystemExit("--snapshot and --weights are mutually exclusive")
+    if not args.snapshot and not getattr(args, "weights", ""):
+        # ref: caffe.cpp test() CHECK_GT(FLAGS_weights.size(), 0)
+        # "Need model weights to score." — scoring a random init is
+        # never what the user meant
+        raise SystemExit("test needs --weights or --snapshot to score")
     net_param, solver_cfg = _build_net_and_solver(args)
     solver = Solver(solver_cfg, net_param)
     if args.snapshot:
         solver.restore(args.snapshot)
+    else:
+        _load_weights_into(solver, args.weights, strict_shapes=True)
     _, test_fn = _data_fns(args, solver.test_net)
     scores = solver.test(args.iterations or 10, test_fn)
     print(json.dumps(scores))
@@ -777,6 +801,8 @@ def main(argv=None) -> int:
 
     sp = sub.add_parser("test", help="score a model")
     common(sp)
+    sp.add_argument("--weights", default="",
+                    help="score a .caffemodel / .h5 (the caffe test usage)")
     sp.set_defaults(fn=cmd_test)
 
     sp = sub.add_parser("time", help="per-layer timing")

@@ -634,3 +634,28 @@ def test_cli_train_elastic(tmp_path, monkeypatch):
         ])
         assert rc == 0
         assert os.path.exists(f"e{tau}.solverstate.npz")
+
+
+def test_cli_test_weights(tmp_path, monkeypatch, capsys):
+    """tpunet test --weights: score a caffemodel directly (the reference's
+    canonical `caffe test --weights` usage)."""
+    from sparknet_tpu.cli import main
+
+    monkeypatch.chdir(tmp_path)
+    assert main([
+        "train", "--solver", "zoo:lenet", "--batch", "8",
+        "--data", "synthetic", "--iterations", "2", "--output", "m",
+    ]) == 0
+    capsys.readouterr()
+    assert main([
+        "test", "--solver", "zoo:lenet", "--batch", "8",
+        "--data", "synthetic", "--iterations", "2",
+        "--weights", "m.caffemodel",
+    ]) == 0
+    scores = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "accuracy" in scores
+
+    with pytest.raises(SystemExit, match="mutually exclusive"):
+        main(["test", "--solver", "zoo:lenet", "--batch", "8",
+              "--data", "synthetic", "--snapshot", "m.solverstate.npz",
+              "--weights", "m.caffemodel"])
