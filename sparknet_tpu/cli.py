@@ -113,9 +113,17 @@ def _data_fns(args, net):
     raise SystemExit(f"unknown --data source {args.data!r}")
 
 
-def _load_weights_into(solver, path: str, strict_shapes: bool) -> list[str]:
+def _load_weights_into(
+    solver, path: str, strict_shapes: bool, require_match: bool
+) -> list[str]:
     """Copy .caffemodel/.h5 weights into a solver's params by layer name,
-    with clean CLI errors; returns the loaded layer names."""
+    with clean CLI errors; returns the loaded layer names.
+
+    ``require_match=False`` (the permissive finetune path) tolerates zero
+    loadable layers — the donor's layers are all renamed/reshaped and
+    training starts fresh, Caffe's CopyTrainedLayersFrom behavior."""
+    import struct
+
     from sparknet_tpu.compiler.graph import NetVars
     from sparknet_tpu.net import copy_caffemodel_params, copy_hdf5_params
 
@@ -128,10 +136,14 @@ def _load_weights_into(solver, path: str, strict_shapes: bool) -> list[str]:
         params, loaded = copy(
             solver.variables.params, path, strict_shapes=strict_shapes
         )
-    except (OSError, ValueError) as e:  # missing/corrupt file, bad shapes
-        raise SystemExit(str(e)) from None
-    if not loaded:
-        raise SystemExit(f"{path}: no layer names match this net")
+    except (OSError, ValueError, KeyError, struct.error) as e:
+        # missing/corrupt/truncated file, wrong HDF5 layout, bad shapes
+        raise SystemExit(f"{path}: {e}") from None
+    if require_match and not loaded:
+        raise SystemExit(
+            f"{path}: no layers could be loaded (names or shapes do not "
+            "match this net)"
+        )
     solver.variables = NetVars(params=params, state=solver.variables.state)
     return loaded
 
@@ -183,7 +195,9 @@ def cmd_train(args) -> int:
         # optimizer state (ref: caffe.cpp:184-189 CopyLayers / the
         # finetune_flickr_style recipe); permissive shapes so changed
         # heads are skipped
-        loaded = _load_weights_into(solver, args.weights, strict_shapes=False)
+        loaded = _load_weights_into(
+            solver, args.weights, strict_shapes=False, require_match=False
+        )
         print(json.dumps({"finetune_from": args.weights, "layers_loaded": loaded}))
     log = EventLogger(".", prefix="tpunet_train")
     train_fn, test_fn = _data_fns(args, solver.train_net)
@@ -308,7 +322,9 @@ def cmd_test(args) -> int:
     if args.snapshot:
         solver.restore(args.snapshot)
     else:
-        _load_weights_into(solver, args.weights, strict_shapes=True)
+        _load_weights_into(
+            solver, args.weights, strict_shapes=True, require_match=True
+        )
     _, test_fn = _data_fns(args, solver.test_net)
     scores = solver.test(args.iterations or 10, test_fn)
     print(json.dumps(scores))

@@ -10,7 +10,7 @@ crop).
 from __future__ import annotations
 
 import os
-from multiprocessing import Pool
+from multiprocessing import get_context
 
 _EXTS = (".jpg", ".jpeg", ".png", ".bmp")
 
@@ -61,7 +61,9 @@ def resize_tree(
     if workers == 1:
         results = [resize_and_crop_image(j) for j in jobs]
     else:
-        with Pool(workers) as pool:
+        # spawn, not fork: the caller may hold jax/threading state that
+        # fork() would duplicate into a deadlock-prone child
+        with get_context("spawn").Pool(workers) as pool:
             results = pool.map(resize_and_crop_image, jobs)
     errors = [(p, msg) for p, msg in results if msg != "ok"]
     return len(results) - len(errors), errors

@@ -56,15 +56,19 @@ def _blob_spec(
     rules: ShardingRules,
 ) -> P:
     cfg = get_config()
-    if (
-        rules.tensor_parallel
-        and model_size > 1
-        and layer_type in _TP_TYPES
-        and len(shape) >= 1
-        and shape[0] % model_size == 0
-        and shape[0] >= rules.min_tp_dim
-    ):
-        return P(cfg.model_axis)  # axis 0 = num_output; rest replicated
+    if rules.tensor_parallel and model_size > 1 and len(shape) >= 1:
+        if (
+            layer_type in _TP_TYPES
+            and shape[0] % model_size == 0
+            and shape[0] >= rules.min_tp_dim
+        ):
+            return P(cfg.model_axis)  # axis 0 = num_output; rest replicated
+        if layer_type == "MoE" and shape[0] % model_size == 0:
+            # expert parallelism by layout: every MoE blob is expert-major
+            # [E, ...], so sharding axis 0 puts whole experts on devices
+            # and GSPMD partitions the expert-batched einsums.  No
+            # min_tp_dim floor — E is small but each expert is big.
+            return P(cfg.model_axis)
     return P()
 
 
