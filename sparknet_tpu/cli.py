@@ -941,9 +941,9 @@ def main(argv=None) -> int:
 
     args = p.parse_args(argv)
     if args.platform:
-        import jax
+        from sparknet_tpu.common import force_platform
 
-        jax.config.update("jax_platforms", args.platform)
+        force_platform(args.platform)
     return args.fn(args)
 
 

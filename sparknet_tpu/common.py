@@ -67,6 +67,13 @@ def set_config(**overrides) -> Config:
     return _config
 
 
+def force_platform(name: str) -> None:
+    """Pin jax to a platform via the config route, which outranks the
+    ``JAX_PLATFORMS`` env var when a site hook pre-registers a hardware
+    plugin.  Must run before the first backend-initializing jax call."""
+    jax.config.update("jax_platforms", name)
+
+
 def root_key(seed: int | None = None) -> jax.Array:
     """The root PRNG key for a run (ref: common.cpp:set_random_seed)."""
     cfg = get_config()

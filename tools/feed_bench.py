@@ -83,9 +83,9 @@ def main() -> int:
                     "config route wins over JAX_PLATFORMS site pins)")
     args = ap.parse_args()
     if args.platform:
-        import jax
+        from sparknet_tpu.common import force_platform
 
-        jax.config.update("jax_platforms", args.platform)
+        force_platform(args.platform)
 
     print(json.dumps(bench_transform("numpy", args.batch, args.iters)))
     from sparknet_tpu import native
