@@ -758,6 +758,45 @@ def _cmd_deprecated(replacement):
     return fn
 
 
+def cmd_bench(args) -> int:
+    """The headline throughput benchmark (bench.py) as a brew: 20 timed
+    AlexNet-class training iterations, one JSON line (see
+    docs/BENCHMARKS.md for measured results)."""
+    import importlib.util
+
+    from sparknet_tpu.common import get_config, set_config
+
+    overrides = {}
+    if args.model:
+        overrides["SPARKNET_BENCH_MODEL"] = args.model
+    if args.batch:
+        overrides["SPARKNET_BENCH_BATCH"] = str(args.batch)
+    if args.dtype:
+        overrides["SPARKNET_BENCH_DTYPE"] = args.dtype
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    bench_path = os.path.join(root, "bench.py")
+    if not os.path.exists(bench_path):
+        raise SystemExit("bench.py not found next to the package")
+    spec = importlib.util.spec_from_file_location("sparknet_bench", bench_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    # scope the env-var IPC and the global compute dtype to this call —
+    # the CLI process may outlive it (tests, interactive use)
+    saved = {k: os.environ.get(k) for k in overrides}
+    prev_dtype = get_config().compute_dtype
+    os.environ.update(overrides)
+    try:
+        mod.main()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        set_config(compute_dtype=prev_dtype)
+    return 0
+
+
 def cmd_device_query(args) -> int:
     """ref: caffe.cpp:110-150 device_query()."""
     import jax
@@ -935,6 +974,13 @@ def main(argv=None) -> int:
         sp = sub.add_parser(cmd, help=f"deprecated: use tpunet {repl.split()[0]}")
         sp.add_argument("ignored", nargs="*")
         sp.set_defaults(fn=_cmd_deprecated(repl))
+
+    sp = sub.add_parser("bench", help="headline training-throughput benchmark")
+    sp.add_argument("--model", default="", help="alexnet|caffenet|googlenet")
+    sp.add_argument("--batch", type=int, default=0)
+    sp.add_argument("--dtype", default="",
+                    choices=["", "bf16", "bfloat16", "f32"])
+    sp.set_defaults(fn=cmd_bench)
 
     sp = sub.add_parser("device_query", help="show devices")
     sp.set_defaults(fn=cmd_device_query)

@@ -659,3 +659,14 @@ def test_cli_test_weights(tmp_path, monkeypatch, capsys):
         main(["test", "--solver", "zoo:lenet", "--batch", "8",
               "--data", "synthetic", "--snapshot", "m.solverstate.npz",
               "--weights", "m.caffemodel"])
+
+
+def test_cli_bench_brew(capsys, monkeypatch):
+    """tpunet bench: the headline benchmark as a brew (one JSON line)."""
+    from sparknet_tpu.cli import main
+
+    monkeypatch.setenv("SPARKNET_BENCH_INIT_TIMEOUT", "0")
+    assert main(["bench", "--batch", "4", "--dtype", "f32"]) == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["metric"] == "alexnet_train_images_per_sec_per_chip"
+    assert rec["value"] > 0
