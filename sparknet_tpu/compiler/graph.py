@@ -369,7 +369,6 @@ class Network:
                 f"start layer {start!r} (#{si}) comes after end layer "
                 f"{end!r} (#{ei})"
             )
-        partial = start is not None or end is not None
         # Mixed precision (Config.compute_dtype, default f32): master params
         # and optimizer state stay in param_dtype; activations and the conv/
         # matmul FLOPs run in compute_dtype (bf16 keeps the MXU at full

@@ -67,6 +67,19 @@ def expert_parallel_moe(
         raise ValueError(f"token count {x.shape[0]} not divisible by {E}")
     tokens_local = x.shape[0] // E
     if capacity_factor is None:
+        # Exact-parity default: capacity E means nothing can drop, at the
+        # price of an [E, tokens_local, D] send buffer — E x the token
+        # memory.  Fine for oracles/tests; a production run should pass
+        # 1.0-2.0 explicitly and accept Switch-style drops.
+        if E > 2:
+            import warnings
+
+            warnings.warn(
+                f"expert_parallel_moe: default capacity_factor={E} "
+                f"(loss-free parity) allocates {E}x token memory for send "
+                "buffers; pass capacity_factor=1.0-2.0 for production",
+                stacklevel=2,
+            )
         capacity_factor = float(E)
     C = _capacity(tokens_local, E, capacity_factor)
 

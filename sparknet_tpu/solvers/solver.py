@@ -375,7 +375,10 @@ class Solver:
                 train=True,
             )
             loss = float(loss_arr)
-            print(f"Iteration {self.iter}, loss = {loss:.6g}")
+            print(
+                f"Iteration {self.iter}, loss = {loss:.6g}, "
+                f"lr = {float(learning_rate(cfg, self.iter)):.6g}"
+            )
         if (
             test_fns is not None
             and cfg.test_interval

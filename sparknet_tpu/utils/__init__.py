@@ -2,5 +2,5 @@
 
 from sparknet_tpu.utils.event_log import EventLogger  # noqa: F401
 from sparknet_tpu.utils.log_parse import parse_log, parse_log_to_csv, save_csv  # noqa: F401
-from sparknet_tpu.utils.signals import SignalHandler, SolverAction  # noqa: F401
+from sparknet_tpu.utils.signals import SignalHandler, SolverAction, agree_action  # noqa: F401
 from sparknet_tpu.utils.timing import Timer, time_layers  # noqa: F401

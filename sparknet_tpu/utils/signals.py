@@ -20,6 +20,34 @@ class SolverAction(enum.Enum):
     SNAPSHOT = 2
 
 
+def agree_action(action: SolverAction) -> SolverAction:
+    """Agree on one action across all hosts of a multi-process run.
+
+    POSIX delivers a signal to one process only, but acting on it involves
+    collectives (``sync_to_solver`` averages globally-sharded arrays) and
+    control flow (breaking the round loop) that every host must take
+    together or the program diverges into a distributed hang.  Each host
+    contributes its locally-pending action; any STOP wins, else any
+    SNAPSHOT, else NONE.  Single-process: identity, no collective.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return action
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    codes = np.asarray(
+        multihost_utils.process_allgather(np.int32(action.value))
+    ).ravel()
+    if (codes == SolverAction.STOP.value).any():
+        return SolverAction.STOP
+    if (codes == SolverAction.SNAPSHOT.value).any():
+        return SolverAction.SNAPSHOT
+    return SolverAction.NONE
+
+
 class SignalHandler:
     """Install with desired actions; poll ``check()`` each iteration."""
 

@@ -297,7 +297,7 @@ def test_expert_parallel_matches_dense():
     )
     x = jnp.asarray(rs.randn(T, D), jnp.float32)
     ref = np.asarray(moe_dense(params, x))
-    out = np.asarray(expert_parallel_moe(mesh, params, x))
+    out = np.asarray(expert_parallel_moe(mesh, params, x, capacity_factor=float(E)))
     np.testing.assert_allclose(out, ref, atol=2e-5)
 
     tight = np.asarray(expert_parallel_moe(mesh, params, x, capacity_factor=1.0))
