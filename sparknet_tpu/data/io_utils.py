@@ -207,41 +207,34 @@ def resize_image(
     return out
 
 
+def fivecrop_origins(image_hw, crop_hw) -> list[tuple[int, int]]:
+    """(row, col) origins for the 4 corner crops (row-major) + center.
+
+    The center origin floors to match the reference's truncated
+    ``center - crop/2`` arithmetic (io.py:356-359).
+    """
+    dr, dc = image_hw[0] - crop_hw[0], image_hw[1] - crop_hw[1]
+    return [(0, 0), (0, dc), (dr, 0), (dr, dc), (dr // 2, dc // 2)]
+
+
 def oversample(images, crop_dims) -> np.ndarray:
     """Ten-crop: 4 corners + center, plus horizontal mirrors of each.
 
-    Returns (10*N, h, w, K) float32 in the reference's crop order
-    (io.py:340-384: corners row-major, center, then the mirrored five).
+    Vectorized over the batch.  Returns (10*N, h, w, K) float32 in the
+    reference's crop order (io.py:340-384: corners row-major, center,
+    then the same five mirrored along width).
     """
-    images = list(images)
-    im_shape = np.array(images[0].shape)
-    crop_dims = np.array(crop_dims, int)
-    im_center = im_shape[:2] / 2.0
-
-    h_indices = (0, im_shape[0] - crop_dims[0])
-    w_indices = (0, im_shape[1] - crop_dims[1])
-    crops_ix = np.empty((5, 4), dtype=int)
-    curr = 0
-    for i in h_indices:
-        for j in w_indices:
-            crops_ix[curr] = (i, j, i + crop_dims[0], j + crop_dims[1])
-            curr += 1
-    crops_ix[4] = np.tile(im_center, (1, 2)) + np.concatenate(
-        [-crop_dims / 2.0, crop_dims / 2.0]
-    )
-    crops_ix = np.tile(crops_ix, (2, 1))
-
-    crops = np.empty(
-        (10 * len(images), crop_dims[0], crop_dims[1], im_shape[-1]), np.float32
-    )
-    ix = 0
-    for im in images:
-        for crop in crops_ix:
-            crops[ix] = im[crop[0] : crop[2], crop[1] : crop[3], :]
-            ix += 1
-        # mirror the second five along width (reference io.py:381-383)
-        crops[ix - 5 : ix] = crops[ix - 5 : ix, :, ::-1, :]
-    return crops
+    batch = np.asarray(list(images), np.float32)  # [N, H, W, K]
+    h, w = (int(d) for d in crop_dims)
+    five = np.stack(
+        [
+            batch[:, r : r + h, c : c + w]
+            for r, c in fivecrop_origins(batch.shape[1:3], (h, w))
+        ],
+        axis=1,
+    )  # [N, 5, h, w, K]
+    ten = np.concatenate([five, five[:, :, :, ::-1]], axis=1)
+    return ten.reshape(-1, h, w, batch.shape[-1])
 
 
 # ---------------------------------------------------------------------------
@@ -252,10 +245,30 @@ def oversample(images, crop_dims) -> np.ndarray:
 class Transformer:
     """Input formatting adapter: (H', W', K) image -> net input blob.
 
-    Order of operations matches the reference exactly (io.py:121-161):
-    resize to input dims → transpose → channel swap → raw_scale → mean
-    subtract → input_scale.  ``deprocess`` inverts it (io.py:163-184).
+    Declarative stage pipeline rather than the reference's unrolled
+    if-chains: each stage is ``(settings_attr, apply, invert)``; unset
+    stages are skipped.  ``preprocess`` runs the table top to bottom
+    after resizing to the input dims, giving the reference's operation
+    order (io.py:121-161: resize → transpose → channel swap → raw_scale
+    → mean subtract → input_scale); ``deprocess`` runs the inverses
+    bottom to top (the resize is not inverted, matching io.py:163-184).
     """
+
+    _STAGES = (
+        (
+            "transpose",
+            lambda x, axes: x.transpose(axes),
+            lambda x, axes: x.transpose(np.argsort(axes)),
+        ),
+        (
+            "channel_swap",
+            lambda x, perm: x[list(perm)],
+            lambda x, perm: x[np.argsort(perm)],
+        ),
+        ("raw_scale", lambda x, k: x * k, lambda x, k: x / k),
+        ("mean", lambda x, m: x - m, lambda x, m: x + m),
+        ("input_scale", lambda x, k: x * k, lambda x, k: x / k),
+    )
 
     def __init__(self, inputs: dict[str, tuple[int, ...]]):
         self.inputs = dict(inputs)
@@ -273,46 +286,24 @@ class Transformer:
 
     def preprocess(self, in_: str, data: np.ndarray) -> np.ndarray:
         self._check_input(in_)
-        caffe_in = np.asarray(data, np.float32)
-        in_dims = tuple(self.inputs[in_][2:])
-        if caffe_in.shape[:2] != in_dims:
-            caffe_in = resize_image(caffe_in, in_dims)
-        order = self.transpose.get(in_)
-        if order is not None:
-            caffe_in = caffe_in.transpose(order)
-        swap = self.channel_swap.get(in_)
-        if swap is not None:
-            caffe_in = caffe_in[swap, :, :]
-        raw_scale = self.raw_scale.get(in_)
-        if raw_scale is not None:
-            caffe_in = caffe_in * raw_scale
-        mean = self.mean.get(in_)
-        if mean is not None:
-            caffe_in = caffe_in - mean
-        input_scale = self.input_scale.get(in_)
-        if input_scale is not None:
-            caffe_in = caffe_in * input_scale
-        return caffe_in
+        x = np.asarray(data, np.float32)
+        spatial = tuple(self.inputs[in_][2:])
+        if x.shape[:2] != spatial:
+            x = resize_image(x, spatial)
+        for attr, apply_stage, _ in self._STAGES:
+            setting = getattr(self, attr).get(in_)
+            if setting is not None:
+                x = apply_stage(x, setting)
+        return x
 
     def deprocess(self, in_: str, data: np.ndarray) -> np.ndarray:
         self._check_input(in_)
-        decaf_in = np.array(data, np.float32).squeeze()
-        input_scale = self.input_scale.get(in_)
-        if input_scale is not None:
-            decaf_in = decaf_in / input_scale
-        mean = self.mean.get(in_)
-        if mean is not None:
-            decaf_in = decaf_in + mean
-        raw_scale = self.raw_scale.get(in_)
-        if raw_scale is not None:
-            decaf_in = decaf_in / raw_scale
-        swap = self.channel_swap.get(in_)
-        if swap is not None:
-            decaf_in = decaf_in[np.argsort(swap), :, :]
-        order = self.transpose.get(in_)
-        if order is not None:
-            decaf_in = decaf_in.transpose(np.argsort(order))
-        return decaf_in
+        x = np.array(data, np.float32).squeeze()
+        for attr, _, invert_stage in reversed(self._STAGES):
+            setting = getattr(self, attr).get(in_)
+            if setting is not None:
+                x = invert_stage(x, setting)
+        return x
 
     def set_transpose(self, in_: str, order) -> None:
         self._check_input(in_)

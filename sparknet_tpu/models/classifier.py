@@ -40,38 +40,31 @@ class Classifier(DeployNet):
     def predict(self, inputs, oversample: bool = True) -> np.ndarray:
         """(N) iterable of (H, W, K) images -> (N, C) class probabilities.
 
-        ``oversample=True`` averages over 4 corners + center and mirrors
-        (classifier.py:47-99); ``False`` takes the center crop only.
+        Behavioral parity with classifier.py:47-99, restructured: resize
+        every image to ``image_dims``, crop (ten-crop when
+        ``oversample``, else the shared `fivecrop_origins` center crop),
+        preprocess, forward, and average each image's 10 crop
+        predictions when oversampling.
         """
-        inputs = list(inputs)
-        input_ = np.zeros(
-            (len(inputs), self.image_dims[0], self.image_dims[1], inputs[0].shape[2]),
-            np.float32,
+        resized = np.stack(
+            [
+                cio.resize_image(np.asarray(im, np.float32), self.image_dims)
+                for im in inputs
+            ]
         )
-        for ix, im in enumerate(inputs):
-            input_[ix] = cio.resize_image(im, self.image_dims)
-
         if oversample:
-            input_ = cio.oversample(input_, self.crop_dims)
+            crops = cio.oversample(resized, self.crop_dims)
         else:
-            center = np.array(self.image_dims) / 2.0
-            crop = np.tile(center, (1, 2))[0] + np.concatenate(
-                [-self.crop_dims / 2.0, self.crop_dims / 2.0]
-            )
-            crop = crop.astype(int)
-            input_ = input_[:, crop[0] : crop[2], crop[1] : crop[3], :]
+            h, w = (int(d) for d in self.crop_dims)
+            r, c = cio.fivecrop_origins(self.image_dims, (h, w))[-1]
+            crops = resized[:, r : r + h, c : c + w]
 
         in_ = self.inputs[0]
-        caffe_in = np.zeros(
-            (len(input_),) + tuple(np.array(input_.shape)[[3, 1, 2]]), np.float32
-        )
-        for ix, im in enumerate(input_):
-            caffe_in[ix] = self.transformer.preprocess(in_, im)
-        out = self.forward_all(in_, caffe_in)
-        predictions = out[self.outputs[0]]
-        predictions = predictions.reshape(len(predictions), -1)
-
+        blobs = np.stack(
+            [self.transformer.preprocess(in_, im) for im in crops]
+        ).astype(np.float32)
+        probs = self.forward_all(in_, blobs)[self.outputs[0]]
+        probs = probs.reshape(len(crops), -1)
         if oversample:
-            predictions = predictions.reshape((len(predictions) // 10, 10, -1))
-            predictions = predictions.mean(1)
-        return predictions
+            probs = probs.reshape(-1, 10, probs.shape[-1]).mean(axis=1)
+        return probs

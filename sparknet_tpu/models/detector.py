@@ -84,73 +84,88 @@ class Detector(DeployNet):
             return cio.load_image(src).astype(np.float32)
         return np.asarray(src, np.float32)
 
-    def crop(self, im: np.ndarray, window: np.ndarray) -> np.ndarray:
+    def crop(self, im: np.ndarray, window) -> np.ndarray:
         """Crop a window, optionally with scaled surrounding context and
-        mean padding where the context runs off the image
-        (detector.py:125-180)."""
-        window = np.asarray(window)
-        crop = im[window[0] : window[2], window[1] : window[3]]
+        mean padding where the context runs off the image.
 
-        if self.context_pad:
-            box = window.astype(float).copy()
-            crop_size = self.feed_shapes[self.inputs[0]][3]  # square input
-            scale = crop_size / (1.0 * crop_size - self.context_pad * 2)
-            half_h = (box[2] - box[0] + 1) / 2.0
-            half_w = (box[3] - box[1] + 1) / 2.0
-            center = (box[0] + half_h, box[1] + half_w)
-            scaled_dims = scale * np.array((-half_h, -half_w, half_h, half_w))
-            box = np.round(np.tile(center, 2) + scaled_dims)
-            full_h = box[2] - box[0] + 1
-            full_w = box[3] - box[1] + 1
-            scale_h = crop_size / full_h
-            scale_w = crop_size / full_w
-            pad_y = int(round(max(0.0, -box[0]) * scale_h))
-            pad_x = int(round(max(0.0, -box[1]) * scale_w))
+        Behavioral parity with detector.py:125-180, restructured as two
+        per-axis geometry passes (`_inflate_span` / `_axis_paste`): the
+        window is an inclusive box, inflated about its center so the
+        original content occupies the net input minus ``context_pad`` on
+        each side; whatever falls outside the image is filled with the
+        unprocessed-space mean."""
+        top, left, bottom, right = (int(v) for v in np.asarray(window)[:4])
+        if not self.context_pad:
+            return im[top:bottom, left:right]
 
-            im_h, im_w = im.shape[:2]
-            box = np.clip(box, 0.0, [im_h, im_w, im_h, im_w]).astype(int)
-            clip_h = box[2] - box[0] + 1
-            clip_w = box[3] - box[1] + 1
-            assert clip_h > 0 and clip_w > 0
-            crop_h = int(round(clip_h * scale_h))
-            crop_w = int(round(clip_w * scale_w))
-            crop_h = min(crop_h, crop_size - pad_y)
-            crop_w = min(crop_w, crop_size - pad_x)
+        size = int(self.feed_shapes[self.inputs[0]][3])  # square net input
+        inflate = size / float(size - 2 * self.context_pad)
+        rows = _inflate_span(top, bottom, inflate)
+        cols = _inflate_span(left, right, inflate)
+        src_r, dst_r = _axis_paste(rows, im.shape[0], size)
+        src_c, dst_c = _axis_paste(cols, im.shape[1], size)
 
-            context_crop = im[box[0] : box[2], box[1] : box[3]]
-            context_crop = cio.resize_image(context_crop, (crop_h, crop_w))
-            crop = np.ones(self.crop_dims, dtype=np.float32) * self.crop_mean
-            crop[pad_y : pad_y + crop_h, pad_x : pad_x + crop_w] = context_crop
-
-        return crop
+        context = cio.resize_image(
+            im[src_r[0] : src_r[1], src_c[0] : src_c[1]],
+            (dst_r[1] - dst_r[0], dst_c[1] - dst_c[0]),
+        )
+        canvas = np.array(
+            np.broadcast_to(self.crop_mean, tuple(self.crop_dims)), np.float32
+        )
+        canvas[dst_r[0] : dst_r[1], dst_c[0] : dst_c[1]] = context
+        return canvas
 
     def configure_crop(self, context_pad) -> None:
-        """Set crop dims in input-image space and the unprocessed-space mean
-        used for context padding (detector.py:181-211)."""
+        """Set crop dims in input-image space and the unprocessed-space
+        mean used for context padding (parity: detector.py:181-211)."""
         in_ = self.inputs[0]
-        tpose = self.transformer.transpose[in_]
-        inv_tpose = [tpose[t] for t in tpose]
-        self.crop_dims = np.array(self.feed_shapes[in_][1:])[inv_tpose]
+        to_image = np.argsort(self.transformer.transpose[in_])
+        self.crop_dims = np.asarray(self.feed_shapes[in_][1:])[to_image]
         self.context_pad = context_pad
         if self.context_pad:
-            transpose = self.transformer.transpose.get(in_)
-            channel_order = self.transformer.channel_swap.get(in_)
-            raw_scale = self.transformer.raw_scale.get(in_)
-            mean = self.transformer.mean.get(in_)
-            if mean is not None:
-                inv_transpose = [transpose[t] for t in transpose]
-                crop_mean = mean.copy().transpose(inv_transpose)
-                if crop_mean.shape[:2] == (1, 1):  # broadcast channel mean
-                    crop_mean = np.broadcast_to(
-                        crop_mean, tuple(self.crop_dims)
-                    ).copy()
-                if channel_order is not None:
-                    channel_order_inverse = [
-                        channel_order.index(i) for i in range(crop_mean.shape[2])
-                    ]
-                    crop_mean = crop_mean[:, :, channel_order_inverse]
-                if raw_scale is not None:
-                    crop_mean /= raw_scale
-                self.crop_mean = crop_mean
-            else:
-                self.crop_mean = np.zeros(tuple(self.crop_dims), np.float32)
+            self.crop_mean = self._unprocessed_mean(in_, to_image)
+
+    def _unprocessed_mean(self, in_: str, to_image: np.ndarray) -> np.ndarray:
+        """The Transformer's mean pushed back through its own stages into
+        raw image space (H, W, K, input units) for context padding."""
+        xf = self.transformer
+        mean = xf.mean.get(in_)
+        if mean is None:
+            return np.zeros(tuple(self.crop_dims), np.float32)
+        m = np.asarray(mean, np.float32).transpose(to_image)
+        if m.shape[:2] == (1, 1):  # per-channel mean: broadcast spatially
+            m = np.broadcast_to(m, tuple(self.crop_dims))
+        swap = xf.channel_swap.get(in_)
+        if swap is not None:
+            m = m[:, :, np.argsort(swap)]
+        m = np.array(m, np.float32)
+        raw_scale = xf.raw_scale.get(in_)
+        if raw_scale is not None:
+            m /= raw_scale
+        return m
+
+
+def _inflate_span(lo: int, hi: int, factor: float) -> tuple[float, float]:
+    """Scale an inclusive 1-D span about its center; rounded endpoints."""
+    half = (hi - lo + 1) / 2.0
+    mid = lo + half
+    return float(np.round(mid - factor * half)), float(np.round(mid + factor * half))
+
+
+def _axis_paste(
+    span: tuple[float, float], limit: int, out_size: int
+) -> tuple[tuple[int, int], tuple[int, int]]:
+    """Map one axis of an inclusive source span onto a length-``out_size``
+    destination.
+
+    Returns ``((src_lo, src_hi), (dst_lo, dst_hi))``: the in-bounds part
+    of the span, and where its resized image lands in the destination
+    (the remainder is padding)."""
+    zoom = out_size / (span[1] - span[0] + 1)
+    dst_lo = int(round(max(0.0, -span[0]) * zoom))
+    src_lo = int(min(max(span[0], 0.0), limit))
+    src_hi = int(min(max(span[1], 0.0), limit))
+    if src_hi <= src_lo:
+        raise ValueError(f"window span {span} lies outside the image")
+    dst_len = min(int(round((src_hi - src_lo + 1) * zoom)), out_size - dst_lo)
+    return (src_lo, src_hi), (dst_lo, dst_lo + dst_len)
