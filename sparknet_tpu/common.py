@@ -41,9 +41,11 @@ class Config:
     compute_dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
     # Default mesh axis names: data parallelism over 'data', within-layer
-    # (tensor) sharding over 'model'.
+    # (tensor) sharding over 'model', sequence/context parallelism over
+    # 'seq' (ring / Ulysses attention).
     data_axis: str = "data"
     model_axis: str = "model"
+    seq_axis: str = "seq"
 
 
 _lock = threading.Lock()

@@ -112,13 +112,17 @@ def InnerProductLayer(
     num_output: int,
     weight_filler: Message | None = None,
     bias_filler: Message | None = None,
+    axis: int | None = None,
 ) -> Message:
-    """ref: Layers.scala:88-100."""
+    """ref: Layers.scala:88-100.  ``axis`` flattens from that axis
+    (Caffe default 1; axis=2 keeps a [B, S, E] sequence per-token)."""
     m = _layer(name, "InnerProduct", bottoms)
     p = Message()
     p.set("num_output", num_output)
     p.set("weight_filler", weight_filler or _filler("xavier"))
     p.set("bias_filler", bias_filler or _filler("constant", value=0.0))
+    if axis is not None:
+        p.set("axis", axis)
     m.set("inner_product_param", p)
     return m
 
@@ -189,6 +193,19 @@ def SigmoidCrossEntropyLossLayer(
     return m
 
 
+def EltwiseLayer(
+    name: str,
+    bottoms: Sequence[str],
+    operation: str = "SUM",
+    top: str | None = None,
+) -> Message:
+    """ref: eltwise_layer.cpp (PROD / SUM / MAX over bottoms)."""
+    m = _layer(name, "Eltwise", bottoms, [top] if top else None)
+    if operation != "SUM":
+        m.set("eltwise_param", Message().set("operation", operation))
+    return m
+
+
 def SoftmaxLayer(name: str, bottoms: Sequence[str]) -> Message:
     return _layer(name, "Softmax", bottoms)
 
@@ -213,6 +230,23 @@ def AccuracyLayer(
     if phase is not None:
         m.add("include", Message().set("phase", phase))
     return m
+
+
+def EmbedLayer(
+    name: str,
+    bottoms: Sequence[str],
+    input_dim: int,
+    num_output: int,
+    weight_filler: Message | None = None,
+    top: str | None = None,
+) -> Message:
+    """Embedding lookup (ref: embed_layer.cpp; ops/blocks.py Embed)."""
+    m = _layer(name, "Embed", bottoms, [top] if top else None)
+    p = Message()
+    p.set("input_dim", input_dim)
+    p.set("num_output", num_output)
+    p.set("weight_filler", weight_filler or _filler("xavier"))
+    return m.set("embed_param", p)
 
 
 def MultiHeadAttentionLayer(

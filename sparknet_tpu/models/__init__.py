@@ -25,4 +25,6 @@ from sparknet_tpu.models.zoo import (  # noqa: F401
     mnist_autoencoder_solver,
     mnist_siamese,
     mnist_siamese_solver,
+    transformer,
+    transformer_solver,
 )

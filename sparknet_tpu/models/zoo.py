@@ -22,10 +22,13 @@ from sparknet_tpu.layers_dsl import (
     ConcatLayer,
     ConvolutionLayer,
     DropoutLayer,
+    EltwiseLayer,
+    EmbedLayer,
     EuclideanLossLayer,
     FlattenLayer,
     InnerProductLayer,
     LRNLayer,
+    MultiHeadAttentionLayer,
     NetParam,
     Pooling,
     PoolingLayer,
@@ -495,4 +498,69 @@ def mnist_autoencoder_solver() -> SolverConfig:
         base_lr=0.01, lr_policy="step", gamma=0.1, stepsize=10000,
         momentum=0.9, weight_decay=0.0005, max_iter=65000,
         solver_type="SGD", display=100, snapshot=10000,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Transformer sequence classifier — long-context extra (no reference
+# analog: SURVEY §5 "long-context: absent").  A causal decoder stack built
+# entirely from prototxt-compatible layers, so the flagship TPU features
+# (ring/Ulysses sequence parallelism via a 'seq' mesh axis, flash
+# attention) are reachable from the framework's ordinary model front door.
+# ---------------------------------------------------------------------------
+def _transformer_block(i: int, bottom: str, embed_dim: int, heads: int,
+                       ffn_dim: int) -> tuple[list[Message], str]:
+    """Pre-LN-free residual block: attention + residual, per-token FFN
+    (InnerProduct axis=2) + residual."""
+    attn, res, out = f"attn{i}", f"res{i}", f"blk{i}"
+    layers = [
+        MultiHeadAttentionLayer(attn, [bottom], num_heads=heads,
+                                causal=True, top=attn),
+        EltwiseLayer(res, [bottom, attn], top=res),
+        InnerProductLayer(f"ffn{i}a", [res], num_output=ffn_dim, axis=2,
+                          weight_filler=_gauss(0.05)),
+        ReLULayer(f"ffn{i}r", [f"ffn{i}a"], in_place=True),
+        InnerProductLayer(f"ffn{i}b", [f"ffn{i}a"], num_output=embed_dim,
+                          axis=2, weight_filler=_gauss(0.05)),
+        EltwiseLayer(out, [res, f"ffn{i}b"], top=out),
+    ]
+    return layers, out
+
+
+def transformer(
+    batch: int = 32,
+    seq_len: int = 32,
+    vocab: int = 64,
+    embed_dim: int = 32,
+    heads: int = 4,
+    ffn_dim: int = 64,
+    blocks: int = 2,
+    num_classes: int = 10,
+) -> Message:
+    """Causal transformer over [batch, seq_len] token ids -> sequence
+    class.  Trains under `ParallelTrainer` on a (data, seq) mesh with the
+    attention cores running ring/Ulysses sequence parallelism."""
+    layers = [
+        RDDLayer("data", shape=[batch, seq_len]),
+        RDDLayer("label", shape=[batch]),
+        EmbedLayer("embed", ["data"], input_dim=vocab,
+                   num_output=embed_dim, top="embed"),
+    ]
+    bottom = "embed"
+    for i in range(1, blocks + 1):
+        blk, bottom = _transformer_block(i, bottom, embed_dim, heads, ffn_dim)
+        layers += blk
+    layers += [
+        InnerProductLayer("fc", [bottom], num_output=num_classes,
+                          weight_filler=_gauss(0.05)),
+        SoftmaxWithLoss("loss", ["fc", "label"]),
+        AccuracyLayer("accuracy", ["fc", "label"], phase="TEST"),
+    ]
+    return NetParam("Transformer", *layers)
+
+
+def transformer_solver() -> SolverConfig:
+    return SolverConfig(
+        base_lr=0.1, lr_policy="fixed", momentum=0.9, weight_decay=1e-4,
+        max_iter=2000, solver_type="SGD", display=100,
     )

@@ -19,18 +19,102 @@ Input/output blobs are [B, S, E].  Params follow Caffe blob order:
 exportable through every weight path (caffemodel, HDF5, orbax).  The
 attention core routes through :func:`flash_attention`, so
 ``SPARKNET_ATTN_IMPL=pallas`` drops the blocked MXU kernel in unchanged.
+
+Sequence parallelism composes here: under an active
+:func:`sequence_parallel` context (a `ParallelTrainer` whose mesh has a
+'seq' axis activates it automatically), the attention core runs ring or
+Ulysses attention with the sequence dimension sharded over that axis —
+the same prototxt model scales to long contexts with no model changes.
 """
 
 from __future__ import annotations
 
+import contextlib
+import threading
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 
+from sparknet_tpu.common import get_config
 from sparknet_tpu.ops.base import Layer, LayerOutput
 from sparknet_tpu.ops.fillers import fill
 from sparknet_tpu.ops.pallas_kernels import flash_attention
 from sparknet_tpu.ops.registry import register
 from sparknet_tpu.proto.text_format import Message
+
+# ---------------------------------------------------------------------------
+# Sequence-parallel dispatch.
+#
+# The SP primitives (`parallel/ring_attention.py`, `parallel/ulysses.py`)
+# are mesh programs; a Layer is a mesh-oblivious pytree function.  The
+# bridge is a TRACE-TIME context: a trainer whose mesh has a 'seq' axis
+# activates `sequence_parallel(mesh, impl)` around its jitted-step trace,
+# and every MultiHeadAttention layer traced inside routes its attention
+# core through a shard_map over that axis (batch stays on 'data').  The
+# context nests under jit: only tracing consults it, the compiled program
+# keeps the collectives.
+# ---------------------------------------------------------------------------
+
+_SP = threading.local()
+
+
+@contextlib.contextmanager
+def sequence_parallel(mesh, impl: str = "ring"):
+    """Route MultiHeadAttention layers traced in this context through
+    sequence parallelism over ``mesh``'s 'seq' axis.
+
+    ``impl``: 'ring' (ppermute K/V rotation — any head count) or
+    'ulysses' (head-scatter all_to_all — needs num_heads divisible by the
+    seq-axis size).
+    """
+    if impl not in ("ring", "ulysses"):
+        raise ValueError(f"unknown sequence-parallel impl {impl!r}")
+    prev = getattr(_SP, "ctx", None)
+    _SP.ctx = (mesh, impl)
+    try:
+        yield
+    finally:
+        _SP.ctx = prev
+
+
+def active_sequence_parallel():
+    """(mesh, impl) when a seq-parallel context with a real (>1) seq axis
+    is active, else None."""
+    ctx = getattr(_SP, "ctx", None)
+    if ctx is None:
+        return None
+    mesh, impl = ctx
+    from sparknet_tpu.parallel.mesh import mesh_seq_size
+
+    if mesh_seq_size(mesh) <= 1:
+        return None
+    return mesh, impl
+
+
+def _sp_attention(mesh, impl, q, k, v, causal):
+    """Attention core over a (data?, seq) mesh: [B, H, S, D] inputs with
+    B on 'data' and S on 'seq'; collectives ride the 'seq' axis only."""
+    from sparknet_tpu.parallel.mesh import shard_map
+    from sparknet_tpu.parallel.ring_attention import ring_attention
+    from sparknet_tpu.parallel.ulysses import ulysses_attention
+
+    cfg = get_config()
+    sax = cfg.seq_axis
+    dax = cfg.data_axis if mesh.shape.get(cfg.data_axis, 1) > 1 else None
+    if impl == "ulysses" and q.shape[1] % mesh.shape[sax] != 0:
+        raise ValueError(
+            f"ulysses needs num_heads ({q.shape[1]}) divisible by the "
+            f"'{sax}' mesh axis ({mesh.shape[sax]}); use impl='ring'"
+        )
+    core = ring_attention if impl == "ring" else ulysses_attention
+    spec = jax.sharding.PartitionSpec(dax, None, sax, None)
+    return shard_map(
+        partial(core, axis_name=sax, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )(q, k, v)
 
 
 @register
@@ -72,7 +156,25 @@ class MultiHeadAttentionLayer(Layer):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         # [B, S, E] -> [B, H, S, D]
         split = lambda t: t.reshape(B, S, H, D).transpose(0, 2, 1, 3)
-        o = flash_attention(split(q), split(k), split(v), causal=self.causal)
+        sp = active_sequence_parallel()
+        if sp is not None and S % sp[0].shape[get_config().seq_axis] != 0:
+            # ring/Ulysses need equal sequence blocks; an indivisible S
+            # runs locally instead (correct, just not sequence-parallel)
+            import warnings
+
+            warnings.warn(
+                f"{self.name}: sequence length {S} not divisible by the "
+                f"'seq' mesh axis ({sp[0].shape[get_config().seq_axis]}); "
+                "attention runs without sequence parallelism",
+                stacklevel=2,
+            )
+            sp = None
+        if sp is not None:
+            o = _sp_attention(
+                sp[0], sp[1], split(q), split(k), split(v), self.causal
+            )
+        else:
+            o = flash_attention(split(q), split(k), split(v), causal=self.causal)
         o = o.transpose(0, 2, 1, 3).reshape(B, S, E)
         y = jnp.einsum("bse,fe->bsf", o, w_out) + b_out
         return LayerOutput(outputs=[y])

@@ -58,19 +58,37 @@ def data_parallel_mesh(num_devices: int | None = None) -> Mesh:
 def auto_mesh(
     num_devices: int | None = None,
     model_parallel: int = 1,
+    seq_parallel: int = 1,
 ) -> Mesh:
-    """2-D (data, model) mesh.  `model_parallel` is the tensor-parallel
-    degree; the rest of the devices go to data parallelism.  On real TPU
-    hardware the default device order already keeps the minor axis on
-    ICI-adjacent chips, so the model axis rides the fastest links."""
+    """(data, model[, seq]) mesh.  `model_parallel` is the tensor-parallel
+    degree, `seq_parallel` the sequence/context-parallel degree (ring /
+    Ulysses attention); the rest of the devices go to data parallelism.
+    On real TPU hardware the default device order keeps the minor-most
+    mesh axis on ICI-adjacent chips; the reshape here places seq
+    minor-most (then model), so the per-step ppermute/all_to_all traffic
+    of sequence parallelism rides the fastest links."""
     cfg = get_config()
     devices = jax.devices()
     n = num_devices if num_devices is not None else len(devices)
     devices = devices[:n]
-    if n % model_parallel != 0:
-        raise ValueError(f"{n} devices not divisible by model_parallel={model_parallel}")
-    arr = np.array(devices).reshape(n // model_parallel, model_parallel)
-    return Mesh(arr, axis_names=(cfg.data_axis, cfg.model_axis))
+    denom = model_parallel * seq_parallel
+    if n % denom != 0:
+        raise ValueError(
+            f"{n} devices not divisible by model_parallel={model_parallel} "
+            f"* seq_parallel={seq_parallel}"
+        )
+    dims = [n // denom, model_parallel]
+    axes = [cfg.data_axis, cfg.model_axis]
+    if seq_parallel > 1:
+        dims.append(seq_parallel)
+        axes.append(cfg.seq_axis)
+    arr = np.array(devices).reshape(dims)
+    return Mesh(arr, axis_names=tuple(axes))
+
+
+def mesh_seq_size(mesh: Mesh) -> int:
+    cfg = get_config()
+    return mesh.shape.get(cfg.seq_axis, 1)
 
 
 def mesh_data_size(mesh: Mesh) -> int:

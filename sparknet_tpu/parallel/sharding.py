@@ -37,6 +37,17 @@ class ShardingRules:
     tensor_parallel: bool = True
     # don't bother sharding tiny blobs — the all-gather costs more than it saves
     min_tp_dim: int = 128
+    # Sequence parallelism: when the trainer's mesh has a 'seq' axis,
+    # shard feed axis 1 (the sequence axis of [B, S] / [B, S, E] feeds)
+    # over it and route MultiHeadAttention layers through ring/Ulysses
+    # (`ops.attention.sequence_parallel`).
+    sequence_parallel: bool = True
+    attention_impl: str = "ring"  # 'ring' | 'ulysses'
+    # Which feeds carry a sequence axis (axis 1).  None = auto: any feed
+    # whose axis-1 size is divisible by the seq-axis degree (others
+    # replicate along 'seq').  Name feeds explicitly to fail loudly on a
+    # non-divisible sequence length instead of silently falling back.
+    seq_feeds: tuple[str, ...] | None = None
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

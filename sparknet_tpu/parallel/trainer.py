@@ -94,6 +94,23 @@ class ParallelTrainer:
             solver.train_net, solver.variables, self.mesh, self._rules
         )
 
+        # Sequence parallelism: a 'seq' mesh axis + rules.sequence_parallel
+        # shards feed axis 1 over it and routes MultiHeadAttention layers
+        # through ring/Ulysses at trace time (ops.attention context).
+        from sparknet_tpu.parallel.mesh import mesh_seq_size
+
+        self._seq_size = (
+            mesh_seq_size(self.mesh) if self._rules.sequence_parallel else 1
+        )
+        if self._seq_size > 1 and (self.tau > 1 or elastic_alpha > 0):
+            raise ValueError(
+                "sequence parallelism (a 'seq' mesh axis) composes with "
+                "tau=1 synchronous DP only: the tau>1/EASGD rounds are "
+                "already a manual shard_map over 'data' and cannot nest "
+                "the seq-axis attention shard_map. Use tau=1, or a mesh "
+                "without a 'seq' axis."
+            )
+
         self.elastic_alpha = float(elastic_alpha)
         self._elastic = elastic_alpha > 0.0
         if elastic_alpha and not (
@@ -255,11 +272,38 @@ class ParallelTrainer:
         (each Spark executor reads its partition, ref:
         CifarApp.scala:118-130) — and the global array is assembled
         process-locally without any cross-host data motion."""
-        spec = (
-            NamedSharding(self.mesh, P(None, self.data_axis))
-            if with_tau_axis
-            else batch_sharding(self.mesh)
-        )
+        def spec_for(name, v):
+            if with_tau_axis:
+                return NamedSharding(self.mesh, P(None, self.data_axis))
+            if self._seq_size > 1 and np.ndim(v) >= 2:
+                # sequence models: feed axis 1 is the sequence dimension
+                # ([B, S] ids / [B, S, E] embeddings / [B, S] labels) and
+                # shards over 'seq' alongside the batch over 'data'.
+                # rules.seq_feeds selects feeds explicitly; the default
+                # (None) applies to any feed whose axis 1 divides evenly,
+                # falling back to batch-only sharding otherwise (sharding
+                # is layout, not semantics — GSPMD reshards inside the
+                # program, and the attention shard_map forces its own
+                # specs — so a skipped/extra feed costs transfer, never
+                # correctness).
+                listed = self._rules.seq_feeds
+                divisible = np.shape(v)[1] % self._seq_size == 0
+                if listed is not None and name in listed:
+                    if not divisible:
+                        raise ValueError(
+                            f"feed {name!r}: sequence length "
+                            f"{np.shape(v)[1]} not divisible by the "
+                            f"'seq' mesh axis ({self._seq_size})"
+                        )
+                    wanted = True
+                else:
+                    wanted = listed is None and divisible
+                if wanted:
+                    return NamedSharding(
+                        self.mesh, P(self.data_axis, get_config().seq_axis)
+                    )
+            return batch_sharding(self.mesh)
+
         mesh_procs = self._mesh_procs
         if mesh_procs > 1:
             out = {}
@@ -271,9 +315,14 @@ class ParallelTrainer:
                     + (v.shape[bax] * mesh_procs,)
                     + v.shape[bax + 1:]
                 )
-                out[k] = jax.make_array_from_process_local_data(spec, v, gshape)
+                out[k] = jax.make_array_from_process_local_data(
+                    spec_for(k, v), v, gshape
+                )
             return out
-        return {k: jax.device_put(jnp.asarray(v), spec) for k, v in feeds.items()}
+        return {
+            k: jax.device_put(jnp.asarray(v), spec_for(k, v))
+            for k, v in feeds.items()
+        }
 
     # ------------------------------------------------------------------
     def train_round(self, data_fn: DataFn) -> float:
@@ -297,9 +346,11 @@ class ParallelTrainer:
             self.iter += self.tau
         elif self.tau == 1:
             feeds = self._put_feeds(data_fn(self.iter), with_tau_axis=False)
-            self.variables, self.slots, loss = self._train(
-                self.variables, self.slots, self.iter, feeds, self.solver._key
-            )
+            with self._sp_context():
+                self.variables, self.slots, loss = self._train(
+                    self.variables, self.slots, self.iter, feeds,
+                    self.solver._key,
+                )
             self.iter += 1
         else:
             feeds = self._put_feeds(data_fn(self.iter), with_tau_axis=True)
@@ -318,6 +369,18 @@ class ParallelTrainer:
         return loss
 
     # ------------------------------------------------------------------
+    def _sp_context(self):
+        """Trace-time sequence-parallel routing for jitted steps (no-op
+        without a 'seq' mesh axis)."""
+        if self._seq_size > 1:
+            from sparknet_tpu.ops.attention import sequence_parallel
+
+            return sequence_parallel(self.mesh, self._rules.attention_impl)
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    # ------------------------------------------------------------------
     def test(self, num_batches: int, data_fn: DataFn) -> dict[str, float]:
         """Distributed eval with the reference's sum-then-normalize semantics
         (ref: Solver::TestAndStoreResult solver.cpp:414-444 +
@@ -326,7 +389,8 @@ class ParallelTrainer:
         sums: dict[str, float] = {}
         for b in range(num_batches):
             feeds = self._put_feeds(data_fn(b), with_tau_axis=False)
-            outs = self.solver._eval_step(variables, feeds)
+            with self._sp_context():
+                outs = self.solver._eval_step(variables, feeds)
             for name, val in outs.items():
                 sums[name] = sums.get(name, 0.0) + float(jnp.sum(val))
         return {k: v / num_batches for k, v in sums.items()}
