@@ -155,7 +155,7 @@ def cmd_train(args) -> int:
 
     from sparknet_tpu.parallel.trainer import ParallelTrainer
     from sparknet_tpu.solvers.solver import Solver
-    from sparknet_tpu.utils import EventLogger, SignalHandler, SolverAction
+    from sparknet_tpu.utils import EventLogger, SignalHandler, SolverAction, agree_action
 
     if args.snapshot and getattr(args, "weights", ""):
         # ref: caffe.cpp:161-163 "Give a snapshot to resume training or
@@ -233,7 +233,7 @@ def cmd_train(args) -> int:
                             _widen_batch(train_fn, trainer.num_local_workers)
                         )
                     log(f"loss: {loss:.5f}", i=trainer.iter)
-                    action = sig.check()
+                    action = agree_action(sig.check())
                     if action is SolverAction.SNAPSHOT:
                         trainer.sync_to_solver()
                         # process 0 owns snapshots (replicated params are
@@ -437,13 +437,26 @@ def cmd_convert_imageset(args) -> int:
                     continue
                 yield arr, int(label)
 
-    n = create_db(args.db, samples())
+    n = create_db(args.db, samples(), backend=args.backend)
     if n == 0:
         raise SystemExit(
             f"no decodable images: check --root {args.root!r} and the "
             f"listfile paths (0 of the listed files produced records)"
         )
-    print(json.dumps({"records": n, "db": args.db}))
+    print(json.dumps({"records": n, "db": args.db, "backend": args.backend}))
+    return 0
+
+
+def cmd_convert_db(args) -> int:
+    """LMDB <-> RecordDB conversion — the ingest bridge for existing
+    Caffe datasets (ref: caffe/src/caffe/util/db_lmdb.cpp is the
+    reference's reader; tpunet reads that format directly and this
+    command re-materializes it for the native data plane)."""
+    from sparknet_tpu.data.createdb import convert_db
+
+    n = convert_db(args.src, args.dst, backend=args.backend)
+    print(json.dumps({"records": n, "src": args.src, "dst": args.dst,
+                      "backend": args.backend}))
     return 0
 
 
@@ -874,7 +887,17 @@ def main(argv=None) -> int:
     sp.add_argument("--listfile", required=True, help='lines of "relpath label"')
     sp.add_argument("--db", required=True, help="output record DB path")
     sp.add_argument("--resize", type=int, default=256)
+    sp.add_argument("--backend", choices=("record", "lmdb"), default="record",
+                    help="output format (lmdb = Caffe-compatible)")
     sp.set_defaults(fn=cmd_convert_imageset)
+
+    sp = sub.add_parser("convert_db",
+                        help="convert LMDB <-> native record DB")
+    sp.add_argument("--src", required=True, help="source DB (either format)")
+    sp.add_argument("--dst", required=True, help="destination path")
+    sp.add_argument("--backend", choices=("record", "lmdb"),
+                    default="record", help="destination format")
+    sp.set_defaults(fn=cmd_convert_db)
 
     sp = sub.add_parser("compute_image_mean", help="record DB -> mean .npy")
     sp.add_argument("--db", required=True)

@@ -1,15 +1,19 @@
-"""Dataset -> record-DB materialization and DB-backed minibatch reading.
+"""Dataset -> DB materialization and DB-backed minibatch reading.
 
 The reference's alternative "Caffe-native data source" path: executors
 write their partition into per-worker LMDB/LevelDBs through the C API
 (ref: src/main/scala/preprocessing/CreateDB.scala:10-52, commit every
 1000 records) and training reads them through Caffe's own DataLayer
-(ref: src/main/scala/apps/CifarDBApp.scala:96-131).  Here: the native
-RecordDB plays LMDB, and ``db_minibatches`` plays the DataLayer cursor.
+(ref: src/main/scala/apps/CifarDBApp.scala:96-131).  Two backends here:
 
-Record value layout (the Datum role, ref: caffe.proto:30-41 without the
-protobuf dependency): little-endian u32 c,h,w, i32 label, then c*h*w raw
-uint8 pixels.
+- ``record`` — the native RecordDB (C++ data plane), value layout:
+  little-endian u32 c,h,w, i32 label, then c*h*w raw uint8 pixels (the
+  Datum role, ref: caffe.proto:30-41, without the protobuf dependency);
+- ``lmdb`` — real LMDB environments with protobuf ``Datum`` values, the
+  reference's own format (ref: db_lmdb.cpp), via the clean-room codec in
+  :mod:`sparknet_tpu.data.lmdb_io` — existing Caffe datasets load as-is.
+
+``db_minibatches`` auto-detects the backend per path.
 """
 
 from __future__ import annotations
@@ -42,16 +46,72 @@ def create_db(
     path: str,
     samples: Iterable[tuple[np.ndarray, int]],
     commit_every: int = COMMIT_EVERY,
+    backend: str = "record",
 ) -> int:
-    """Write (uint8 CHW image, label) samples; returns the record count."""
+    """Write (uint8 CHW image, label) samples; returns the record count.
+
+    ``backend='lmdb'`` writes a real LMDB environment with protobuf
+    Datum values (Caffe-readable); default is the native RecordDB."""
+    writer = _open_writer(path, backend)
+    encode = _value_encoder(backend)
     n = 0
-    with RecordDB(path, "w") as db:
+    with writer as db:
         for image, label in samples:
-            db.put(f"{n:08d}".encode(), encode_datum(image, label))
+            db.put(f"{n:08d}".encode(), encode(image, label))
             n += 1
             if n % commit_every == 0:
                 db.commit()
         db.commit()
+    return n
+
+
+def _open_writer(path: str, backend: str):
+    if backend == "record":
+        return RecordDB(path, "w")
+    if backend == "lmdb":
+        from sparknet_tpu.data.lmdb_io import LmdbWriter
+
+        return LmdbWriter(path)
+    raise ValueError(f"unknown db backend {backend!r} (record | lmdb)")
+
+
+def _value_encoder(backend: str):
+    if backend == "lmdb":
+        from sparknet_tpu.data.io_utils import array_to_datum
+
+        return lambda image, label: array_to_datum(
+            np.ascontiguousarray(image, np.uint8), label
+        )
+    return encode_datum
+
+
+def _open_reader(path: str):
+    """(db, decode) for either backend; LMDB detected by meta magic."""
+    from sparknet_tpu.data import lmdb_io
+
+    if lmdb_io.is_lmdb(path):
+        from sparknet_tpu.data.io_utils import datum_to_array
+
+        return lmdb_io.LmdbReader(path), datum_to_array
+    return RecordDB(path, "r"), decode_datum
+
+
+def convert_db(src: str, dst: str, backend: str = "record") -> int:
+    """Re-materialize ``src`` (either backend) as ``dst`` in ``backend``
+    format — the LMDB-ingest bridge: existing Caffe LMDBs convert to the
+    native RecordDB (or the reverse) with keys preserved."""
+    db, decode = _open_reader(src)
+    writer = _open_writer(dst, backend)
+    encode = _value_encoder(backend)
+    n = 0
+    with db, writer:
+        for key, value in db:
+            image, label = decode(value)
+            writer.put(key, encode(image, label))
+            n += 1
+            if n % COMMIT_EVERY == 0:
+                writer.commit()
+        writer.commit()
     return n
 
 
@@ -86,7 +146,8 @@ def db_minibatches(
     ``loop=True`` restarts the cursor each epoch (the DataLayer's rewind).
     ``dtype=np.uint8`` hands back raw pixels (skip the float cast when a
     transformer will cast anyway)."""
-    with RecordDB(path, "r") as db:
+    db, decode = _open_reader(path)
+    with db:
         if loop and (
             len(db) == 0 or (len(db) < batch_size and drop_remainder)
         ):
@@ -97,7 +158,7 @@ def db_minibatches(
         while True:
             imgs, labels = [], []
             for _, value in db:
-                img, label = decode_datum(value)
+                img, label = decode(value)
                 imgs.append(img)
                 labels.append(label)
                 if len(imgs) == batch_size:
