@@ -665,8 +665,12 @@ def test_cli_bench_brew(capsys, monkeypatch):
     """tpunet bench: the headline benchmark as a brew (one JSON line)."""
     from sparknet_tpu.cli import main
 
-    monkeypatch.setenv("SPARKNET_BENCH_INIT_TIMEOUT", "0")
+    # conftest pins JAX_PLATFORMS=cpu, which bench.py honors as the
+    # forced-CPU fast path (no probe subprocess, no watchdog); assert
+    # that coupling so a conftest change fails here, not by hanging
+    assert os.environ.get("JAX_PLATFORMS") == "cpu"
     assert main(["bench", "--batch", "4", "--dtype", "f32"]) == 0
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["metric"] == "alexnet_train_images_per_sec_per_chip"
+    assert rec["measured"] is True
     assert rec["value"] > 0
