@@ -1,0 +1,132 @@
+"""Real-pixel convergence evidence + the SparkNet tau tradeoff.
+
+The reference's canonical checks train on real MNIST/CIFAR bytes with
+published accuracy targets (ref: src/test/scala/libs/CifarSpec.scala:10-94;
+caffe/examples/mnist lenet ~99%; caffe/examples/cifar10 quick ~75%).
+This environment has zero egress and no MNIST/CIFAR files on disk, so the
+strongest real-pixel substitute is sklearn's bundled handwritten digits
+(1,797 genuine 8x8 scans — `sparknet_tpu.data.digits`): the unmodified
+zoo LeNet reaches >=98% test accuracy on them in a few hundred
+iterations.  docs/CONVERGENCE.md records the mapping to the reference
+targets and the measured numbers.
+
+Part 2 reproduces the SparkNet paper's core tradeoff qualitatively on
+the virtual 8-device mesh: at a fixed local-step budget, higher tau
+(fewer synchronizations) trades a little accuracy for fewer
+communication rounds (paper: https://arxiv.org/abs/1511.06051, fig. 5 —
+tau tolerates slow networks).
+
+Run:  python examples/05_convergence_digits.py [--platform cpu]
+      [--iters 400] [--taus 1,5,10]
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--platform", default="cpu",
+                   help="jax platform (cpu = virtual 8-device mesh)")
+    p.add_argument("--iters", type=int, default=400,
+                   help="single-chip training iterations")
+    p.add_argument("--taus", default="1,5,10",
+                   help="comma-separated tau values for the mesh table")
+    p.add_argument("--tau-iters", type=int, default=200,
+                   help="per-worker local-step budget for the tau table")
+    p.add_argument("--batch", type=int, default=64)
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    import os
+
+    if args.platform == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", args.platform)
+
+    from sparknet_tpu import models
+    from sparknet_tpu.data.digits import load_digits_dataset, minibatch_fn
+    from sparknet_tpu.parallel.mesh import data_parallel_mesh
+    from sparknet_tpu.parallel.trainer import ParallelTrainer
+    from sparknet_tpu.solvers.solver import Solver
+
+    xtr, ytr, xte, yte = load_digits_dataset()
+    # lenet's recipe expects [0,1]-scaled inputs (the MNIST prototxt data
+    # layer applies scale 1/256); digits pixels are 0..16
+    xtr, xte = xtr / 16.0, xte / 16.0
+    B = args.batch
+    nb_test = len(yte) // B
+
+    def test_fn(b):
+        return {"data": xte[b * B : (b + 1) * B],
+                "label": yte[b * B : (b + 1) * B]}
+
+    # ---- Part 1: single-chip LeNet on real pixels ----
+    solver = Solver(models.lenet_solver(), models.lenet(B))
+    t0 = time.time()
+    solver.step(args.iters, minibatch_fn(xtr, ytr, B, seed=0))
+    acc = solver.test(nb_test, test_fn)["accuracy"]
+    single = {"iters": args.iters, "test_accuracy": round(float(acc), 4),
+              "seconds": round(time.time() - t0, 1)}
+    print(json.dumps({"lenet_digits_single": single}))
+
+    # ---- Part 2: the tau table on the 8-way mesh ----
+    mesh = data_parallel_mesh()
+    workers = mesh.shape["data"]
+    rows = []
+    for tau in (int(t) for t in args.taus.split(",")):
+        s = Solver(models.lenet_solver(), models.lenet(B))
+        trainer = ParallelTrainer(s, mesh=mesh, tau=tau)
+        outer = args.tau_iters // tau
+        fn = minibatch_fn(xtr, ytr, B, seed=1)
+
+        if tau == 1:
+            def data_fn(it, fn=fn, workers=workers):
+                parts = [fn(it * workers + w) for w in range(workers)]
+                return {k: np.concatenate([p[k] for p in parts])
+                        for k in parts[0]}
+        else:
+            counter = [0]
+
+            def data_fn(it, fn=fn, workers=workers, tau=tau, counter=counter):
+                slots = []
+                for _ in range(tau):
+                    parts = []
+                    for _ in range(workers):
+                        parts.append(fn(counter[0]))
+                        counter[0] += 1
+                    slots.append({k: np.concatenate([p[k] for p in parts])
+                                  for k in parts[0]})
+                return {k: np.stack([s_[k] for s_ in slots])
+                        for k in slots[0]}
+
+        t0 = time.time()
+        for _ in range(outer):
+            trainer.train_round(data_fn)
+        wall = time.time() - t0
+        acc = trainer.test(nb_test, test_fn)["accuracy"]
+        rows.append({
+            "tau": tau,
+            "sync_rounds": outer,
+            "local_steps_per_worker": outer * tau,
+            "test_accuracy": round(float(acc), 4),
+            "seconds": round(wall, 1),
+        })
+        print(json.dumps({"tau_row": rows[-1]}))
+
+    print(json.dumps({"lenet_digits_tau_table": rows, "workers": workers}))
+
+
+if __name__ == "__main__":
+    main()

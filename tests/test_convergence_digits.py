@@ -1,0 +1,40 @@
+"""Real-pixel convergence: zoo LeNet on sklearn's handwritten digits.
+
+The reference's statistical end-to-end check trains on real data and
+asserts accuracy properties (ref: src/test/scala/libs/CifarSpec.scala:92
+— untrained ~chance; caffe/examples/mnist — lenet ~99%).  Real
+MNIST/CIFAR bytes are unavailable in this zero-egress environment
+(caffe/data/*/get_*.sh are download scripts), so the evidence runs on
+the bundled real digits corpus instead: docs/CONVERGENCE.md records the
+target mapping.  Marked slow: ~1 min of CPU training.
+"""
+
+import numpy as np
+import pytest
+
+sklearn_datasets = pytest.importorskip("sklearn.datasets")
+
+from sparknet_tpu import models
+from sparknet_tpu.data.digits import load_digits_dataset, minibatch_fn
+from sparknet_tpu.solvers.solver import Solver
+
+pytestmark = pytest.mark.slow
+
+
+def test_lenet_digits_chance_then_98pct():
+    xtr, ytr, xte, yte = load_digits_dataset()
+    xtr, xte = xtr / 16.0, xte / 16.0  # lenet recipe expects [0,1] scale
+    B = 64
+    nb = len(yte) // B
+
+    def test_fn(b):
+        return {"data": xte[b * B : (b + 1) * B],
+                "label": yte[b * B : (b + 1) * B]}
+
+    solver = Solver(models.lenet_solver(), models.lenet(B))
+    untrained = solver.test(nb, test_fn)["accuracy"]
+    assert 0.02 <= untrained <= 0.25, untrained  # ~chance (CifarSpec bound)
+
+    solver.step(400, minibatch_fn(xtr, ytr, B, seed=0))
+    trained = solver.test(nb, test_fn)["accuracy"]
+    assert trained >= 0.97, trained  # measured 0.984; margin for jitter
