@@ -333,14 +333,18 @@ def cmd_test(args) -> int:
 
 def cmd_time(args) -> int:
     """Per-layer forward/backward breakdown (ref: caffe.cpp:290-380).
-    ``--fused`` instead times the whole jitted train step — the number that
-    matters on TPU, where XLA fuses the layer loop away."""
+    ``--fused`` times the whole jitted train step; ``--trace`` runs the
+    fused step under jax.profiler and attributes device-op time back to
+    layers via the compiler's L.<name> HLO scopes — the honest per-layer
+    number on TPU, where per-layer dispatch measures launch overhead."""
     from sparknet_tpu.common import Phase
     from sparknet_tpu.compiler.graph import Network
     from sparknet_tpu.utils.timing import time_layers
     import jax
 
     net_param, solver_cfg = _build_net_and_solver(args)
+    if getattr(args, "trace", False):
+        return _time_trace(args, net_param, solver_cfg)
     if args.fused:
         import time as _time
 
@@ -410,6 +414,72 @@ def cmd_time(args) -> int:
         tot_b += r["backward_ms"] or 0.0
     print(f"{'TOTAL':<{w}}{'':<18}{tot_f:>9.3f}ms {tot_b:>9.3f}ms")
     print("(layers timed in isolation; the fused jit step is faster)")
+    return 0
+
+
+def _time_trace(args, net_param, solver_cfg) -> int:
+    """tpunet time --trace: profiler-attributed per-layer device time on
+    the fused step, plus MFU and HBM bytes/step (VERDICT r1 item 7 —
+    replaces dispatch-dominated per-layer jit calls)."""
+    import jax
+
+    from sparknet_tpu.solvers.solver import Solver
+    from sparknet_tpu.utils.op_profile import layer_time_table
+
+    solver = Solver(solver_cfg, net_param)
+    train_fn, _ = _data_fns(args, solver.train_net)
+    feeds = jax.device_put(train_fn(0))
+    step, v, s, key = solver.jitted_train_step(donate=False)
+    iters = args.iterations or 10
+
+    # cost analysis for MFU / bytes alongside the measured time; the SAME
+    # compiled executable then drives the profiled run (one XLA compile,
+    # not two — compiles are minutes-scale for big nets on the tunnel)
+    compiled = step.lower(v, s, 0, feeds, key).compile()
+    cost = compiled.cost_analysis() or {}
+    if isinstance(cost, list):
+        cost = cost[0] if cost else {}
+    flops = float(cost.get("flops", 0.0))
+    hbm_bytes = float(cost.get("bytes accessed", 0.0))
+
+    layer_names = [l.name for l in solver.train_net.layers]
+    table = layer_time_table(
+        lambda *a: compiled(*a), (v, s, 0, feeds, key), layer_names, iters
+    )
+
+    wall_s = table["wall_us_per_step"] / 1e6
+    batch = next(iter(feeds.values())).shape[0]
+    platform = jax.devices()[0].platform
+    # public v5e peak: 394 bf16 TFLOP/s (f32 matmuls emulate at ~1/4)
+    peaks = {"tpu": 394e12, "axon": 394e12}
+    peak = peaks.get(platform)
+    mfu = flops / wall_s / peak if peak and wall_s else None
+
+    if table["rows"]:
+        w = max(len(r) for r, _ in table["rows"]) + 2
+        print(f"{'layer':<{w}}{'device ms/step':>15}")
+        for name, us in table["rows"]:
+            print(f"{name:<{w}}{us / 1e3:>14.3f}")
+        print(
+            f"{'DEVICE TOTAL':<{w}}{table['device_us_per_step'] / 1e3:>14.3f}"
+            f"  (attributed {table['attributed_frac'] * 100:.0f}%)"
+        )
+    else:
+        print(
+            "(no device-op lanes in the trace — per-layer attribution "
+            "needs an accelerator backend; wall/MFU numbers below are "
+            "still measured)"
+        )
+    print(json.dumps({
+        "wall_ms_per_step": round(wall_s * 1e3, 3),
+        "img_per_sec": round(batch / wall_s, 1),
+        "batch": int(batch),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "gflop_per_step": round(flops / 1e9, 2),
+        "hbm_gb_per_step": round(hbm_bytes / 1e9, 3),
+        "platform": platform,
+        "trace_dir": table["trace_dir"],
+    }))
     return 0
 
 
@@ -880,6 +950,9 @@ def main(argv=None) -> int:
     sp.add_argument("--hlo", action="store_true",
                     help="XLA cost analysis of the compiled step (flops, "
                     "HBM bytes, arithmetic intensity)")
+    sp.add_argument("--trace", action="store_true",
+                    help="profiler-attributed per-layer device time on the "
+                    "fused step + MFU + bytes/step (accelerator backends)")
     sp.set_defaults(fn=cmd_time)
 
     sp = sub.add_parser("convert_imageset", help="image list -> record DB")

@@ -424,7 +424,11 @@ class Network:
                     ins = [_cast(x, jnp.float32) for x in ins]
                 else:
                     p = [_cast(x, cdt) for x in p]
-            out = layer.apply(p, s, ins, train=train, rng=sub)
+            # the scope lands in HLO op metadata, letting profiler traces
+            # attribute fused-op time back to prototxt layers (tpunet
+            # time --trace); '/' would nest scopes, so flatten it
+            with jax.named_scope("L." + layer.name.replace("/", ".")):
+                out = layer.apply(p, s, ins, train=train, rng=sub)
             if out.state:
                 if mixed and layer.name in variables.state:
                     prev = variables.state[layer.name]

@@ -1,0 +1,132 @@
+"""Profiler-trace aggregation: fused-step time attributed to layers.
+
+The reference's ``caffe time`` walks the layer vector calling
+Forward/Backward per layer with a cudaEvent timer (ref:
+caffe/tools/caffe.cpp:290-380 + util/benchmark.cpp) — honest there,
+meaningless on TPU where XLA fuses the whole step into one program and
+per-layer dispatch measures launch overhead, not compute.  The TPU-native
+equivalent: run the REAL fused step under ``jax.profiler``, parse the
+exported trace, and attribute device-op time back to prototxt layers via
+the ``L.<name>`` scopes the graph compiler stamps into HLO metadata
+(compiler/graph.py).  The per-layer table then sums to ~the measured
+step time instead of to a dispatch artifact.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+import tempfile
+from collections import defaultdict
+
+_SCOPE = re.compile(r"\bL\.([\w.\-]+)")
+
+
+def profile_step(step_fn, args, iters: int = 5) -> dict:
+    """Run ``step_fn(*args)`` ``iters`` times under the profiler; returns
+    {"events": [(name, dur_us)], "wall_step_us": float}.
+
+    The first call is executed before tracing starts so compile time
+    never pollutes the trace.
+    """
+    import time
+
+    import jax
+
+    out = step_fn(*args)
+    jax.block_until_ready(out)
+
+    tmp = tempfile.mkdtemp(prefix="tpunet_time_")
+    jax.profiler.start_trace(tmp)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = step_fn(*args)
+    jax.block_until_ready(out)
+    wall = (time.perf_counter() - t0) / iters
+    jax.profiler.stop_trace()
+    return {
+        "events": _device_events(tmp),
+        "wall_step_us": wall * 1e6,
+        "trace_dir": tmp,
+    }
+
+
+def _device_events(log_dir: str) -> list[tuple[str, float]]:
+    """(op name, duration µs) complete-events from device lanes of every
+    exported Chrome trace under ``log_dir``."""
+    events: list[tuple[str, float]] = []
+    for path in glob.glob(
+        os.path.join(log_dir, "**", "*.trace.json.gz"), recursive=True
+    ):
+        with gzip.open(path, "rt") as f:
+            trace = json.load(f)
+        raw = trace.get("traceEvents", [])
+        # pid -> process name; device lanes carry the XLA op timeline
+        pnames = {
+            e.get("pid"): e.get("args", {}).get("name", "")
+            for e in raw
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+        }
+        device_pids = {
+            pid
+            for pid, name in pnames.items()
+            if any(tag in name for tag in ("/device:", "TPU", "GPU", "XLA"))
+            and "CUPTI" not in name
+        }
+        for e in raw:
+            if e.get("ph") != "X" or e.get("pid") not in device_pids:
+                continue
+            dur = e.get("dur")
+            if not dur:
+                continue
+            name = e.get("name", "")
+            scope = e.get("args", {}).get("long_name", "") or e.get(
+                "args", {}
+            ).get("tf_op", "")
+            events.append((f"{name}|{scope}", float(dur)))
+    return events
+
+
+def aggregate_by_layer(
+    events: list[tuple[str, float]], iters: int
+) -> tuple[dict[str, float], float]:
+    """Per-layer µs/step from scoped events; unattributed time under
+    '(other)'.  Returns (layer -> us, total device us/step)."""
+    per_layer: dict[str, float] = defaultdict(float)
+    total = 0.0
+    for name, dur in events:
+        total += dur
+        m = _SCOPE.search(name)
+        per_layer[m.group(1) if m else "(other)"] += dur
+    return (
+        {k: v / iters for k, v in per_layer.items()},
+        total / iters,
+    )
+
+
+def layer_time_table(step_fn, args, layer_names, iters: int = 5) -> dict:
+    """The ``tpunet time --trace`` payload: per-layer device µs/step (in
+    net order, then the rest), total device time, and wall step time."""
+    prof = profile_step(step_fn, args, iters)
+    per_layer, device_total = aggregate_by_layer(prof["events"], iters)
+    ordered: list[tuple[str, float]] = []
+    for name in layer_names:
+        key = name.replace("/", ".")
+        if key in per_layer:
+            ordered.append((name, per_layer.pop(key)))
+    ordered.extend(sorted(per_layer.items(), key=lambda kv: -kv[1]))
+    return {
+        "rows": ordered,
+        "device_us_per_step": device_total,
+        "wall_us_per_step": prof["wall_step_us"],
+        "trace_dir": prof["trace_dir"],
+        "attributed_frac": (
+            sum(us for name, us in ordered if name != "(other)")
+            / device_total
+            if device_total
+            else 0.0
+        ),
+    }
