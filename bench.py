@@ -65,7 +65,7 @@ def _env_int(name: str, default: int) -> int:
 
 
 def _bench_params():
-    """(model, crop, dtype_name) from env, validated."""
+    """(model, crop) from env, validated."""
     crops = {"alexnet": 227, "caffenet": 227, "googlenet": 224}
     model = os.environ.get("SPARKNET_BENCH_MODEL", "alexnet")
     if model not in crops:
@@ -265,13 +265,18 @@ def main() -> int:
     import threading
 
     model, crop = _bench_params()
-    forced_cpu = os.environ.get("JAX_PLATFORMS", "").strip() == "cpu"
+    # forced-CPU detection must cover BOTH routes: the env var and the
+    # jax.config route (the CLI's --platform flag and site hooks pin the
+    # platform through config, which outranks the env var).  Importing
+    # jax reads config without initializing a backend.
+    import jax
+
+    forced_cpu = (
+        os.environ.get("JAX_PLATFORMS", "").strip() == "cpu"
+        or jax.config.jax_platforms == "cpu"
+    )
 
     if forced_cpu:
-        # env alone is not enough: a site hook may pin a hardware plugin
-        # through jax.config, which outranks JAX_PLATFORMS
-        import jax
-
         jax.config.update("jax_platforms", "cpu")
         platform = "cpu"
     else:
@@ -281,6 +286,8 @@ def main() -> int:
         )
         if not probe["ok"]:
             dtype_name = os.environ.get("SPARKNET_BENCH_DTYPE", "bf16")
+            if dtype_name == "bfloat16":
+                dtype_name = "bf16"
             batch = _env_int("SPARKNET_BENCH_BATCH", 256)
             print(
                 f"bench: backend unreachable ({probe['reason']}); emitting "
