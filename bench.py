@@ -75,6 +75,12 @@ def _bench_params():
     return model, crops[model]
 
 
+def _bench_dtype(default: str) -> str:
+    """Normalized SPARKNET_BENCH_DTYPE (one alias table for every path)."""
+    name = os.environ.get("SPARKNET_BENCH_DTYPE", default)
+    return {"bfloat16": "bf16", "float32": "f32"}.get(name, name)
+
+
 def probe_backend(attempts: int = 3, timeout: float = 150.0) -> dict:
     """Dial the default jax backend from a disposable subprocess.
 
@@ -285,9 +291,7 @@ def main() -> int:
             timeout=_env_float("SPARKNET_BENCH_PROBE_TIMEOUT", 150.0),
         )
         if not probe["ok"]:
-            dtype_name = os.environ.get("SPARKNET_BENCH_DTYPE", "bf16")
-            if dtype_name == "bfloat16":
-                dtype_name = "bf16"
+            dtype_name = _bench_dtype("bf16")
             batch = _env_int("SPARKNET_BENCH_BATCH", 256)
             print(
                 f"bench: backend unreachable ({probe['reason']}); emitting "
@@ -309,11 +313,7 @@ def main() -> int:
     # a fraction of peak), f32 master params and optimizer state.  Default
     # to it on accelerators; SPARKNET_BENCH_DTYPE=f32 forces the baseline's
     # full-f32 arithmetic for an apples-to-apples run.
-    dtype_name = os.environ.get(
-        "SPARKNET_BENCH_DTYPE", "bf16" if on_accel else "f32"
-    )
-    if dtype_name in ("bfloat16",):
-        dtype_name = "bf16"
+    dtype_name = _bench_dtype("bf16" if on_accel else "f32")
 
     # Deadline watchdog: the probe says the relay answers, but a wedge can
     # still strike mid-compile.  On expiry print the partial record so the

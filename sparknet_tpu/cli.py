@@ -7,6 +7,8 @@ framework's own text-format parser) or zoo names (``zoo:alexnet``).
 
 Data sources (the reference's in-net LMDB layers are host-plane inputs
 here): ``--data cifar:<dir>`` reads real CIFAR-10 binaries;
+``--data db:<path>[,<test_path>]`` streams a record DB or Caffe LMDB
+(``{proc}`` expands to the process id — the per-worker-DB layout);
 ``--data synthetic`` generates pixel-scale random batches (enough for
 ``time``/smoke runs, like ``caffe time``'s dummy forward/backward).
 """
@@ -89,6 +91,68 @@ def _data_fns(args, net):
             }
 
         return train_fn, test_fn
+
+    if args.data.startswith("db:"):
+        # DB-backed training — the CifarDBApp/ImageNetRunDBApp flow (ref:
+        # src/main/scala/apps/CifarDBApp.scala:96-131 reads per-worker
+        # LevelDBs through Caffe's DataLayer).  Accepts the native
+        # RecordDB or a real Caffe LMDB (auto-detected);
+        # "db:train[,test]" with "{proc}" substituted by process id for
+        # the reference's per-worker-DB layout.
+        from sparknet_tpu.data.createdb import db_minibatches
+
+        paths = args.data[3:].split(",")
+        train_path = paths[0].replace("{proc}", str(pid))
+        # eval stream stays identical on every process (see cifar note)
+        test_path = (paths[1] if len(paths) > 1 else paths[0]).replace(
+            "{proc}", "0"
+        )
+        # transform_param.scale parity (ref: lenet_train_test.prototxt
+        # scale: 0.00390625 — DataLayer scales raw bytes before the net)
+        scale = getattr(args, "data_scale", 0.0) or 1.0
+        # one shared DB across a multi-process job: shard by batch
+        # interleave (process p takes batches p, p+n, ...) — correct but
+        # every host decodes everything; the {proc} per-worker layout is
+        # the efficient path
+        shared = "{proc}" not in paths[0] and nproc > 1
+
+        def db_stream(path, stride=1, offset=0):
+            """Lazy cursor: nothing opens until the first call, so
+            eval-only subcommands never touch the train DB; errors
+            surface as clean SystemExits at first use."""
+            state: dict = {}
+
+            def fn(_):
+                if "iter" not in state:
+                    try:
+                        state["iter"] = db_minibatches(path, batch, loop=True)
+                        b = next(state["iter"])
+                        for _ in range(offset):
+                            b = next(state["iter"])
+                    except (OSError, ValueError) as e:
+                        raise SystemExit(f"--data db: {path}: {e}") from None
+                    if tuple(b["data"].shape[1:]) != tuple(data_shape[1:]):
+                        raise SystemExit(
+                            f"{path}: db images {tuple(b['data'].shape[1:])} "
+                            f"do not match the net's data blob "
+                            f"{tuple(data_shape[1:])}"
+                        )
+                else:
+                    for _ in range(stride - 1):
+                        next(state["iter"])
+                    b = next(state["iter"])
+                if scale != 1.0:
+                    b = dict(b, data=b["data"] * scale)
+                return b
+
+            return fn
+
+        return (
+            db_stream(train_path,
+                      stride=nproc if shared else 1,
+                      offset=pid if shared else 0),
+            db_stream(test_path),
+        )
 
     if args.data == "synthetic":
         rs = np.random.RandomState(pid)
@@ -911,7 +975,10 @@ def main(argv=None) -> int:
 
     def common(sp):
         sp.add_argument("--solver", help="solver prototxt path or zoo:<name>")
-        sp.add_argument("--data", default="synthetic", help="cifar:<dir> | synthetic")
+        sp.add_argument("--data", default="synthetic", help="cifar:<dir> | db:<path>[,<test_path>] | synthetic")
+        sp.add_argument("--data-scale", type=float, default=0.0,
+                        help="multiply db feeds by this (transform_param."
+                        "scale parity, e.g. 0.00390625 for lenet)")
         sp.add_argument("--batch", type=int, default=0, help="zoo batch override")
         sp.add_argument("--iterations", type=int, default=0)
         sp.add_argument("--snapshot", help=".solverstate.npz to restore")

@@ -218,3 +218,42 @@ class TestDataLayerIngest:
         assert main(["convert_db", "--src", src, "--dst", dst]) == 0
         out = json.loads(capsys.readouterr().out.strip())
         assert out["records"] == 5
+
+
+def test_cli_train_from_lmdb(tmp_path, capsys):
+    """tpunet train --data db:<lmdb> — the CifarDBApp flow end to end
+    from a real Caffe-format LMDB through the CLI."""
+    import numpy as np
+
+    from sparknet_tpu.cli import main
+    from sparknet_tpu.data.createdb import create_db
+
+    rs = np.random.RandomState(0)
+    samples = [
+        (rs.randint(0, 255, (1, 28, 28)).astype(np.uint8), i % 10)
+        for i in range(64)
+    ]
+    p = str(tmp_path / "train_lmdb")
+    create_db(p, samples, backend="lmdb")
+    out = str(tmp_path / "model")
+    assert main([
+        "train", "--solver", "zoo:lenet", "--batch", "16",
+        "--iterations", "2", "--data", f"db:{p}", "--output", out,
+    ]) == 0
+
+
+def test_cli_train_db_shape_mismatch(tmp_path):
+    import numpy as np
+    import pytest
+
+    from sparknet_tpu.cli import main
+    from sparknet_tpu.data.createdb import create_db
+
+    rs = np.random.RandomState(0)
+    samples = [(rs.randint(0, 255, (3, 8, 8)).astype(np.uint8), 0)
+               for _ in range(8)]
+    p = str(tmp_path / "bad_lmdb")
+    create_db(p, samples, backend="lmdb")
+    with pytest.raises(SystemExit, match="do not match"):
+        main(["train", "--solver", "zoo:lenet", "--batch", "4",
+              "--iterations", "1", "--data", f"db:{p}"])

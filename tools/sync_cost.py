@@ -51,6 +51,12 @@ def main():
 
     mesh = data_parallel_mesh(args.devices)
     p = mesh.shape["data"]
+    if p < args.devices:
+        raise SystemExit(
+            f"only {p} device(s) visible, {args.devices} requested — on a "
+            "CPU-only host pass --platform cpu so the virtual mesh flag "
+            "is set before jax initializes"
+        )
     spec = NamedSharding(mesh, P("data"))
 
     # v5e public specs for the analytic column: per-chip ICI egress
