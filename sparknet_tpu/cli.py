@@ -1138,6 +1138,10 @@ def main(argv=None) -> int:
         sp.add_argument("ignored", nargs="*")
         sp.set_defaults(fn=_cmd_deprecated(repl))
 
+    from sparknet_tpu import pods as _pods
+
+    _pods.add_parser(sub)
+
     sp = sub.add_parser("bench", help="headline training-throughput benchmark")
     sp.add_argument("--model", default="", help="alexnet|caffenet|googlenet")
     sp.add_argument("--batch", type=int, default=0)

@@ -1,0 +1,187 @@
+"""TPU pod provisioning — the `ec2/spark_ec2.py` role, TPU-native.
+
+The reference forks Apache spark-ec2 (1,528 LoC) to stand up a Spark
+cluster with GPU AMIs (ref: ec2/spark_ec2.py:481 launch_cluster, :790
+setup_cluster, spot pricing, security groups).  A TPU pod needs none of
+that machinery — the slice IS the cluster — so the equivalent is a thin,
+auditable command builder over `gcloud compute tpus tpu-vm`, exposed as
+``tpunet pods <verb>`` with the spark-ec2 verb set:
+
+    launch   -> pods create        (accelerator type, runtime, spot)
+    destroy  -> pods delete
+    login    -> pods ssh           (one worker or --worker=all)
+    (rsync)  -> pods scp           (stage code/data onto every worker)
+    —        -> pods run           (same command on every worker — the
+                                    spark-submit analog; SPMD programs
+                                    self-coordinate via jax.distributed)
+    —        -> pods status        (describe, health)
+
+Every verb supports ``--dry-run`` printing the exact command line(s)
+instead of executing, which is also how the logic is tested in an
+environment without gcloud or network access.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import shlex
+import shutil
+import subprocess
+import sys
+
+
+@dataclasses.dataclass(frozen=True)
+class PodConfig:
+    name: str
+    zone: str
+    accelerator_type: str = "v5litepod-8"
+    version: str = "v2-alpha-tpuv5-lite"  # runtime image
+    project: str | None = None
+    spot: bool = False
+
+    def base(self) -> list[str]:
+        cmd = ["gcloud", "compute", "tpus", "tpu-vm"]
+        return cmd
+
+    def scope(self) -> list[str]:
+        out = ["--zone", self.zone]
+        if self.project:
+            out += ["--project", self.project]
+        return out
+
+
+def create_command(cfg: PodConfig) -> list[str]:
+    cmd = cfg.base() + ["create", cfg.name] + cfg.scope()
+    cmd += ["--accelerator-type", cfg.accelerator_type]
+    cmd += ["--version", cfg.version]
+    if cfg.spot:
+        cmd += ["--spot"]  # the spark-ec2 spot-pricing knob
+    return cmd
+
+
+def delete_command(cfg: PodConfig) -> list[str]:
+    return cfg.base() + ["delete", cfg.name, "--quiet"] + cfg.scope()
+
+
+def status_command(cfg: PodConfig) -> list[str]:
+    return cfg.base() + ["describe", cfg.name] + cfg.scope()
+
+
+def ssh_command(
+    cfg: PodConfig, command: str | None = None, worker: str = "0"
+) -> list[str]:
+    cmd = cfg.base() + ["ssh", cfg.name] + cfg.scope()
+    cmd += ["--worker", worker]
+    if command:
+        cmd += ["--command", command]
+    return cmd
+
+
+def scp_command(
+    cfg: PodConfig, src: str, dst: str, worker: str = "all"
+) -> list[str]:
+    cmd = cfg.base() + ["scp", "--recurse", src, f"{cfg.name}:{dst}"]
+    cmd += cfg.scope() + ["--worker", worker]
+    return cmd
+
+
+def run_command(cfg: PodConfig, command: str) -> list[str]:
+    """The spark-submit analog: every worker runs the same SPMD program;
+    jax.distributed.initialize() self-coordinates on Cloud TPU."""
+    return ssh_command(cfg, command=command, worker="all")
+
+
+def execute(cmd: list[str], dry_run: bool) -> int:
+    """Print (dry run) or run a provisioning command."""
+    line = shlex.join(cmd)  # paste-able: quoting survives --command args
+    if dry_run:
+        print(line)
+        return 0
+    if shutil.which(cmd[0]) is None:
+        raise SystemExit(
+            f"{cmd[0]} not found on PATH — install the Google Cloud CLI, "
+            "or use --dry-run to print the commands for another shell"
+        )
+    print(f"+ {line}", file=sys.stderr)
+    return subprocess.run(cmd).returncode
+
+
+def config_from_args(args) -> PodConfig:
+    if not args.name:
+        raise SystemExit("--name is required (the pod slice name)")
+    if not args.zone:
+        raise SystemExit("--zone is required (e.g. us-west4-a)")
+    return PodConfig(
+        name=args.name,
+        zone=args.zone,
+        accelerator_type=args.type,
+        version=args.runtime,
+        project=args.project or None,
+        spot=bool(args.spot),
+    )
+
+
+def cmd_pods(args) -> int:
+    cfg = config_from_args(args)
+    verb = args.verb
+    if verb == "create":
+        return execute(create_command(cfg), args.dry_run)
+    if verb == "delete":
+        return execute(delete_command(cfg), args.dry_run)
+    if verb == "status":
+        return execute(status_command(cfg), args.dry_run)
+    if verb == "ssh":
+        # interactive login defaults to one worker (gcloud rejects a
+        # multi-worker ssh without --command); scp/run default to all
+        worker = args.worker or ("0" if not args.command else "all")
+        return execute(
+            ssh_command(cfg, command=args.command or None, worker=worker),
+            args.dry_run,
+        )
+    if verb == "scp":
+        if not args.src or not args.dst:
+            raise SystemExit("scp needs --src and --dst")
+        return execute(
+            scp_command(cfg, args.src, args.dst,
+                        worker=args.worker or "all"),
+            args.dry_run,
+        )
+    if verb == "run":
+        if not args.command:
+            raise SystemExit(
+                'run needs --command, e.g. --command "python -m '
+                "sparknet_tpu.cli train --solver zoo:caffenet "
+                '--data db:/data/train --distributed"'
+            )
+        cmd = ssh_command(cfg, command=args.command,
+                          worker=args.worker or "all")
+        return execute(cmd, args.dry_run)
+    raise SystemExit(f"unknown pods verb {verb!r}")
+
+
+def add_parser(sub) -> None:
+    sp = sub.add_parser(
+        "pods",
+        help="provision/drive TPU pod slices (the spark-ec2 role)",
+    )
+    sp.add_argument("verb",
+                    choices=("create", "delete", "status", "ssh", "scp",
+                             "run"))
+    sp.add_argument("--name", default="", help="pod slice name")
+    sp.add_argument("--zone", default="", help="GCP zone")
+    sp.add_argument("--type", default="v5litepod-8",
+                    help="accelerator type (v5litepod-8/-32/-256, ...)")
+    sp.add_argument("--runtime", default="v2-alpha-tpuv5-lite",
+                    help="TPU VM runtime version")
+    sp.add_argument("--project", default="")
+    sp.add_argument("--spot", action="store_true",
+                    help="preemptible capacity (spark-ec2's spot pricing)")
+    sp.add_argument("--worker", default="",
+                    help='worker index or "all" (default: 0 for '
+                    "interactive ssh, all otherwise)")
+    sp.add_argument("--command", default="", help="remote command")
+    sp.add_argument("--src", default="", help="scp source")
+    sp.add_argument("--dst", default="", help="scp destination")
+    sp.add_argument("--dry-run", action="store_true",
+                    help="print the gcloud command instead of running")
+    sp.set_defaults(fn=cmd_pods)

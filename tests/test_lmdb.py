@@ -220,10 +220,12 @@ class TestDataLayerIngest:
         assert out["records"] == 5
 
 
-def test_cli_train_from_lmdb(tmp_path, capsys):
+def test_cli_train_from_lmdb(tmp_path, capsys, monkeypatch):
     """tpunet train --data db:<lmdb> — the CifarDBApp flow end to end
     from a real Caffe-format LMDB through the CLI."""
     import numpy as np
+
+    monkeypatch.chdir(tmp_path)  # cmd_train writes its event log to cwd
 
     from sparknet_tpu.cli import main
     from sparknet_tpu.data.createdb import create_db
@@ -242,9 +244,11 @@ def test_cli_train_from_lmdb(tmp_path, capsys):
     ]) == 0
 
 
-def test_cli_train_db_shape_mismatch(tmp_path):
+def test_cli_train_db_shape_mismatch(tmp_path, monkeypatch):
     import numpy as np
     import pytest
+
+    monkeypatch.chdir(tmp_path)  # cmd_train writes its event log to cwd
 
     from sparknet_tpu.cli import main
     from sparknet_tpu.data.createdb import create_db

@@ -1,0 +1,83 @@
+"""TPU pod provisioning CLI (the spark-ec2 role; ref: ec2/spark_ec2.py
+launch/destroy/login verbs).  Dry-run only — this environment has no
+gcloud and no network; the command builder IS the logic."""
+
+import pytest
+
+from sparknet_tpu.cli import main
+from sparknet_tpu.pods import (
+    PodConfig,
+    create_command,
+    delete_command,
+    run_command,
+    scp_command,
+    ssh_command,
+)
+
+CFG = PodConfig(name="sparknet-pod", zone="us-west4-a",
+                accelerator_type="v5litepod-32", project="proj")
+
+
+def test_create_command_shape():
+    cmd = create_command(CFG)
+    assert cmd[:5] == ["gcloud", "compute", "tpus", "tpu-vm", "create"]
+    assert "sparknet-pod" in cmd
+    assert ["--zone", "us-west4-a"] == cmd[cmd.index("--zone"):][:2]
+    assert ["--accelerator-type", "v5litepod-32"] == \
+        cmd[cmd.index("--accelerator-type"):][:2]
+    assert "--spot" not in cmd
+    assert "--spot" in create_command(
+        PodConfig(name="p", zone="z", spot=True))
+
+
+def test_delete_is_quiet_and_scoped():
+    cmd = delete_command(CFG)
+    assert "--quiet" in cmd and "--project" in cmd
+
+
+def test_run_spans_all_workers():
+    cmd = run_command(CFG, "python train.py")
+    assert ["--worker", "all"] == cmd[cmd.index("--worker"):][:2]
+    assert ["--command", "python train.py"] == \
+        cmd[cmd.index("--command"):][:2]
+
+
+def test_ssh_single_worker_no_command():
+    cmd = ssh_command(CFG, worker="3")
+    assert ["--worker", "3"] == cmd[cmd.index("--worker"):][:2]
+    assert "--command" not in cmd
+
+
+def test_scp_recurse_to_pod_path():
+    cmd = scp_command(CFG, "/repo", "/home/u/repo")
+    assert "--recurse" in cmd
+    assert "sparknet-pod:/home/u/repo" in cmd
+
+
+def test_cli_dry_run_prints_command(capsys):
+    rc = main(["pods", "create", "--name", "p1", "--zone", "us-west4-a",
+               "--type", "v5litepod-8", "--dry-run"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert out.startswith("gcloud compute tpus tpu-vm create p1")
+    assert "--accelerator-type v5litepod-8" in out
+
+
+def test_cli_validation():
+    with pytest.raises(SystemExit, match="--name"):
+        main(["pods", "create", "--zone", "z", "--dry-run"])
+    with pytest.raises(SystemExit, match="--zone"):
+        main(["pods", "create", "--name", "p", "--dry-run"])
+    with pytest.raises(SystemExit, match="--command"):
+        main(["pods", "run", "--name", "p", "--zone", "z", "--dry-run"])
+    with pytest.raises(SystemExit, match="--src"):
+        main(["pods", "scp", "--name", "p", "--zone", "z", "--dry-run"])
+
+
+def test_cli_run_dry_run(capsys):
+    rc = main(["pods", "run", "--name", "p", "--zone", "z", "--command",
+               "tpunet train --solver zoo:caffenet --distributed",
+               "--dry-run"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "--worker all" in out and "tpunet train" in out
