@@ -309,6 +309,26 @@ def cmd_train(args) -> int:
                         break
             trainer.sync_to_solver()
         else:
+            if getattr(args, "prefetch", 0) > 0:
+                # async host->HBM feed (the BasePrefetchingDataLayer role):
+                # the worker thread transforms + device_puts ahead of the
+                # step; fall back to the direct fn if the stream runs dry
+                # (the display path consumes extra batches)
+                from sparknet_tpu.data.prefetch import DevicePrefetcher
+
+                direct_fn = train_fn
+                pf = DevicePrefetcher(
+                    direct_fn, iters, depth=args.prefetch
+                )
+                pf_iter = iter(pf)
+
+                def train_fn(it, _direct=direct_fn):  # noqa: F811
+                    try:
+                        return next(pf_iter)
+                    except StopIteration:
+                        return jax.device_put(_direct(it))
+
+                log(f"prefetch: depth {args.prefetch}")
             display = solver_cfg.display
             with SignalHandler() as sig:
                 def hook(it, loss):
@@ -989,6 +1009,9 @@ def main(argv=None) -> int:
                     help="finetune: copy params by layer name from a "
                     ".caffemodel/.h5 (fresh optimizer state)")
     sp.add_argument("--tau", type=int, default=1, help="model-averaging interval")
+    sp.add_argument("--prefetch", type=int, default=0,
+                    help="async device-feed queue depth (0 = off; the "
+                    "reference's PREFETCH_COUNT is 3)")
     sp.add_argument("--distributed", action="store_true", help="use the device mesh")
     sp.add_argument("--elastic-alpha", type=float, default=0.0,
                     help="EASGD coupling strength (~0.9/num_workers); "
