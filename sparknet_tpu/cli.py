@@ -309,28 +309,29 @@ def cmd_train(args) -> int:
                         break
             trainer.sync_to_solver()
         else:
+            import contextlib
+
+            pf_ctx = contextlib.nullcontext()
             if getattr(args, "prefetch", 0) > 0:
                 # async host->HBM feed (the BasePrefetchingDataLayer role):
                 # the worker thread transforms + device_puts ahead of the
-                # step; fall back to the direct fn if the stream runs dry
-                # (the display path consumes extra batches)
+                # step.  Streams from solver.iter so snapshot resume
+                # continues the data sequence; the context closes the
+                # worker on STOP so queued device batches release.
                 from sparknet_tpu.data.prefetch import DevicePrefetcher
 
-                direct_fn = train_fn
-                pf = DevicePrefetcher(
-                    direct_fn, iters, depth=args.prefetch
+                pf_ctx = DevicePrefetcher(
+                    train_fn, iters, depth=args.prefetch,
+                    start_iter=solver.iter,
                 )
-                pf_iter = iter(pf)
+                pf_iter = iter(pf_ctx)
 
-                def train_fn(it, _direct=direct_fn):  # noqa: F811
-                    try:
-                        return next(pf_iter)
-                    except StopIteration:
-                        return jax.device_put(_direct(it))
+                def train_fn(it):  # noqa: F811
+                    return next(pf_iter)
 
                 log(f"prefetch: depth {args.prefetch}")
             display = solver_cfg.display
-            with SignalHandler() as sig:
+            with pf_ctx, SignalHandler() as sig:
                 def hook(it, loss):
                     # mirror the solver's display cadence into the event log
                     # so parse_log gets train-table rows (the reference's
