@@ -534,10 +534,37 @@ def _time_trace(args, net_param, solver_cfg) -> int:
 
     wall_s = table["wall_us_per_step"] / 1e6
     batch = next(iter(feeds.values())).shape[0]
-    platform = jax.devices()[0].platform
-    # public v5e peak: 394 bf16 TFLOP/s (f32 matmuls emulate at ~1/4)
-    peaks = {"tpu": 394e12, "axon": 394e12}
-    peak = peaks.get(platform)
+    device = jax.devices()[0]
+    platform = device.platform
+    # Peak FLOP/s by TPU generation AND active compute dtype (public specs;
+    # f32 matmuls emulate on the MXU at a fraction of bf16 rate).  MFU
+    # against the wrong cell is off by ~4x, so the record also names which
+    # peak it was computed against.
+    import jax.numpy as jnp
+
+    from sparknet_tpu.common import get_config
+
+    dtype = get_config().compute_dtype
+    dtype_name = "bf16" if dtype == jnp.bfloat16 else "f32"
+    kind = getattr(device, "device_kind", "") or platform
+    peak_table = {
+        # device_kind substring -> {dtype: peak FLOP/s}
+        "v5 lite": {"bf16": 394e12, "f32": 98e12},
+        "v5e": {"bf16": 394e12, "f32": 98e12},
+        "v5p": {"bf16": 459e12, "f32": 115e12},
+        "v4": {"bf16": 275e12, "f32": 69e12},
+        "v6": {"bf16": 918e12, "f32": 230e12},
+    }
+    peak = None
+    peak_label = None
+    if platform in ("tpu", "axon"):
+        kind_l = kind.lower()
+        for sub, cols in peak_table.items():
+            if sub in kind_l:
+                peak, peak_label = cols[dtype_name], f"{sub}_{dtype_name}"
+                break
+        else:  # unknown TPU generation: fall back to v5e, but say so
+            peak, peak_label = peak_table["v5e"][dtype_name], f"v5e_{dtype_name}(assumed)"
     mfu = flops / wall_s / peak if peak and wall_s else None
 
     if table["rows"]:
@@ -560,6 +587,7 @@ def _time_trace(args, net_param, solver_cfg) -> int:
         "img_per_sec": round(batch / wall_s, 1),
         "batch": int(batch),
         "mfu": round(mfu, 4) if mfu is not None else None,
+        "mfu_vs_peak": peak_label,
         "gflop_per_step": round(flops / 1e9, 2),
         "hbm_gb_per_step": round(hbm_bytes / 1e9, 3),
         "platform": platform,

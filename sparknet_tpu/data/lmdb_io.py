@@ -233,7 +233,19 @@ class LmdbWriter:
 
     def commit(self) -> None:
         """Accepted for API symmetry with RecordDB; the single durable
-        commit happens at close."""
+        commit happens at close.  Warn once so large ingests relying on
+        the reference's every-1000-records durability cadence know the
+        data stays in RAM until close() (use the record backend for
+        incremental durability)."""
+        if not getattr(self, "_commit_warned", False):
+            self._commit_warned = True
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "LmdbWriter.commit() is deferred: all records are buffered "
+                "in memory and written durably at close(); for incremental "
+                "commit durability use the RecordDB backend"
+            )
 
     # -- page assembly -------------------------------------------------
     def _build(self) -> bytes:
@@ -278,7 +290,6 @@ class LmdbWriter:
                 cur_nodes, cur_used = [], 0
 
         cur_first_key = [b""]
-        overflow_patches: list[tuple[int, bytes]] = []  # (node index in flat list)
         flat_nodes: list[bytearray] = []
 
         for key, value in items:
@@ -311,7 +322,10 @@ class LmdbWriter:
             flat_nodes.append(blob)
             cur_used += size + 2
             if not inline:
-                npages = -(-len(value) // (PAGESIZE - PAGEHDRSZ))
+                # liblmdb's OVPAGES: the value sits contiguously after ONE
+                # 16-byte page header, so pages = ceil((size+hdr)/pagesize)
+                # — not ceil(size/(pagesize-hdr)), which over-allocates.
+                npages = -(-(len(value) + PAGEHDRSZ) // PAGESIZE)
                 first = alloc()
                 for i in range(1, npages):
                     alloc()

@@ -173,24 +173,28 @@ def FlattenLayer(name: str, bottoms: Sequence[str]) -> Message:
     return _layer(name, "Flatten", bottoms)
 
 
+def _loss_layer(
+    name: str, type_: str, bottoms: Sequence[str],
+    loss_weight: float | None, top: str | None,
+) -> Message:
+    m = _layer(name, type_, bottoms, [top] if top else None)
+    if loss_weight is not None:
+        m.add("loss_weight", loss_weight)
+    return m
+
+
 def EuclideanLossLayer(
     name: str, bottoms: Sequence[str], loss_weight: float | None = None,
     top: str | None = None,
 ) -> Message:
-    m = _layer(name, "EuclideanLoss", bottoms, [top] if top else None)
-    if loss_weight is not None:
-        m.add("loss_weight", loss_weight)
-    return m
+    return _loss_layer(name, "EuclideanLoss", bottoms, loss_weight, top)
 
 
 def SigmoidCrossEntropyLossLayer(
     name: str, bottoms: Sequence[str], loss_weight: float | None = None,
     top: str | None = None,
 ) -> Message:
-    m = _layer(name, "SigmoidCrossEntropyLoss", bottoms, [top] if top else None)
-    if loss_weight is not None:
-        m.add("loss_weight", loss_weight)
-    return m
+    return _loss_layer(name, "SigmoidCrossEntropyLoss", bottoms, loss_weight, top)
 
 
 def EltwiseLayer(
@@ -210,9 +214,14 @@ def SoftmaxLayer(name: str, bottoms: Sequence[str]) -> Message:
     return _layer(name, "Softmax", bottoms)
 
 
-def SoftmaxWithLoss(name: str, bottoms: Sequence[str]) -> Message:
-    """ref: Layers.scala:115-128 (bottoms = [scores, label])."""
-    return _layer(name, "SoftmaxWithLoss", bottoms)
+def SoftmaxWithLoss(
+    name: str, bottoms: Sequence[str], loss_weight: float | None = None,
+    top: str | None = None,
+) -> Message:
+    """ref: Layers.scala:115-128 (bottoms = [scores, label]).  ``loss_weight``
+    scales this loss term in the total objective — the GoogLeNet auxiliary
+    classifiers train at 0.3 (bvlc_googlenet/train_val.prototxt:933,1696)."""
+    return _loss_layer(name, "SoftmaxWithLoss", bottoms, loss_weight, top)
 
 
 def AccuracyLayer(

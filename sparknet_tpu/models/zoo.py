@@ -308,6 +308,36 @@ def _inception(name: str, bottom: str, c1: int, c3r: int, c3: int,
     return layers
 
 
+def _googlenet_aux_head(i: int, bottom: str, num_classes: int) -> list[Message]:
+    """Auxiliary classifier tower ``loss{i}`` — ave_pool 5x5/3 → 1x1 conv 128
+    → fc 1024 → drop 0.7 → fc num_classes → SoftmaxWithLoss at weight 0.3.
+    The published recipe trains with BOTH aux heads in every phase (ref:
+    caffe/models/bvlc_googlenet/train_val.prototxt:823-953 loss1,
+    :1586-1716 loss2; loss_weight 0.3 at :933 and :1696)."""
+    w = lambda: _filler("xavier")
+    b = lambda: _const(0.2)
+    p = f"loss{i}"
+    return [
+        PoolingLayer(f"{p}/ave_pool", [bottom], Pooling.Ave,
+                     kernel=(5, 5), stride=(3, 3)),
+        ConvolutionLayer(f"{p}/conv", [f"{p}/ave_pool"], kernel=(1, 1),
+                         num_output=128, weight_filler=w(), bias_filler=b()),
+        ReLULayer(f"{p}/relu_conv", [f"{p}/conv"], in_place=True),
+        InnerProductLayer(f"{p}/fc", [f"{p}/conv"], num_output=1024,
+                          weight_filler=w(), bias_filler=b()),
+        ReLULayer(f"{p}/relu_fc", [f"{p}/fc"], in_place=True),
+        DropoutLayer(f"{p}/drop_fc", [f"{p}/fc"], ratio=0.7, in_place=True),
+        InnerProductLayer(f"{p}/classifier", [f"{p}/fc"],
+                          num_output=num_classes, weight_filler=w(),
+                          bias_filler=_const(0.0)),
+        SoftmaxWithLoss(f"{p}/loss", [f"{p}/classifier", "label"],
+                        loss_weight=0.3, top=f"{p}/loss{i}"),
+        AccuracyLayer(f"{p}/top-1", [f"{p}/classifier", "label"], phase="TEST"),
+        AccuracyLayer(f"{p}/top-5", [f"{p}/classifier", "label"], top_k=5,
+                      phase="TEST"),
+    ]
+
+
 def googlenet(batch: int = 32, num_classes: int = 1000, crop: int = 224) -> Message:
     w = lambda: _filler("xavier")
     b = lambda: _const(0.2)
@@ -339,9 +369,11 @@ def googlenet(batch: int = 32, num_classes: int = 1000, crop: int = 224) -> Mess
     layers += [PoolingLayer("pool3/3x3_s2", ["inception_3b/output"],
                             Pooling.Max, kernel=(3, 3), stride=(2, 2))]
     layers += _inception("4a", "pool3/3x3_s2", 192, 96, 208, 16, 48, 64)
+    layers += _googlenet_aux_head(1, "inception_4a/output", num_classes)
     layers += _inception("4b", "inception_4a/output", 160, 112, 224, 24, 64, 64)
     layers += _inception("4c", "inception_4b/output", 128, 128, 256, 24, 64, 64)
     layers += _inception("4d", "inception_4c/output", 112, 144, 288, 32, 64, 64)
+    layers += _googlenet_aux_head(2, "inception_4d/output", num_classes)
     layers += _inception("4e", "inception_4d/output", 256, 160, 320, 32, 128, 128)
     layers += [PoolingLayer("pool4/3x3_s2", ["inception_4e/output"],
                             Pooling.Max, kernel=(3, 3), stride=(2, 2))]

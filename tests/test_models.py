@@ -91,7 +91,9 @@ def test_caffenet_matches_alexnet_size():
 
 
 def test_googlenet_stress():
-    """The multi-tower concat DAG compiles, runs, and has ~7M params."""
+    """The multi-tower concat DAG compiles, runs, and has the canonical
+    13,378,280 params (main tower ~7M + two auxiliary classifier towers,
+    ref: bvlc_googlenet/train_val.prototxt:823-953,1586-1716)."""
     B = 1
     feeds = {
         "data": jnp.zeros((B, 3, 224, 224), jnp.float32),
@@ -104,7 +106,7 @@ def test_googlenet_stress():
     assert blobs["loss3/classifier"].shape == (B, 1000)
     assert jnp.isfinite(loss)
     n = _param_count(variables)
-    assert 6_900_000 < n < 7_100_000, n
+    assert n == 13_378_280, n
 
 
 @pytest.mark.parametrize(

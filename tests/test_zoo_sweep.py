@@ -61,6 +61,100 @@ def test_reference_example_nets_shape_infer():
         assert variables.params, rel
 
 
+# ---------------------------------------------------------------------------
+# Full init of EVERY reference net — shape inference + param materialization,
+# not just graph construction (ref: Net::Init, net.cpp:40-540, is the real
+# contract: Caffe nets that "parse" but can't shape-infer are broken).
+# DB/HDF5/ImageData/WindowData-backed feeds don't declare shapes in the
+# prototxt (they come from the data source), so each such net gets the
+# runtime-shaped feed hint its source would produce, at batch 2.
+# ---------------------------------------------------------------------------
+B = 2
+_IMNET = {"data": (B, 3, 227, 227), "label": (B,)}
+_MNIST = {"data": (B, 1, 28, 28), "label": (B,)}
+_CIFAR = {"data": (B, 3, 32, 32), "label": (B,)}
+FEED_HINTS = {
+    "models/bvlc_alexnet/train_val.prototxt": _IMNET,
+    "models/bvlc_reference_caffenet/train_val.prototxt": _IMNET,
+    "models/bvlc_googlenet/train_val.prototxt": {"data": (B, 3, 224, 224),
+                                                 "label": (B,)},
+    "models/finetune_flickr_style/train_val.prototxt": _IMNET,
+    "examples/cifar10/cifar10_full.prototxt": _CIFAR,
+    "examples/cifar10/cifar10_full_java_train_test.prototxt": _CIFAR,
+    "examples/cifar10/cifar10_full_sigmoid_train_test.prototxt": _CIFAR,
+    "examples/cifar10/cifar10_full_sigmoid_train_test_bn.prototxt": _CIFAR,
+    "examples/cifar10/cifar10_full_train_test.prototxt": _CIFAR,
+    "examples/cifar10/cifar10_quick.prototxt": _CIFAR,
+    "examples/cifar10/cifar10_quick_train_test.prototxt": _CIFAR,
+    "examples/feature_extraction/imagenet_val.prototxt": _IMNET,
+    "examples/finetune_pascal_detection/pascal_finetune_trainval_test.prototxt":
+        _IMNET,
+    "examples/hdf5_classification/nonlinear_auto_test.prototxt":
+        {"data": (B, 4), "label": (B,)},
+    "examples/hdf5_classification/nonlinear_auto_train.prototxt":
+        {"data": (B, 4), "label": (B,)},
+    "examples/hdf5_classification/nonlinear_train_val.prototxt":
+        {"data": (B, 4), "label": (B,)},
+    "examples/hdf5_classification/train_val.prototxt":
+        {"data": (B, 4), "label": (B,)},
+    "examples/mnist/lenet_train_test.prototxt": _MNIST,
+    "examples/mnist/mnist_autoencoder.prototxt": {"data": (B, 1, 28, 28)},
+    "examples/siamese/mnist_siamese_train_test.prototxt":
+        {"pair_data": (B, 2, 28, 28), "sim": (B,)},
+}
+
+# the canonical published param counts (alexnet readme: ~61M; googlenet
+# readme: ~13.4M including both auxiliary towers)
+PARAM_COUNT_PINS = {
+    "models/bvlc_alexnet/train_val.prototxt": 60_965_224,
+    "models/bvlc_googlenet/train_val.prototxt": 13_378_280,
+}
+
+
+def _param_count(variables) -> int:
+    return sum(int(a.size) for plist in variables.params.values() for a in plist)
+
+
+@needs_ref
+@pytest.mark.slow
+@pytest.mark.parametrize("path", _net_files(), ids=lambda p: p.split("caffe/")[-1])
+def test_reference_prototxt_full_init(path):
+    rel = path.split("caffe/")[-1]
+    npz = parse_file(path)
+    net = Network(npz, Phase.TRAIN, batch_override=B)
+    variables = net.init(jax.random.PRNGKey(0),
+                         feed_shapes=FEED_HINTS.get(rel))
+    if rel in PARAM_COUNT_PINS:
+        assert _param_count(variables) == PARAM_COUNT_PINS[rel], rel
+
+
+@needs_ref
+@pytest.mark.slow
+def test_zoo_googlenet_matches_reference_file():
+    """The DSL GoogLeNet is the published recipe: same param count as a full
+    init of the reference train_val file (13,378,280 — INCLUDING both aux
+    towers), and the TRAIN loss is three weighted terms (0.3 + 0.3 + 1.0)."""
+    from sparknet_tpu.models import zoo
+
+    ref = Network(parse_file(f"{REF}/models/bvlc_googlenet/train_val.prototxt"),
+                  Phase.TRAIN, batch_override=B)
+    ref_vars = ref.init(jax.random.PRNGKey(0),
+                        feed_shapes={"data": (B, 3, 224, 224), "label": (B,)})
+
+    dsl = Network(zoo.googlenet(batch=B), Phase.TRAIN)
+    dsl_vars = dsl.init(jax.random.PRNGKey(0))
+
+    assert _param_count(dsl_vars) == _param_count(ref_vars) == 13_378_280
+
+    loss_terms = {
+        l.name: list(l.loss_weights()) for l in dsl.layers
+        if any(w != 0 for w in l.loss_weights())
+    }
+    assert loss_terms == {
+        "loss1/loss": [0.3], "loss2/loss": [0.3], "loss3/loss3": [1.0],
+    }
+
+
 @needs_ref
 def test_every_reference_solver_prototxt_parses():
     """All 29 solver prototxts in the reference tree produce a valid
