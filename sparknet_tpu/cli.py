@@ -56,10 +56,46 @@ def _data_fns(args, net):
     synthetic stream seeds per process."""
     import jax
 
+    pid, nproc = jax.process_index(), jax.process_count()
+
+    if args.data == "proto":
+        # the net's OWN data-layer params drive the host stream — a
+        # reference ImageData/WindowData/HDF5Data prototxt trains end to
+        # end with no surgery (ref: image_data_layer.cpp,
+        # window_data_layer.cpp, hdf5_data_layer.cpp read these sources
+        # inside the layer; here the host reader replaces the layer's
+        # prefetch thread).  Handled before any feed-shape deref: these
+        # sources define their own geometry.
+        from sparknet_tpu.data.listfile import source_from_net
+
+        try:
+            train_src = source_from_net(net, seed=1234 + pid)
+            # eval uses a SEPARATE instance with a fixed seed so every
+            # process scores the identical stream (the cifar/db paths'
+            # sum-then-normalize invariant) and eval cadence can't
+            # advance the training stream's position
+            eval_src = source_from_net(net, seed=4321)
+        except (OSError, ValueError, LookupError) as e:
+            raise SystemExit(f"--data proto: {e}") from None
+        if nproc > 1:
+            # sequential (unshuffled) sources would otherwise stream the
+            # SAME lines on every process; interleave batches by process
+            # id like the shared-db path (every host decodes everything —
+            # correct, if not maximally efficient)
+            inner, state = train_src, {"started": False}
+
+            def train_src(it):  # noqa: F811 — deliberate shadowing wrapper
+                skip = pid if not state["started"] else nproc - 1
+                state["started"] = True
+                for _ in range(skip):
+                    inner(it)
+                return inner(it)
+
+        return train_src, eval_src
+
     shapes = _feed_shapes(net)
     data_shape = shapes["data"]
     batch = data_shape[0]
-    pid, nproc = jax.process_index(), jax.process_count()
 
     if args.data.startswith("cifar:"):
         from sparknet_tpu.data import CifarLoader, DataTransformer, TransformConfig
@@ -265,6 +301,18 @@ def cmd_train(args) -> int:
         print(json.dumps({"finetune_from": args.weights, "layers_loaded": loaded}))
     log = EventLogger(".", prefix="tpunet_train")
     train_fn, test_fn = _data_fns(args, solver.train_net)
+    if args.data == "proto":
+        # the TEST net's data layer names its own source file + phase; a
+        # train-only prototxt (no TEST-phase listfile layer) keeps the
+        # train stream for any eval
+        from sparknet_tpu.data.listfile import source_from_net
+
+        try:
+            test_fn = source_from_net(solver.test_net, seed=4321)
+        except LookupError:
+            pass
+        except (OSError, ValueError) as e:
+            raise SystemExit(f"--data proto (test net): {e}") from None
 
     import contextlib
 
@@ -1024,7 +1072,10 @@ def main(argv=None) -> int:
 
     def common(sp):
         sp.add_argument("--solver", help="solver prototxt path or zoo:<name>")
-        sp.add_argument("--data", default="synthetic", help="cifar:<dir> | db:<path>[,<test_path>] | synthetic")
+        sp.add_argument("--data", default="synthetic",
+                        help="cifar:<dir> | db:<path>[,<test_path>] | proto "
+                        "(stream from the net's own ImageData/WindowData/"
+                        "HDF5Data layers) | synthetic")
         sp.add_argument("--data-scale", type=float, default=0.0,
                         help="multiply db feeds by this (transform_param."
                         "scale parity, e.g. 0.00390625 for lenet)")

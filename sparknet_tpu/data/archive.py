@@ -51,29 +51,56 @@ def list_archive_samples(tar_path: str, labels: dict[str, int]) -> Iterator[tupl
 
 
 class ImageNetLoader:
-    """Walks a directory of tar shards, one worker's slice at a time.
+    """Walks a directory — or object-store prefix — of tar shards, one
+    worker's slice at a time.
 
     ``shard(worker, num_workers)`` yields this worker's (bytes, label)
     stream — the analog of the reference's ``RDD[(Array[Byte], Int)]``
-    partition (ref: ImageNetLoader.scala:91-96).
+    partition (ref: ImageNetLoader.scala:91-96).  A ``gs://`` / ``s3://``
+    root restores the reference's remote walk (S3 listObjects,
+    ImageNetLoader.scala:25-39): shards are listed through
+    ``data.remote.get_store`` and fetched lazily into ``cache_dir``
+    before each worker explodes its slice.
     """
 
-    def __init__(self, root: str, label_file: str):
+    def __init__(self, root: str, label_file: str,
+                 cache_dir: str | None = None):
         self.root = root
+        self.cache_dir = cache_dir
+        if "://" in root and not root.startswith("file://"):
+            if cache_dir is None:
+                raise ValueError("remote shard roots need a cache_dir")
+            from sparknet_tpu.data.remote import get_store
+
+            self._store = get_store(root)
+        else:
+            self._store = None
+            root = root.removeprefix("file://")
+            self.root = root
         self.labels = load_label_map(label_file)
+        names = (
+            self._store.list_prefix(self.root)
+            if self._store is not None
+            else (
+                os.path.join(root, f) for f in os.listdir(root)
+            )
+        )
         self.archives = sorted(
-            os.path.join(root, f)
-            for f in os.listdir(root)
-            if f.endswith((".tar", ".tar.gz", ".tgz"))
+            f for f in names if f.endswith((".tar", ".tar.gz", ".tgz"))
         )
         if not self.archives:
             raise FileNotFoundError(f"no tar shards under {root!r}")
+
+    def _materialize(self, path: str) -> str:
+        if self._store is None:
+            return path
+        return self._store.fetch(path, self.cache_dir)
 
     def shard(self, worker: int, num_workers: int) -> Iterator[tuple[bytes, int]]:
         for i, tar_path in enumerate(self.archives):
             if i % num_workers != worker:
                 continue
-            yield from list_archive_samples(tar_path, self.labels)
+            yield from list_archive_samples(self._materialize(tar_path), self.labels)
 
     def __len__(self) -> int:
         return len(self.archives)

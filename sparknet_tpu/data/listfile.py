@@ -1,0 +1,370 @@
+"""Host-side readers for the listfile-driven reference data layers.
+
+These give `ImageData`, `WindowData`, and `HDF5Data` prototxts a real
+feed path (the layers themselves stay feed-declaration shells in-graph —
+the TPU-first inversion of Caffe's in-layer prefetch threads: the host
+produces numpy batches, `tpunet train --data proto` / `DevicePrefetcher`
+push them to the device).
+
+- ImageData (ref: caffe/src/caffe/layers/image_data_layer.cpp:1-167):
+  "<path> <label>" lines; optional force-resize to new_height/new_width;
+  optional seeded shuffle, reshuffled every epoch; loops forever;
+  TransformationParameter crop/mirror/mean/scale per batch.
+- WindowData (ref: caffe/src/caffe/layers/window_data_layer.cpp:1-470):
+  the R-CNN window file (``# idx / path / c h w / n / label overlap x1 y1
+  x2 y2``); windows split into foreground (overlap >= fg_threshold,
+  label > 0) and background (overlap < bg_threshold, label forced 0)
+  pools; each batch draws ``batch*fg_fraction`` fg + rest bg (bg first,
+  like the reference's is_fg 0/1 loop), crops each window with
+  context_pad / "square" geometry, warps to crop_size, random-mirrors,
+  and applies mean_value/mean_file + scale.
+- HDF5Data (ref: caffe/src/caffe/layers/hdf5_data_layer.cpp): source is
+  a listfile of .h5 paths; rows stream in file order and loop.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from sparknet_tpu.proto import Message
+
+
+def _read_image(path: str, color: bool, new_h: int = 0, new_w: int = 0) -> np.ndarray:
+    """uint8 CHW; force-resized (no aspect keep) when new_h/new_w set —
+    cv::imread + cv::resize parity (image_data_layer.cpp ReadImageToCVMat)."""
+    from PIL import Image
+
+    img = Image.open(path)
+    img = img.convert("RGB" if color else "L")
+    if new_h and new_w:
+        # BILINEAR matches cv::resize's default INTER_LINEAR
+        img = img.resize((new_w, new_h), Image.BILINEAR)
+    arr = np.asarray(img, np.uint8)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return arr.transpose(2, 0, 1)
+
+
+def _transformer(lp: Message, seed: int | None):
+    """DataTransformer from a layer's transform_param."""
+    from sparknet_tpu.data.transform import DataTransformer, TransformConfig, load_mean_file
+
+    tp = lp.get_msg("transform_param")
+    mean_image = None
+    mean_file = tp.get_str("mean_file", "")
+    if mean_file:
+        mean_image = load_mean_file(mean_file)
+    return DataTransformer(TransformConfig(
+        scale=tp.get_float("scale", 1.0),
+        mirror=tp.get_bool("mirror", False),
+        crop_size=tp.get_int("crop_size", 0),
+        mean_value=tuple(float(v) for v in tp.get_all("mean_value")),
+        mean_image=mean_image,
+        seed=seed,
+    ))
+
+
+class ImageDataSource:
+    """Infinite minibatch stream for one ImageData layer."""
+
+    def __init__(self, layer_param: Message, *, train: bool, seed: int = 0):
+        self.lp = layer_param
+        p = layer_param.get_msg("image_data_param")
+        self.batch = p.get_int("batch_size", 0)
+        if self.batch <= 0:
+            raise ValueError("image_data_param.batch_size must be set")
+        self.new_h = p.get_int("new_height", 0)
+        self.new_w = p.get_int("new_width", 0)
+        if bool(self.new_h) != bool(self.new_w):
+            # the reference CHECKs both-or-neither (image_data_layer.cpp:31)
+            raise ValueError("new_height and new_width must be set together")
+        self.color = p.get_bool("is_color", True)
+        self.root = p.get_str("root_folder", "")
+        self.shuffle = p.get_bool("shuffle", False)
+        self.train = train
+        self.tops = list(layer_param.get_all("top"))
+        self._rs = np.random.RandomState(seed)
+        source = p.get_str("source", "")
+        self.lines: list[tuple[str, int]] = []
+        with open(source) as f:
+            for lineno, line in enumerate(f, 1):
+                parts = line.split()
+                if not parts:
+                    continue
+                if len(parts) < 2:
+                    raise ValueError(
+                        f"{source}:{lineno}: expected '<path> <label>', "
+                        f"got {line.strip()!r}"
+                    )
+                self.lines.append((parts[0], int(parts[1])))
+        if not self.lines:
+            raise ValueError(f"{source}: empty image list")
+        if self.shuffle:
+            self._rs.shuffle(self.lines)
+        skip = p.get_int("rand_skip", 0)
+        self._pos = int(self._rs.randint(0, skip)) if skip > 1 else 0
+        self.xform = _transformer(layer_param, seed)
+
+    def __call__(self, _it: int) -> dict[str, np.ndarray]:
+        imgs, labels = [], []
+        while len(imgs) < self.batch:
+            if self._pos >= len(self.lines):
+                self._pos = 0
+                if self.shuffle:  # reshuffle each epoch (image_data_layer.cpp:143)
+                    self._rs.shuffle(self.lines)
+            rel, label = self.lines[self._pos]
+            self._pos += 1
+            imgs.append(_read_image(os.path.join(self.root, rel), self.color,
+                                    self.new_h, self.new_w))
+            labels.append(label)
+        data = self.xform(np.stack(imgs), self.train)
+        return {self.tops[0]: data,
+                self.tops[1]: np.asarray(labels, np.int32)}
+
+
+class WindowDataSource:
+    """Infinite fg/bg-sampled window stream for one WindowData layer."""
+
+    def __init__(self, layer_param: Message, *, train: bool, seed: int = 0):
+        self.lp = layer_param
+        p = layer_param.get_msg("window_data_param")
+        self.batch = p.get_int("batch_size", 0)
+        if self.batch <= 0:
+            raise ValueError("window_data_param.batch_size must be set")
+        self.fg_threshold = p.get_float("fg_threshold", 0.5)
+        self.bg_threshold = p.get_float("bg_threshold", 0.5)
+        self.fg_fraction = p.get_float("fg_fraction", 0.25)
+        self.context_pad = p.get_int("context_pad", 0)
+        self.crop_mode = p.get_str("crop_mode", "warp")
+        self.root = p.get_str("root_folder", "")
+        tp = layer_param.get_msg("transform_param")
+        self.crop_size = tp.get_int("crop_size", 0)
+        if self.crop_size <= 0:
+            raise ValueError("WindowData needs transform_param.crop_size")
+        self.scale = tp.get_float("scale", 1.0)
+        self.mirror = tp.get_bool("mirror", False)
+        self.mean_values = tuple(float(v) for v in tp.get_all("mean_value"))
+        self.mean_image = None
+        if tp.get_str("mean_file", ""):
+            from sparknet_tpu.data.transform import load_mean_file
+
+            self.mean_image = load_mean_file(tp.get_str("mean_file"))
+        self.train = train
+        self.tops = list(layer_param.get_all("top"))
+        self._rs = np.random.RandomState(seed)
+
+        # parse the window file into image table + fg/bg pools
+        self.images: list[str] = []
+        self.fg: list[tuple[int, int, int, int, int, int]] = []  # (img, label, x1,y1,x2,y2)
+        self.bg: list[tuple[int, int, int, int, int, int]] = []
+        source = p.get_str("source", "")
+        with open(source) as f:
+            tokens = f.read().split()
+        i = 0
+        while i < len(tokens):
+            if tokens[i] != "#":
+                raise ValueError(f"{source}: expected '#', got {tokens[i]!r}")
+            i += 2  # "#", image_index
+            path = tokens[i]; i += 1
+            i += 3  # channels, height, width (decode re-derives them)
+            num_windows = int(tokens[i]); i += 1
+            img_idx = len(self.images)
+            self.images.append(os.path.join(self.root, path))
+            for _ in range(num_windows):
+                label = int(tokens[i]); overlap = float(tokens[i + 1])
+                x1, y1, x2, y2 = (int(t) for t in tokens[i + 2 : i + 6])
+                i += 6
+                if overlap >= self.fg_threshold:
+                    if label <= 0:
+                        raise ValueError(f"{source}: fg window with label {label}")
+                    self.fg.append((img_idx, label, x1, y1, x2, y2))
+                elif overlap < self.bg_threshold:
+                    self.bg.append((img_idx, 0, x1, y1, x2, y2))
+                # windows between the thresholds are dropped, as in the ref
+        if not self.fg or not self.bg:
+            raise ValueError(f"{source}: need at least one fg and one bg window")
+        self._cache: dict[int, np.ndarray] = {}
+
+    # -- window geometry ------------------------------------------------
+    def _warp(self, img: np.ndarray, x1: int, y1: int, x2: int, y2: int,
+              do_mirror: bool) -> np.ndarray:
+        """Crop + context-pad + warp one window to (C, crop, crop), float32
+        with mean/scale applied — window_data_layer.cpp:297-420."""
+        from PIL import Image
+
+        c, ih, iw = img.shape
+        cs = self.crop_size
+        out = np.zeros((c, cs, cs), np.float32)
+        pad_x1 = pad_y1 = pad_x2 = pad_y2 = 0
+        crop_w = crop_h = cs
+        if self.context_pad > 0 or self.crop_mode == "square":
+            context_scale = cs / (cs - 2.0 * self.context_pad)
+            half_h = (y2 - y1 + 1) / 2.0
+            half_w = (x2 - x1 + 1) / 2.0
+            cx, cy = x1 + half_w, y1 + half_h
+            if self.crop_mode == "square":
+                half_h = half_w = max(half_h, half_w)
+            x1 = int(round(cx - half_w * context_scale))
+            x2 = int(round(cx + half_w * context_scale))
+            y1 = int(round(cy - half_h * context_scale))
+            y2 = int(round(cy + half_h * context_scale))
+            unclipped_h, unclipped_w = y2 - y1 + 1, x2 - x1 + 1
+            pad_x1, pad_y1 = max(0, -x1), max(0, -y1)
+            pad_x2, pad_y2 = max(0, x2 - iw + 1), max(0, y2 - ih + 1)
+            x1, x2 = x1 + pad_x1, x2 - pad_x2
+            y1, y2 = y1 + pad_y1, y2 - pad_y2
+            scale_x = cs / unclipped_w
+            scale_y = cs / unclipped_h
+            crop_w = int(round((x2 - x1 + 1) * scale_x))
+            crop_h = int(round((y2 - y1 + 1) * scale_y))
+            pad_x1 = int(round(pad_x1 * scale_x))
+            pad_x2 = int(round(pad_x2 * scale_x))
+            pad_y1 = int(round(pad_y1 * scale_y))
+            pad_y2 = int(round(pad_y2 * scale_y))
+
+        pad_h = pad_y1
+        pad_w = pad_x2 if do_mirror else pad_x1
+        crop_h = min(crop_h, cs - pad_h)
+        crop_w = min(crop_w, cs - pad_w)
+
+        # plain-warp windows are taken as given by the window file, but a
+        # stray out-of-range coordinate must clamp, not wrap through
+        # Python's negative indexing (the reference's cv::Mat ROI would
+        # abort; silent wraparound would train on garbage)
+        x1, y1 = max(0, x1), max(0, y1)
+        x2, y2 = min(iw - 1, x2), min(ih - 1, y2)
+        patch = img[:, y1 : y2 + 1, x1 : x2 + 1]
+        pil = Image.fromarray(patch.transpose(1, 2, 0).squeeze()
+                              if c == 1 else patch.transpose(1, 2, 0))
+        pil = pil.resize((max(crop_w, 1), max(crop_h, 1)), Image.BILINEAR)
+        warped = np.asarray(pil, np.float32)
+        if warped.ndim == 2:
+            warped = warped[:, :, None]
+        warped = warped.transpose(2, 0, 1)
+        if do_mirror:
+            warped = warped[:, :, ::-1]
+
+        # mean subtraction: full mean image indexes at the center offset
+        # shifted by the padding (window_data_layer.cpp:404-411)
+        if self.mean_image is not None:
+            mh, mw = self.mean_image.shape[1:]
+            off = (mw - cs) // 2
+            m = self.mean_image[:, off + pad_h : off + pad_h + warped.shape[1],
+                                off + pad_w : off + pad_w + warped.shape[2]]
+            warped = warped - m
+        elif self.mean_values:
+            warped = warped - np.asarray(self.mean_values, np.float32).reshape(-1, 1, 1)
+        out[:, pad_h : pad_h + warped.shape[1], pad_w : pad_w + warped.shape[2]] = warped
+        return out * self.scale
+
+    def _image(self, idx: int) -> np.ndarray:
+        if idx not in self._cache:
+            if len(self._cache) > 256:  # bound host memory
+                self._cache.clear()
+            self._cache[idx] = _read_image(self.images[idx], color=True)
+        return self._cache[idx]
+
+    def __call__(self, _it: int) -> dict[str, np.ndarray]:
+        num_fg = int(self.batch * self.fg_fraction)
+        data = np.zeros((self.batch, 3, self.crop_size, self.crop_size), np.float32)
+        labels = np.zeros(self.batch, np.int32)
+        item = 0
+        for is_fg, count in ((0, self.batch - num_fg), (1, num_fg)):
+            pool = self.fg if is_fg else self.bg
+            for _ in range(count):
+                img_idx, label, x1, y1, x2, y2 = pool[self._rs.randint(len(pool))]
+                do_mirror = bool(self.mirror and self._rs.randint(2) and self.train)
+                data[item] = self._warp(self._image(img_idx), x1, y1, x2, y2, do_mirror)
+                labels[item] = label
+                item += 1
+        return {self.tops[0]: data, self.tops[1]: labels}
+
+
+class Hdf5DataSource:
+    """Row stream over the .h5 files named by an HDF5Data source listfile.
+
+    One file resident at a time, like the reference's per-file advance
+    (hdf5_data_layer.cpp LoadHDF5FileData / Next); ``shuffle`` permutes
+    the file order each epoch and the rows within each file, seeded."""
+
+    def __init__(self, layer_param: Message, *, train: bool, seed: int = 0):
+        p = layer_param.get_msg("hdf5_data_param")
+        self.batch = p.get_int("batch_size", 0)
+        if self.batch <= 0:
+            raise ValueError("hdf5_data_param.batch_size must be set")
+        self.tops = list(layer_param.get_all("top"))
+        self.shuffle = p.get_bool("shuffle", False)
+        source = p.get_str("source", "")
+        with open(source) as f:
+            self.paths = [ln.strip() for ln in f if ln.strip()]
+        if not self.paths:
+            raise ValueError(f"{source}: empty HDF5 list")
+        self._rs = np.random.RandomState(seed)
+        self._file_order = list(range(len(self.paths)))
+        self._file_idx = 0
+        self._current: dict[str, np.ndarray] | None = None
+        self._row = 0
+        if self.shuffle:
+            self._rs.shuffle(self._file_order)
+
+    def _load_next_file(self) -> None:
+        from sparknet_tpu.data.hdf5 import read_hdf5_file
+
+        if self._file_idx >= len(self.paths):
+            self._file_idx = 0
+            if self.shuffle:  # reshuffle file order each epoch
+                self._rs.shuffle(self._file_order)
+        path = self.paths[self._file_order[self._file_idx]]
+        self._file_idx += 1
+        self._current = read_hdf5_file(path, tuple(self.tops))
+        n = len(next(iter(self._current.values())))
+        if self.shuffle:
+            perm = self._rs.permutation(n)
+            self._current = {t: v[perm] for t, v in self._current.items()}
+        self._row = 0
+
+    def __call__(self, _it: int) -> dict[str, np.ndarray]:
+        chunks: dict[str, list[np.ndarray]] = {t: [] for t in self.tops}
+        need = self.batch
+        while need > 0:
+            if self._current is None or self._row >= len(
+                next(iter(self._current.values()))
+            ):
+                self._load_next_file()
+            take = min(need, len(next(iter(self._current.values()))) - self._row)
+            for t in self.tops:
+                chunks[t].append(self._current[t][self._row : self._row + take])
+            self._row += take
+            need -= take
+        out = {}
+        for t in self.tops:
+            v = np.concatenate(chunks[t]) if len(chunks[t]) > 1 else chunks[t][0]
+            out[t] = v.astype(np.int32) if t == "label" else v.astype(np.float32)
+        return out
+
+
+_SOURCES = {
+    "ImageData": ImageDataSource,
+    "WindowData": WindowDataSource,
+    "HDF5Data": Hdf5DataSource,
+}
+
+
+def source_from_net(net, *, seed: int = 0):
+    """Build the host stream for the first listfile-driven data layer in a
+    compiled Network (its phase decides train-time augmentation)."""
+    from sparknet_tpu.common import Phase
+
+    for layer in net.input_layers:
+        cls = _SOURCES.get(layer.type)
+        if cls is not None:
+            return cls(layer.lp, train=net.phase == Phase.TRAIN, seed=seed)
+    # LookupError (not ValueError): "this net has no such layer" is a
+    # recoverable capability probe — callers fall back (e.g. a train-only
+    # prototxt's TEST view) — while bad layer params stay fatal
+    raise LookupError(
+        "net has no ImageData/WindowData/HDF5Data layer in this phase "
+        f"(input layers: {[l.type for l in net.input_layers]})"
+    )

@@ -1,0 +1,142 @@
+"""Scheme-abstracted object-store access for remote shard ingest.
+
+The reference's ``ImageNetLoader`` walks an S3 bucket and streams tar
+shards to executors (ref: src/main/scala/loaders/ImageNetLoader.scala:
+25-86 — AmazonS3Client listObjects + getObject).  The TPU-native
+equivalent is a tiny store interface keyed by URL scheme:
+
+- ``file://`` (and bare paths): the local filesystem — also the test
+  fake for the remote schemes (a directory stands in for a bucket).
+- ``gs://`` / ``s3://``: shell out to the cloud CLI (``gsutil`` /
+  ``aws s3``).  On a TPU pod these are ambient (the ec2/pull.py role);
+  in a zero-egress sandbox the commands are absent and the store raises
+  a clear error at first use — never at import.
+
+``register_store(scheme, factory)`` lets tests (or deployments with
+native client libraries) swap in their own implementation; everything
+downstream — ``ImageNetLoader``, ``tpunet pull_shards`` — only sees
+``list_prefix`` / ``fetch``.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import Callable, Protocol
+
+
+class ObjectStore(Protocol):
+    def list_prefix(self, url: str) -> list[str]:
+        """All object URLs under a prefix, sorted."""
+        ...
+
+    def fetch(self, url: str, dest_dir: str) -> str:
+        """Download one object into dest_dir; returns the local path.
+        Already-present files are reused (pull.py's idempotent pull)."""
+        ...
+
+
+def _split(url: str) -> tuple[str, str]:
+    scheme, _, rest = url.partition("://")
+    return (scheme, rest) if "://" in url else ("file", url)
+
+
+class LocalStore:
+    """file:// — and the on-disk fake for remote schemes in tests."""
+
+    def list_prefix(self, url: str) -> list[str]:
+        _, path = _split(url)
+        if os.path.isdir(path):
+            return sorted(
+                os.path.join(path, f) for f in os.listdir(path)
+                if os.path.isfile(os.path.join(path, f))
+            )
+        d, prefix = os.path.split(path)
+        if not os.path.isdir(d):
+            return []
+        return sorted(
+            os.path.join(d, f) for f in os.listdir(d)
+            if f.startswith(prefix) and os.path.isfile(os.path.join(d, f))
+        )
+
+    def fetch(self, url: str, dest_dir: str) -> str:
+        _, path = _split(url)
+        dest = os.path.join(dest_dir, os.path.basename(path))
+        if os.path.abspath(dest) == os.path.abspath(path):
+            return path
+        if not (os.path.exists(dest) and
+                os.path.getsize(dest) == os.path.getsize(path)):
+            os.makedirs(dest_dir, exist_ok=True)
+            shutil.copy(path, dest)
+        return dest
+
+
+class CliStore:
+    """gs:// via gsutil, s3:// via the aws CLI — subprocess-based, like
+    the pod bootstrap scripts; fails loudly if the CLI is absent."""
+
+    def __init__(self, scheme: str):
+        self.scheme = scheme
+        self._argv = {
+            "gs": (["gsutil", "ls"], ["gsutil", "cp"]),
+            "s3": (["aws", "s3", "ls"], ["aws", "s3", "cp"]),
+        }[scheme]
+
+    def _run(self, argv: list[str]) -> str:
+        if shutil.which(argv[0]) is None:
+            raise RuntimeError(
+                f"{argv[0]} not found: {self.scheme}:// access needs the "
+                "cloud CLI (available on TPU pods; absent in zero-egress "
+                "sandboxes — use a file:// path or register_store a client)"
+            )
+        out = subprocess.run(argv, capture_output=True, text=True)
+        if out.returncode != 0:
+            raise RuntimeError(f"{' '.join(argv)} failed: {out.stderr.strip()}")
+        return out.stdout
+
+    def list_prefix(self, url: str) -> list[str]:
+        ls, _ = self._argv
+        lines = self._run(ls + [url]).splitlines()
+        if self.scheme == "s3":
+            # `aws s3 ls` prints "date time size key" relative to the prefix
+            base = url if url.endswith("/") else url.rsplit("/", 1)[0] + "/"
+            return sorted(
+                base + ln.split()[-1] for ln in lines if ln.split()
+            )
+        return sorted(ln.strip() for ln in lines if ln.strip())
+
+    def fetch(self, url: str, dest_dir: str) -> str:
+        dest = os.path.join(dest_dir, os.path.basename(url))
+        if not os.path.exists(dest):
+            # download to a temp name + atomic rename: a cp killed
+            # mid-transfer must not leave a truncated file that every
+            # later run mistakes for a valid cached copy
+            os.makedirs(dest_dir, exist_ok=True)
+            tmp = dest + ".part"
+            _, cp = self._argv
+            self._run(cp + [url, tmp])
+            os.replace(tmp, dest)
+        return dest
+
+
+_REGISTRY: dict[str, Callable[[], ObjectStore]] = {
+    "file": LocalStore,
+    "gs": lambda: CliStore("gs"),
+    "s3": lambda: CliStore("s3"),
+}
+
+
+def register_store(scheme: str, factory: Callable[[], ObjectStore]) -> None:
+    _REGISTRY[scheme] = factory
+
+
+def get_store(url: str) -> ObjectStore:
+    scheme, _ = _split(url)
+    try:
+        return _REGISTRY[scheme]()
+    except KeyError:
+        raise ValueError(
+            f"no object store registered for scheme {scheme!r} "
+            f"(known: {sorted(_REGISTRY)})"
+        ) from None

@@ -144,32 +144,95 @@ class DummyData(InputLayer):
 
 @register
 class ImageData(InputLayer):
-    """File-list image source (ref: image_data_layer.cpp) — feed-backed."""
+    """File-list image source (ref: image_data_layer.cpp) — feed-backed;
+    the host stream is ``data.listfile.ImageDataSource``."""
 
     TYPE = "ImageData"
 
     def batch_size(self) -> int:
         return self.lp.get_msg("image_data_param").get_int("batch_size", 0)
 
+    def blob_shapes(self, batch_override=None):
+        """Declared when the prototxt pins the geometry (crop_size or
+        new_height/new_width); otherwise None — the reference derives it
+        by decoding the first listed image (image_data_layer.cpp:65-77),
+        which a pure graph build must not require."""
+        p = self.lp.get_msg("image_data_param")
+        n = batch_override or p.get_int("batch_size", 0)
+        c = 3 if p.get_bool("is_color", True) else 1
+        crop = self.lp.get_msg("transform_param").get_int("crop_size", 0)
+        h, w = (crop, crop) if crop else (p.get_int("new_height", 0),
+                                          p.get_int("new_width", 0))
+        if not (h and w):
+            # last resort, like the reference: decode the first listed
+            # image for its size (best-effort — a pure graph build may
+            # not have the listfile on disk)
+            try:
+                source = p.get_str("source", "")
+                root = p.get_str("root_folder", "")
+                import os
+
+                with open(source) as f:
+                    first = f.readline().split()[0]
+                from PIL import Image
+
+                with Image.open(os.path.join(root, first)) as img:
+                    w, h = img.size
+            except Exception:
+                return None
+        if not (n and h and w):
+            return None
+        return [(n, c, h, w), (n,)]
+
 
 @register
 class HDF5Data(InputLayer):
-    """ref: hdf5_data_layer.cpp — feed-backed."""
+    """ref: hdf5_data_layer.cpp — feed-backed; the host stream is
+    ``data.listfile.Hdf5DataSource``."""
 
     TYPE = "HDF5Data"
 
     def batch_size(self) -> int:
         return self.lp.get_msg("hdf5_data_param").get_int("batch_size", 0)
 
+    def blob_shapes(self, batch_override=None):
+        """Row shapes peeked from the first listed .h5 file — exactly the
+        reference's LayerSetUp (hdf5_data_layer.cpp LoadHDF5FileData on
+        file 0); best-effort None when the source isn't on disk."""
+        n = batch_override or self.batch_size()
+        if not n:
+            return None
+        try:
+            import h5py
+
+            source = self.lp.get_msg("hdf5_data_param").get_str("source", "")
+            with open(source) as f:
+                first = next(ln.strip() for ln in f if ln.strip())
+            with h5py.File(first, "r") as h5:
+                return [(n,) + tuple(int(d) for d in h5[t].shape[1:])
+                        for t in self.tops]
+        except Exception:
+            return None
+
 
 @register
 class WindowData(InputLayer):
-    """ref: window_data_layer.cpp — feed-backed."""
+    """ref: window_data_layer.cpp — feed-backed; the host stream is
+    ``data.listfile.WindowDataSource``."""
 
     TYPE = "WindowData"
 
     def batch_size(self) -> int:
         return self.lp.get_msg("window_data_param").get_int("batch_size", 0)
+
+    def blob_shapes(self, batch_override=None):
+        """(batch, 3, crop, crop) — WindowData always warps to
+        transform_param.crop_size (window_data_layer.cpp:171-177)."""
+        n = batch_override or self.batch_size()
+        crop = self.lp.get_msg("transform_param").get_int("crop_size", 0)
+        if not (n and crop):
+            return None
+        return [(n, 3, crop, crop), (n,)]
 
 
 @register

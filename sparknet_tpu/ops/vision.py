@@ -199,11 +199,56 @@ def caffe_max_pool(x, kernel, stride, pad):
     )
 
 
+def _pool_patches(x, kernel, stride):
+    """(N, C, kh*kw, oh, ow) window patches with Caffe ceil-mode output
+    dims; edge-overhanging windows are zero-filled (zeros carry no
+    activation mass, matching the reference's hstart/hend clipping)."""
+    h, w = x.shape[2], x.shape[3]
+    kh, kw = kernel
+    sh, sw = stride
+    oh = pool_out_dim(h, kh, 0, sh)
+    ow = pool_out_dim(w, kw, 0, sw)
+    extra_h = max(0, (oh - 1) * sh + kh - h)
+    extra_w = max(0, (ow - 1) * sw + kw - w)
+    xp = jnp.pad(x, ((0, 0), (0, 0), (0, extra_h), (0, extra_w)))
+    patches = jax.lax.conv_general_dilated_patches(
+        xp, (kh, kw), (sh, sw), padding="VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    return patches.reshape(x.shape[0], x.shape[1], kh * kw, oh, ow)
+
+
+def caffe_stochastic_pool(x, kernel, stride, *, train, rng=None):
+    """Stochastic pooling (ref: pooling_layer.cu:83-160 StoPoolForwardTrain/
+    Test; Zeiler & Fergus 2013).  Train: sample one activation per window
+    with probability proportional to its value (threshold r*sum against the
+    running cumsum); gradients flow to the sampled element only, like the
+    reference's StoPoolBackward index routing (pooling_layer.cu:300-330).
+    Test: the activation-weighted average sum(a^2)/sum(a), zero windows -> 0.
+    Assumes non-negative activations (post-ReLU), as the reference does.
+
+    TPU-first: one patch extraction + vectorized cumsum/argmax over the
+    window axis — no scalar loops, fuses under jit."""
+    patches = _pool_patches(x, kernel, stride)
+    total = patches.sum(axis=2)
+    if train:
+        assert rng is not None, "stochastic pooling needs an rng in train mode"
+        thres = jax.random.uniform(rng, total.shape, patches.dtype) * total
+        csum = jnp.cumsum(patches, axis=2)
+        # first window position whose running sum crosses the threshold
+        idx = jnp.argmax(csum >= thres[:, :, None], axis=2)
+        y = jnp.take_along_axis(patches, idx[:, :, None], axis=2)[:, :, 0]
+    else:
+        sq = (patches * patches).sum(axis=2)
+        y = jnp.where(total > 0, sq / jnp.where(total > 0, total, 1), 0)
+    return y.astype(x.dtype)
+
+
 @register
 class Pooling(Layer):
-    """MAX / AVE pooling with Caffe ceil-mode shapes; ``global_pooling``
-    collapses the spatial dims (ref: caffe/src/caffe/layers/pooling_layer.cpp).
-    STOCHASTIC pooling falls back to MAX (ref trains the zoo nets without it).
+    """MAX / AVE / STOCHASTIC pooling with Caffe ceil-mode shapes;
+    ``global_pooling`` collapses the spatial dims
+    (ref: caffe/src/caffe/layers/pooling_layer.cpp, pooling_layer.cu).
     """
 
     TYPE = "Pooling"
@@ -224,8 +269,18 @@ class Pooling(Layer):
         method, kernel, stride, pad = self._conf(x.shape)
         if method == "AVE":
             y = caffe_avg_pool(x, kernel, stride, pad)
-        else:  # MAX (and STOCHASTIC fallback)
+        elif method == "STOCHASTIC":
+            if pad != (0, 0):
+                # the reference CHECKs this in LayerSetUp: padding is
+                # implemented only for AVE and MAX (pooling_layer.cpp)
+                raise ValueError(
+                    f"{self.name}: STOCHASTIC pooling does not support pad"
+                )
+            y = caffe_stochastic_pool(x, kernel, stride, train=train, rng=rng)
+        elif method == "MAX":
             y = caffe_max_pool(x, kernel, stride, pad)
+        else:
+            raise ValueError(f"{self.name}: unknown pool method {method!r}")
         return LayerOutput([y])
 
 

@@ -70,6 +70,7 @@ def x44(rng):
     return jnp.asarray(rng.randn(2, 3, 4, 4), jnp.float32)
 
 
+@pytest.mark.smoke
 def test_convolution_grad(rng, x44):
     layer = make_layer(
         'layer { name: "c" type: "Convolution" bottom: "x" top: "y" '
@@ -122,6 +123,79 @@ def test_pooling_ave_grad(rng):
         "pooling_param { pool: AVE kernel_size: 3 stride: 2 pad: 1 } }"
     )
     check_layer_grad(layer, [x])
+
+
+def test_pooling_stochastic_test_mode_grad(rng):
+    """TEST-mode stochastic pooling (sum(a^2)/sum(a)) is smooth where the
+    window sum is bounded away from 0 — FD-checkable like AVE."""
+    x = jnp.asarray(np.abs(rng.randn(2, 2, 5, 5)) + 0.5, jnp.float32)
+    layer = make_layer(
+        'layer { name: "p" type: "Pooling" bottom: "x" top: "y" '
+        "pooling_param { pool: STOCHASTIC kernel_size: 3 stride: 2 } }",
+        phase=Phase.TEST,
+    )
+
+    def scalar_out(inp):
+        out = layer.apply([], {}, [inp], train=False)
+        w = np.cos(np.arange(out.outputs[0].size)).reshape(out.outputs[0].shape)
+        return jnp.sum(out.outputs[0] * jnp.asarray(w, jnp.float32))
+
+    g_auto = np.asarray(jax.grad(scalar_out)(x))
+    g_num = num_grad(scalar_out, x)
+    np.testing.assert_allclose(g_auto, g_num, atol=5e-2, rtol=5e-2)
+
+
+def test_pooling_stochastic_train_grad_routes_to_sampled_element(rng):
+    """TRAIN-mode autodiff must scatter the gradient to exactly the sampled
+    window element — the reference's StoPoolBackward index routing
+    (pooling_layer.cu:300-330).  FD is meaningless across a sampling kink,
+    so the check is structural: d(sum y)/dx is one 1.0 per window, placed
+    where the forward's sampled value came from."""
+    x = jnp.asarray(np.abs(rng.randn(1, 1, 4, 4)) + 0.1, jnp.float32)
+    layer = make_layer(
+        'layer { name: "p" type: "Pooling" bottom: "x" top: "y" '
+        "pooling_param { pool: STOCHASTIC kernel_size: 2 stride: 2 } }"
+    )
+    key = jax.random.key(3)
+    y = layer.apply([], {}, [x], train=True, rng=key).outputs[0]
+    g = jax.grad(
+        lambda inp: jnp.sum(layer.apply([], {}, [inp], train=True, rng=key).outputs[0])
+    )(x)
+    g = np.asarray(g)
+    xn, yn = np.asarray(x), np.asarray(y)
+    # one selected element per 2x2 window, gradient 1 there, 0 elsewhere
+    assert np.all(np.sort(np.unique(g)) == np.asarray([0.0, 1.0]))
+    for oh in range(2):
+        for ow in range(2):
+            win_g = g[0, 0, 2 * oh : 2 * oh + 2, 2 * ow : 2 * ow + 2]
+            win_x = xn[0, 0, 2 * oh : 2 * oh + 2, 2 * ow : 2 * ow + 2]
+            assert win_g.sum() == 1.0
+            # and the forwarded value is the selected activation
+            assert np.isclose(yn[0, 0, oh, ow], win_x[win_g == 1.0][0])
+
+
+def test_pooling_stochastic_samples_by_activation_mass():
+    """Over many rng draws, each window element is selected with frequency
+    proportional to its activation (StoPoolForwardTrain's r*sum threshold
+    rule); TEST mode returns the exact activation-weighted average."""
+    x = jnp.asarray([[[[1.0, 3.0], [0.0, 4.0]]]], jnp.float32)  # one 2x2 window
+    layer = make_layer(
+        'layer { name: "p" type: "Pooling" bottom: "x" top: "y" '
+        "pooling_param { pool: STOCHASTIC kernel_size: 2 stride: 2 } }"
+    )
+    draws = np.asarray([
+        np.asarray(
+            layer.apply([], {}, [x], train=True, rng=jax.random.key(i)).outputs[0]
+        ).item()
+        for i in range(400)
+    ])
+    freq = {v: float((draws == v).mean()) for v in (1.0, 3.0, 4.0)}
+    assert abs(freq[1.0] - 1 / 8) < 0.06
+    assert abs(freq[3.0] - 3 / 8) < 0.07
+    assert abs(freq[4.0] - 4 / 8) < 0.07
+    assert not np.any(draws == 0.0)  # zero-mass element never sampled
+    y_test = np.asarray(layer.apply([], {}, [x], train=False).outputs[0]).item()
+    assert np.isclose(y_test, (1 + 9 + 16) / 8.0)  # sum(a^2)/sum(a)
 
 
 def test_lrn_across_grad(rng, x44):

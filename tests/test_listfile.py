@@ -1,0 +1,230 @@
+"""Host readers for ImageData / WindowData / HDF5Data prototxt sources.
+
+Tiny on-disk fixtures exercise the reference semantics: listfile parse +
+resize + epoch shuffle (ref: image_data_layer.cpp:1-167), R-CNN fg/bg
+window sampling with context-pad warping (ref: window_data_layer.cpp:
+1-470), and the .h5-list row stream (ref: hdf5_data_layer.cpp) — ending
+with a reference-shaped ImageData prototxt training end to end.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+pytest.importorskip("PIL")
+
+from sparknet_tpu.common import Phase
+from sparknet_tpu.compiler import Network
+from sparknet_tpu.data.listfile import (
+    Hdf5DataSource,
+    ImageDataSource,
+    WindowDataSource,
+    source_from_net,
+)
+from sparknet_tpu.proto import parse
+
+
+def _write_png(path, h, w, value):
+    from PIL import Image
+
+    arr = np.full((h, w, 3), value, np.uint8)
+    Image.fromarray(arr).save(path)
+
+
+@pytest.fixture
+def image_list(tmp_path):
+    """4 solid-color images at mixed sizes + a '<path> <label>' listfile."""
+    for i, (h, w) in enumerate([(10, 12), (8, 8), (16, 10), (12, 12)]):
+        _write_png(tmp_path / f"im{i}.png", h, w, 40 * i + 20)
+    listfile = tmp_path / "list.txt"
+    listfile.write_text(
+        "".join(f"im{i}.png {i % 3}\n" for i in range(4))
+    )
+    return tmp_path, listfile
+
+
+def _image_layer(listfile, root, extra="", transform=""):
+    return parse(
+        'layer { name: "d" type: "ImageData" top: "data" top: "label" '
+        f'image_data_param {{ source: "{listfile}" root_folder: "{root}/" '
+        f"batch_size: 3 new_height: 9 new_width: 9 {extra} }} {transform} }}"
+    ).get_all("layer")[0]
+
+
+def test_image_data_source_shapes_and_loop(image_list):
+    root, listfile = image_list
+    src = ImageDataSource(_image_layer(listfile, root), train=True)
+    for it in range(3):  # 3 batches of 3 from 4 images: wraps mid-batch
+        b = src(it)
+        assert b["data"].shape == (3, 3, 9, 9)
+        assert b["data"].dtype == np.float32
+        assert b["label"].dtype == np.int32
+    # unshuffled wrap order: labels cycle the listfile
+    src2 = ImageDataSource(_image_layer(listfile, root), train=True)
+    seen = np.concatenate([src2(i)["label"] for i in range(4)])
+    assert list(seen) == [0, 1, 2, 0, 0, 1, 2, 0, 0, 1, 2, 0]
+
+
+def test_image_data_transform_and_shuffle(image_list):
+    root, listfile = image_list
+    lp = _image_layer(
+        listfile, root, extra="shuffle: true",
+        transform="transform_param { crop_size: 6 mean_value: 20 scale: 0.5 }",
+    )
+    src = ImageDataSource(lp, train=True, seed=7)
+    b = src(0)
+    assert b["data"].shape == (3, 3, 6, 6)
+    # solid-color images make the transform chain exact: values are
+    # 40i+20, so (v - 20) * 0.5 lands in {0, 20, 40, 60}
+    flat = b["data"].reshape(3, -1)
+    assert all(len(np.unique(r)) == 1 for r in flat)
+    assert set(np.unique(b["data"])) <= {0.0, 20.0, 40.0, 60.0}
+    # same seed -> identical shuffled stream
+    src_same = ImageDataSource(lp, train=True, seed=7)
+    np.testing.assert_array_equal(b["label"], src_same(0)["label"])
+    np.testing.assert_array_equal(src(1)["label"], src_same(1)["label"])
+
+
+def test_image_data_rejects_half_resize(image_list):
+    root, listfile = image_list
+    lp = parse(
+        'layer { name: "d" type: "ImageData" top: "data" top: "label" '
+        f'image_data_param {{ source: "{listfile}" root_folder: "{root}/" '
+        "batch_size: 2 new_height: 9 } }"
+    ).get_all("layer")[0]
+    with pytest.raises(ValueError, match="new_height and new_width"):
+        ImageDataSource(lp, train=True)
+
+
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def window_file(tmp_path):
+    """2 images, each with 1 fg (overlap .8) + 2 bg (overlap .1) windows."""
+    for i in range(2):
+        _write_png(tmp_path / f"w{i}.png", 24, 24, 100 + 50 * i)
+    wf = tmp_path / "windows.txt"
+    lines = []
+    for i in range(2):
+        lines += [f"# {i}", str(tmp_path / f"w{i}.png"), "3 24 24", "3",
+                  f"{i + 1} 0.8 4 4 15 15",
+                  "0 0.1 0 0 7 7",
+                  "0 0.1 10 10 23 23"]
+    wf.write_text("\n".join(lines) + "\n")
+    return wf
+
+
+def _window_layer(wf, extra=""):
+    return parse(
+        'layer { name: "w" type: "WindowData" top: "data" top: "label" '
+        f'window_data_param {{ source: "{wf}" batch_size: 8 '
+        f"fg_threshold: 0.5 bg_threshold: 0.5 fg_fraction: 0.25 {extra} }} "
+        "transform_param { crop_size: 16 mean_value: 50 } }"
+    ).get_all("layer")[0]
+
+
+def test_window_data_fg_bg_sampling(window_file):
+    src = WindowDataSource(_window_layer(window_file), train=True, seed=0)
+    b = src(0)
+    assert b["data"].shape == (8, 3, 16, 16)
+    # batch*fg_fraction = 2 fg samples, placed after the 6 bg (ref order:
+    # is_fg 0 then 1); bg labels forced to 0, fg labels > 0
+    assert list(b["label"][:6]) == [0] * 6
+    assert all(l in (1, 2) for l in b["label"][6:])
+    # solid-color source: warped fg pixels = value - mean, exactly
+    fg_img = int(b["label"][6]) - 1
+    assert np.allclose(np.unique(b["data"][6]), 100 + 50 * fg_img - 50)
+
+
+def test_window_data_context_pad_square(window_file):
+    src = WindowDataSource(
+        _window_layer(window_file, extra='context_pad: 2 crop_mode: "square"'),
+        train=True, seed=1,
+    )
+    b = src(0)
+    assert b["data"].shape == (8, 3, 16, 16)
+    assert np.isfinite(b["data"]).all()
+    # context-padded windows near the border get zero padding rows/cols:
+    # every sample still carries real (nonzero) content
+    assert (np.abs(b["data"]).reshape(8, -1).max(1) > 0).all()
+
+
+def test_window_data_needs_fg_and_bg(tmp_path):
+    _write_png(tmp_path / "only.png", 8, 8, 10)
+    wf = tmp_path / "w.txt"
+    wf.write_text(f"# 0\n{tmp_path / 'only.png'}\n3 8 8\n1\n1 0.9 0 0 7 7\n")
+    with pytest.raises(ValueError, match="fg and.*bg|at least one"):
+        WindowDataSource(_window_layer(wf), train=True)
+
+
+# ---------------------------------------------------------------------------
+def test_hdf5_data_source(tmp_path):
+    h5py = pytest.importorskip("h5py")
+    from sparknet_tpu.data.hdf5 import write_hdf5_file
+
+    for i in range(2):
+        write_hdf5_file(
+            str(tmp_path / f"p{i}.h5"),
+            {"data": np.full((5, 4), i, np.float32),
+             "label": np.arange(5, dtype=np.float32) + 10 * i},
+        )
+    listfile = tmp_path / "h5list.txt"
+    listfile.write_text(f"{tmp_path}/p0.h5\n{tmp_path}/p1.h5\n")
+    lp = parse(
+        'layer { name: "h" type: "HDF5Data" top: "data" top: "label" '
+        f'hdf5_data_param {{ source: "{listfile}" batch_size: 4 }} }}'
+    ).get_all("layer")[0]
+    src = Hdf5DataSource(lp, train=True)
+    b0, b1, b2 = src(0), src(1), src(2)
+    assert b0["data"].shape == (4, 4)
+    assert b0["label"].dtype == np.int32
+    # rows stream in file order and wrap at 10
+    assert list(b0["label"]) == [0, 1, 2, 3]
+    assert list(b1["label"]) == [4, 10, 11, 12]
+    assert list(b2["label"]) == [13, 14, 0, 1]
+
+
+# ---------------------------------------------------------------------------
+def test_image_data_prototxt_trains_end_to_end(image_list):
+    """A reference-shaped ImageData prototxt (conv net + SoftmaxWithLoss)
+    trains through Solver with feeds produced by source_from_net — the
+    finetune_flickr_style flow (ref: models/finetune_flickr_style/
+    train_val.prototxt sources ImageData) at fixture scale."""
+    import jax
+
+    from sparknet_tpu.solvers.solver import Solver, SolverConfig
+
+    root, listfile = image_list
+    npz = parse(
+        'name: "tiny_imagedata" '
+        'layer { name: "d" type: "ImageData" top: "data" top: "label" '
+        f'image_data_param {{ source: "{listfile}" root_folder: "{root}/" '
+        "batch_size: 3 new_height: 9 new_width: 9 shuffle: true } "
+        "transform_param { crop_size: 8 mirror: true scale: 0.0078125 } } "
+        'layer { name: "conv" type: "Convolution" bottom: "data" top: "conv" '
+        "convolution_param { num_output: 4 kernel_size: 3 "
+        'weight_filler { type: "xavier" } } } '
+        'layer { name: "ip" type: "InnerProduct" bottom: "conv" top: "ip" '
+        "inner_product_param { num_output: 3 "
+        'weight_filler { type: "xavier" } } } '
+        'layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" '
+        'bottom: "label" top: "loss" }'
+    )
+    solver = Solver(SolverConfig(base_lr=0.01, max_iter=10), npz)
+    src = source_from_net(solver.train_net)
+    step, variables, slots, key = solver.jitted_train_step()
+    losses = []
+    for i in range(4):
+        variables, slots, loss = step(variables, slots, i, src(i), key)
+        losses.append(float(np.asarray(loss)))
+    assert all(np.isfinite(l) for l in losses)
+
+
+def test_source_from_net_no_listfile_layer():
+    npz = parse(
+        'name: "plain" input: "data" input_dim: 1 input_dim: 3 '
+        "input_dim: 4 input_dim: 4"
+    )
+    net = Network(npz, Phase.TRAIN)
+    with pytest.raises(LookupError, match="no ImageData/WindowData/HDF5Data"):
+        source_from_net(net)

@@ -108,6 +108,7 @@ def test_sync_dp_converges():
     assert scores["accuracy"] > 0.8, scores
 
 
+@pytest.mark.smoke
 def test_tau_local_sgd_round():
     """The SparkNet algorithm: tau local steps then model averaging.
     All replicas must hold identical params after a round (post-pmean),

@@ -52,6 +52,7 @@ def _one_step(cfg, w, g, slots=None, specs=None, it=0):
     return np.asarray(new_p["l"][0]), new_s
 
 
+@pytest.mark.smoke
 def test_sgd_momentum_two_steps():
     """V = mu*V + lr*g; W -= V (ref: sgd_solver.cpp ComputeUpdateValue)."""
     cfg = SolverConfig(base_lr=0.1, momentum=0.9, solver_type="SGD")

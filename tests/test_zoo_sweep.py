@@ -34,6 +34,17 @@ def _net_files():
 
 
 @needs_ref
+@pytest.mark.smoke
+def test_smoke_reference_alexnet_compiles():
+    """Smoke-tier single compile: the canonical AlexNet train_val file
+    builds in both phases (the full sweep covers every file)."""
+    npz = parse_file(f"{REF}/models/bvlc_alexnet/train_val.prototxt")
+    for phase in (Phase.TRAIN, Phase.TEST):
+        net = Network(npz, phase)
+        assert net.layers
+
+
+@needs_ref
 @pytest.mark.parametrize("path", _net_files(), ids=lambda p: p.split("caffe/")[-1])
 def test_reference_prototxt_compiles(path):
     npz = parse_file(path)
