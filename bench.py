@@ -457,6 +457,43 @@ def main() -> int:
                        on_accel=on_accel, result_holder=result_holder)
     done.set()
     emit(rec)
+
+    # A healthy chip session is the scarce resource (the tunnel has been
+    # wedged for whole rounds — docs/TUNNEL_LOG_r3.md): once the headline
+    # is measured AND printed, bank the rest of the protocol's evidence
+    # (AlexNet f32, CaffeNet, GoogLeNet; ref sweep:
+    # caffe/docs/performance_hardware.md) into a side file.  stdout keeps
+    # its one-JSON-line contract; failures here cannot touch the headline.
+    if on_accel and model == "alexnet" and dtype_name == "bf16" \
+            and os.environ.get("SPARKNET_BENCH_EXTRA", "1") != "0":
+        extras = [("alexnet", 227, "f32", 256), ("caffenet", 227, "bf16", 256),
+                  ("googlenet", 224, "bf16", 32)]
+        # the headline is already on stdout; if an extra hangs, exit clean
+        # at the deadline rather than relying on a harder external kill
+        extra_deadline = _env_float("SPARKNET_BENCH_EXTRA_DEADLINE", 1800.0)
+        if extra_deadline > 0:
+            t = threading.Timer(extra_deadline, os._exit, args=(0,))
+            t.daemon = True
+            t.start()
+        results = []
+        for ex_model, ex_crop, ex_dtype, ex_batch in extras:
+            try:
+                phase[0] = f"extra:{ex_model}/{ex_dtype}"
+                r = measured_run(ex_batch, iters, warmup, ex_model, ex_crop,
+                                 ex_dtype, phase)
+                results.append(r)
+                print(f"bench extra: {json.dumps(r)}", file=sys.stderr, flush=True)
+            except Exception as e:
+                results.append({"metric": f"{ex_model}_{ex_dtype}_error",
+                                "error": repr(e)[:300]})
+        try:
+            path = os.path.join(os.path.dirname(__file__), "docs",
+                                "bench_extra_last.json")
+            with open(path + ".tmp", "w") as f:
+                json.dump({"headline": rec, "extras": results}, f, indent=1)
+            os.replace(path + ".tmp", path)
+        except OSError:
+            pass
     return 0
 
 

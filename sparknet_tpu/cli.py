@@ -70,13 +70,24 @@ def _data_fns(args, net):
 
         try:
             train_src = source_from_net(net, seed=1234 + pid)
-            # eval uses a SEPARATE instance with a fixed seed so every
-            # process scores the identical stream (the cifar/db paths'
-            # sum-then-normalize invariant) and eval cadence can't
-            # advance the training stream's position
-            eval_src = source_from_net(net, seed=4321)
         except (OSError, ValueError, LookupError) as e:
             raise SystemExit(f"--data proto: {e}") from None
+
+        # Eval fallback: a SEPARATE lazily-built instance with a fixed
+        # seed so every process scores the identical stream (the cifar/db
+        # paths' sum-then-normalize invariant) and eval cadence can't
+        # advance the training stream.  Lazy because the usual train_val
+        # case replaces it with the TEST net's own source (cmd_train) —
+        # re-parsing a large window file for a throwaway would be waste.
+        eval_state: dict = {}
+
+        def eval_src(b):
+            if "src" not in eval_state:
+                try:
+                    eval_state["src"] = source_from_net(net, seed=4321)
+                except (OSError, ValueError, LookupError) as e:
+                    raise SystemExit(f"--data proto (eval): {e}") from None
+            return eval_state["src"](b)
         if nproc > 1:
             # sequential (unshuffled) sources would otherwise stream the
             # SAME lines on every process; interleave batches by process
@@ -827,13 +838,19 @@ def cmd_classify(args) -> int:
 def cmd_pull_shards(args) -> int:
     """Explode a contiguous range of tar shards into a staging directory —
     per-worker dataset staging (ref: ec2/pull.py, which pulled
-    files-shuf-NNN.tar from S3; here the shard store is a local/NFS dir,
-    the zero-egress TPU-pod equivalent)."""
-    import glob
+    files-shuf-NNN.tar from S3).  ``--store`` takes a local/NFS dir or a
+    ``gs://``/``s3://`` prefix (via data.remote — remote shards are
+    fetched into the staging area before exploding)."""
     import re
     import tarfile
 
-    shards = sorted(glob.glob(os.path.join(args.store, "*.tar")))
+    from sparknet_tpu.data.remote import get_store
+
+    try:
+        store = get_store(args.store)
+        shards = [u for u in store.list_prefix(args.store) if u.endswith(".tar")]
+    except (ValueError, RuntimeError) as e:
+        raise SystemExit(f"--store {args.store}: {e}") from None
     if not shards:
         raise SystemExit(f"no .tar shards under {args.store}")
     # select by the shard NUMBER in the filename (files-shuf-007.tar is
@@ -852,7 +869,15 @@ def cmd_pull_shards(args) -> int:
     os.makedirs(outdir, exist_ok=True)
     written: set[str] = set()
     clobbered = 0
+    # local/NFS shards open in place; remote ones fetch into a cache dir
+    is_remote = "://" in args.store and not args.store.startswith("file://")
+    cache = os.path.join(outdir, ".shard_cache")
     for path in sel:
+        if is_remote:
+            try:
+                path = store.fetch(path, cache)
+            except RuntimeError as e:
+                raise SystemExit(f"--store {args.store}: {e}") from None
         with tarfile.open(path) as tar:
             for member in tar.getmembers():
                 if not member.isfile():

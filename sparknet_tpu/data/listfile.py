@@ -143,6 +143,13 @@ class WindowDataSource:
         self.crop_size = tp.get_int("crop_size", 0)
         if self.crop_size <= 0:
             raise ValueError("WindowData needs transform_param.crop_size")
+        if 2 * p.get_int("context_pad", 0) >= self.crop_size:
+            # context_scale divides by (crop - 2*pad): zero/negative means
+            # the padding leaves no room for the window itself
+            raise ValueError(
+                f"window_data_param.context_pad {p.get_int('context_pad', 0)} "
+                f"must be < crop_size/2 ({self.crop_size}/2)"
+            )
         self.scale = tp.get_float("scale", 1.0)
         self.mirror = tp.get_bool("mirror", False)
         self.mean_values = tuple(float(v) for v in tp.get_all("mean_value"))
@@ -320,6 +327,11 @@ class Hdf5DataSource:
         self._file_idx += 1
         self._current = read_hdf5_file(path, tuple(self.tops))
         n = len(next(iter(self._current.values())))
+        if n == 0:
+            # the reference CHECKs row count at load (hdf5_data_layer.cpp
+            # LoadHDF5FileData); without this an all-empty list would spin
+            # forever in __call__
+            raise ValueError(f"{path}: HDF5 file has no rows")
         if self.shuffle:
             perm = self._rs.permutation(n)
             self._current = {t: v[perm] for t, v in self._current.items()}

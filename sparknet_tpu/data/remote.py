@@ -99,11 +99,16 @@ class CliStore:
         ls, _ = self._argv
         lines = self._run(ls + [url]).splitlines()
         if self.scheme == "s3":
-            # `aws s3 ls` prints "date time size key" relative to the prefix
+            # `aws s3 ls` prints "date time size key" relative to the
+            # prefix (keys may contain spaces: take the 4th field to the
+            # end of line) and "PRE <dir>/" rows for sub-prefixes (skip)
             base = url if url.endswith("/") else url.rsplit("/", 1)[0] + "/"
-            return sorted(
-                base + ln.split()[-1] for ln in lines if ln.split()
-            )
+            out = []
+            for ln in lines:
+                parts = ln.split(None, 3)
+                if len(parts) == 4 and parts[0] != "PRE":
+                    out.append(base + parts[3])
+            return sorted(out)
         return sorted(ln.strip() for ln in lines if ln.strip())
 
     def fetch(self, url: str, dest_dir: str) -> str:

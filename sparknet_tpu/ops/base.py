@@ -132,7 +132,15 @@ def hw_param(m: Message, base: str, default: int | None = None) -> tuple[int, in
 
 def conv_out_dim(size: int, kernel: int, pad: int, stride: int, dilation: int = 1) -> int:
     ke = dilation * (kernel - 1) + 1
-    return (size + 2 * pad - ke) // stride + 1
+    out = (size + 2 * pad - ke) // stride + 1
+    if out <= 0:
+        # fail with the geometry in hand, not as a negative shape deep in
+        # conv_general_dilated (same contract as pool_out_dim below)
+        raise ValueError(
+            f"conv kernel {kernel} (stride {stride}, pad {pad}, dilation "
+            f"{dilation}) produces no output for input size {size}"
+        )
+    return out
 
 
 def pool_out_dim(size: int, kernel: int, pad: int, stride: int) -> int:
@@ -142,4 +150,12 @@ def pool_out_dim(size: int, kernel: int, pad: int, stride: int) -> int:
     out = int(np.ceil((size + 2 * pad - kernel) / float(stride))) + 1
     if pad > 0 and (out - 1) * stride >= size + pad:
         out -= 1
+    if out <= 0:
+        # a kernel larger than the padded input (e.g. GoogLeNet's 7x7
+        # pool5 fed a sub-224 crop) must fail HERE with the geometry in
+        # hand, not as a zero-size shape exploding in a downstream layer
+        raise ValueError(
+            f"pooling kernel {kernel} (stride {stride}, pad {pad}) "
+            f"produces no output for input size {size}"
+        )
     return out

@@ -125,6 +125,25 @@ def test_pooling_ave_grad(rng):
     check_layer_grad(layer, [x])
 
 
+def test_pooling_rejects_degenerate_geometry():
+    """A kernel larger than the input must fail loudly at shape inference
+    (e.g. GoogLeNet's 7x7 pool5 fed a sub-224 crop), not surface as a
+    zero-size shape exploding in a downstream layer."""
+    from sparknet_tpu.ops.base import conv_out_dim, pool_out_dim
+
+    with pytest.raises(ValueError, match="produces no output"):
+        pool_out_dim(4, 7, 0, 1)
+    with pytest.raises(ValueError, match="produces no output"):
+        conv_out_dim(8, 11, 0, 1)
+    x = jnp.zeros((1, 1, 4, 4), jnp.float32)
+    layer = make_layer(
+        'layer { name: "p" type: "Pooling" bottom: "x" top: "y" '
+        "pooling_param { pool: MAX kernel_size: 7 stride: 1 } }"
+    )
+    with pytest.raises(ValueError, match="produces no output"):
+        layer.apply([], {}, [x], train=True, rng=jax.random.key(0))
+
+
 def test_pooling_stochastic_test_mode_grad(rng):
     """TEST-mode stochastic pooling (sum(a^2)/sum(a)) is smooth where the
     window sum is bounded away from 0 — FD-checkable like AVE."""

@@ -58,6 +58,20 @@ def test_cli_store_absent_tool_is_loud(monkeypatch):
         CliStore("gs").list_prefix("gs://bucket/prefix")
 
 
+def test_cli_store_s3_ls_parse(monkeypatch):
+    """`aws s3 ls` rows: skip PRE sub-prefixes, keep keys with spaces."""
+    store = CliStore("s3")
+    monkeypatch.setattr(store, "_run", lambda argv: (
+        "                           PRE nested/\n"
+        "2023-01-01 12:00:00     1234 s0.tar\n"
+        "2023-01-01 12:00:01     1234 train set/s1.tar\n"
+    ))
+    assert store.list_prefix("s3://bucket/shards/") == [
+        "s3://bucket/shards/s0.tar",
+        "s3://bucket/shards/train set/s1.tar",
+    ]
+
+
 def _make_shards(root, n_shards=2, per=3):
     labels = {}
     os.makedirs(root, exist_ok=True)
