@@ -159,7 +159,11 @@ def _build_step(batch: int, model: str, crop: int, dtype_name: str):
 def measured_run(batch: int, iters: int, warmup: int, model: str, crop: int,
                  dtype_name: str, watchdog_phase: list,
                  on_accel: bool = True,
-                 result_holder: list | None = None) -> dict:
+                 result_holder: list | None = None,
+                 record_last: bool = True) -> dict:
+    """``record_last=False`` for extra (non-headline) measurements: the
+    last-good file holds the headline metric, and partial_record matches
+    it by metric+dtype — an extra overwriting it would orphan that."""
     import numpy as np
 
     watchdog_phase[0] = "build+compile"
@@ -203,7 +207,7 @@ def measured_run(batch: int, iters: int, warmup: int, model: str, crop: int,
     if result_holder is not None:
         result_holder[0] = dict(rec)  # snapshot: the watchdog thread may
         # serialize it while this thread keeps mutating rec below
-    if on_accel:
+    if on_accel and record_last:
         record_last_good(rec)
 
     # Cost analysis from the ACTUAL compiled executable (TPU fusion, not a
@@ -229,7 +233,8 @@ def measured_run(batch: int, iters: int, warmup: int, model: str, crop: int,
                     rec["roofline_img_s_upper_bound"] = round(batch / t_bound, 1)
         except Exception:
             pass  # evidence, not a dependency of the measurement
-        record_last_good(rec)  # re-record with the roofline evidence attached
+        if record_last:
+            record_last_good(rec)  # re-record with the roofline attached
         watchdog_phase[0] = "done"
     return rec
 
@@ -471,29 +476,38 @@ def main() -> int:
         # the headline is already on stdout; if an extra hangs, exit clean
         # at the deadline rather than relying on a harder external kill
         extra_deadline = _env_float("SPARKNET_BENCH_EXTRA_DEADLINE", 1800.0)
+        timer = None
         if extra_deadline > 0:
-            t = threading.Timer(extra_deadline, os._exit, args=(0,))
-            t.daemon = True
-            t.start()
+            timer = threading.Timer(extra_deadline, os._exit, args=(0,))
+            timer.daemon = True
+            timer.start()
         results = []
+        path = os.path.join(os.path.dirname(__file__), "docs",
+                            "bench_extra_last.json")
+
+        def bank() -> None:
+            # re-written after EVERY extra: a later extra hanging into the
+            # hard-exit timer must not discard the ones already measured
+            try:
+                with open(path + ".tmp", "w") as f:
+                    json.dump({"headline": rec, "extras": results}, f, indent=1)
+                os.replace(path + ".tmp", path)
+            except OSError:
+                pass
+
         for ex_model, ex_crop, ex_dtype, ex_batch in extras:
             try:
                 phase[0] = f"extra:{ex_model}/{ex_dtype}"
                 r = measured_run(ex_batch, iters, warmup, ex_model, ex_crop,
-                                 ex_dtype, phase)
+                                 ex_dtype, phase, record_last=False)
                 results.append(r)
                 print(f"bench extra: {json.dumps(r)}", file=sys.stderr, flush=True)
             except Exception as e:
                 results.append({"metric": f"{ex_model}_{ex_dtype}_error",
                                 "error": repr(e)[:300]})
-        try:
-            path = os.path.join(os.path.dirname(__file__), "docs",
-                                "bench_extra_last.json")
-            with open(path + ".tmp", "w") as f:
-                json.dump({"headline": rec, "extras": results}, f, indent=1)
-            os.replace(path + ".tmp", path)
-        except OSError:
-            pass
+            bank()
+        if timer is not None:
+            timer.cancel()  # an embedding caller must outlive this block
     return 0
 
 

@@ -873,9 +873,10 @@ def cmd_pull_shards(args) -> int:
     is_remote = "://" in args.store and not args.store.startswith("file://")
     cache = os.path.join(outdir, ".shard_cache")
     for path in sel:
+        fetched = None
         if is_remote:
             try:
-                path = store.fetch(path, cache)
+                path = fetched = store.fetch(path, cache)
             except RuntimeError as e:
                 raise SystemExit(f"--store {args.store}: {e}") from None
         with tarfile.open(path) as tar:
@@ -896,6 +897,14 @@ def cmd_pull_shards(args) -> int:
                 written.add(dst)
                 with open(dst, "wb") as f:
                     f.write(src.read())
+        if fetched is not None:
+            # exploded successfully: drop the cached tar so staging costs
+            # 1x the dataset, not 2x (the cache only guards re-fetch
+            # within this run's loop, and each shard is visited once)
+            try:
+                os.remove(fetched)
+            except OSError:
+                pass
     print(json.dumps({
         "out": outdir, "shards": len(sel), "files": len(written),
         "clobbered": clobbered,

@@ -143,11 +143,11 @@ class WindowDataSource:
         self.crop_size = tp.get_int("crop_size", 0)
         if self.crop_size <= 0:
             raise ValueError("WindowData needs transform_param.crop_size")
-        if 2 * p.get_int("context_pad", 0) >= self.crop_size:
+        if 2 * self.context_pad >= self.crop_size:
             # context_scale divides by (crop - 2*pad): zero/negative means
             # the padding leaves no room for the window itself
             raise ValueError(
-                f"window_data_param.context_pad {p.get_int('context_pad', 0)} "
+                f"window_data_param.context_pad {self.context_pad} "
                 f"must be < crop_size/2 ({self.crop_size}/2)"
             )
         self.scale = tp.get_float("scale", 1.0)

@@ -19,6 +19,7 @@ SCRIPTS = {
     "03_fine_tuning.py": 560,
     "net_surgery.py": 560,
     "04_distributed_training.py": 1100,
+    "06_listfile_sources.py": 560,
 }
 
 

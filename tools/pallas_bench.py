@@ -1,0 +1,195 @@
+"""Pallas-vs-XLA kernel shootout on the real chip.
+
+The repo ships two opt-in pallas kernels (`ops/pallas_kernels.py`):
+cross-channel LRN and flash attention, both with custom VJPs and
+interpret-mode tests — but neither has ever been timed against the XLA
+lowering on TPU (the round-1 attempt wedged the relay).  This tool makes
+that measurement one command, following bench.py's tunnel protocol:
+subprocess probe first, generous deadlines, one TPU process at a time.
+
+    python tools/pallas_bench.py            # both kernels, fwd+bwd
+    python tools/pallas_bench.py --op lrn   # one kernel
+
+Prints one JSON record per (op, direction, impl) with median ms, and a
+final verdict line per op: promote pallas, keep XLA, or unmeasured.
+Decision rule (VERDICT round 2 item 7): the winner at the bench shapes
+becomes the default; a kernel that loses stays opt-in or gets deleted.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import statistics
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# AlexNet's LRN shape at bench batch (b256 conv1 output) and a
+# transformer-ish attention shape; SPARKNET_PALLAS_BENCH_SMALL=1 shrinks
+# both for plumbing checks on small boxes
+if os.environ.get("SPARKNET_PALLAS_BENCH_SMALL"):
+    LRN_SHAPE = (4, 16, 16, 16)
+    ATTN_SHAPE = (2, 2, 256, 64)
+else:
+    LRN_SHAPE = (256, 96, 55, 55)
+    ATTN_SHAPE = (8, 8, 1024, 64)  # (batch, heads, seq, head_dim)
+
+
+def _time_fn(fn, args, iters=10, warmup=3):
+    import jax
+
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        times.append((time.perf_counter() - t0) * 1e3)
+    return statistics.median(times)
+
+
+def bench_lrn(records):
+    import jax
+    import jax.numpy as jnp
+
+    from sparknet_tpu.ops import pallas_kernels as pk
+
+    x = jax.random.normal(jax.random.key(0), LRN_SHAPE, jnp.float32)
+    grads = jax.random.normal(jax.random.key(1), LRN_SHAPE, jnp.float32)
+    results = {}
+    for impl in ("xla", "pallas"):
+        fwd = jax.jit(functools.partial(
+            pk.lrn_across_channels, size=5, alpha=1e-4, beta=0.75, k=1.0,
+            force=impl))
+        vjp = jax.jit(lambda x, g, f=fwd: jax.vjp(f, x)[1](g)[0])
+        try:
+            results[impl] = {
+                "fwd_ms": round(_time_fn(fwd, (x,)), 3),
+                "bwd_ms": round(_time_fn(vjp, (x, grads)), 3),
+            }
+        except Exception as e:
+            results[impl] = {"error": repr(e)[:300]}
+        records.append({"op": "lrn", "impl": impl, "shape": list(LRN_SHAPE),
+                        **results[impl]})
+    return results
+
+
+def bench_flash(records):
+    import jax
+    import jax.numpy as jnp
+
+    from sparknet_tpu.ops import pallas_kernels as pk
+
+    q, k, v = (jax.random.normal(jax.random.key(i), ATTN_SHAPE, jnp.float32)
+               for i in range(3))
+    g = jax.random.normal(jax.random.key(3), ATTN_SHAPE, jnp.float32)
+    results = {}
+    for impl in ("xla", "pallas"):
+        fwd = jax.jit(functools.partial(pk.flash_attention, causal=True,
+                                        force=impl))
+        # time the FULL backward (dq, dk, dv): returning only dq would let
+        # XLA dead-code-eliminate 2/3 of its backward while the pallas
+        # custom-VJP kernel computes all three — an asymmetric comparison
+        vjp = jax.jit(lambda q, k, v, g, f=fwd: jax.vjp(f, q, k, v)[1](g))
+        try:
+            results[impl] = {
+                "fwd_ms": round(_time_fn(fwd, (q, k, v)), 3),
+                "bwd_ms": round(_time_fn(vjp, (q, k, v, g)), 3),
+            }
+        except Exception as e:
+            results[impl] = {"error": repr(e)[:300]}
+        records.append({"op": "flash_attention", "impl": impl,
+                        "shape": list(ATTN_SHAPE), **results[impl]})
+    return results
+
+
+def verdict(op, results):
+    x, p = results.get("xla", {}), results.get("pallas", {})
+    if "error" in p or "fwd_ms" not in p:
+        return {"op": op, "verdict": "pallas failed on chip — keep XLA "
+                "default, fix or delete the kernel",
+                "pallas_error": p.get("error")}
+    if "error" in x or "fwd_ms" not in x:
+        return {"op": op, "verdict": "xla lowering failed (unexpected)",
+                "xla_error": x.get("error")}
+    total_x = x["fwd_ms"] + x["bwd_ms"]
+    total_p = p["fwd_ms"] + p["bwd_ms"]
+    if total_p < 0.95 * total_x:
+        v = f"PROMOTE pallas ({total_p:.2f} ms vs {total_x:.2f} ms fwd+bwd)"
+    else:
+        v = f"keep XLA default ({total_x:.2f} ms vs {total_p:.2f} ms fwd+bwd)"
+    return {"op": op, "verdict": v, "xla_ms": total_x, "pallas_ms": total_p}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--op", choices=["lrn", "flash", "all"], default="all")
+    ap.add_argument("--allow-cpu", action="store_true",
+                    help="run on CPU/interpret anyway (numbers meaningless "
+                    "for the promote decision; for plumbing checks only)")
+    args = ap.parse_args()
+
+    import bench  # repo-root bench.py: reuse the probe protocol
+
+    forced_cpu = os.environ.get("JAX_PLATFORMS", "").strip() == "cpu"
+    if forced_cpu:
+        # the env var alone loses to the site hook's platform pin — the
+        # config route is the only reliable CPU force
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    if not forced_cpu:
+        probe = bench.probe_backend(
+            attempts=int(os.environ.get("SPARKNET_BENCH_PROBE_ATTEMPTS", "1")),
+            timeout=float(os.environ.get("SPARKNET_BENCH_PROBE_TIMEOUT", "300")),
+        )
+        if not probe["ok"]:
+            print(json.dumps({"measured": False, "reason": probe["reason"]}))
+            return 0
+        if probe["platform"] == "cpu" and not args.allow_cpu:
+            print(json.dumps({"measured": False,
+                              "reason": "backend is CPU; pass --allow-cpu "
+                              "for a plumbing-only run"}))
+            return 0
+    elif not args.allow_cpu:
+        print(json.dumps({"measured": False,
+                          "reason": "forced CPU; pass --allow-cpu"}))
+        return 0
+
+    import jax
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    records: list[dict] = []
+    verdicts = []
+    if args.op in ("lrn", "all"):
+        verdicts.append(verdict("lrn", bench_lrn(records)))
+    if args.op in ("flash", "all"):
+        verdicts.append(verdict("flash_attention", bench_flash(records)))
+    if not on_accel:
+        # CPU numbers can't drive the promote decision (and pallas only
+        # runs in interpret mode here) — mark every line
+        for r in records + verdicts:
+            r["plumbing_only_cpu"] = True
+    for r in records:
+        print(json.dumps(r))
+    for v in verdicts:
+        print(json.dumps(v))
+    try:
+        path = os.path.join(REPO, "docs", "pallas_bench_last.json")
+        with open(path + ".tmp", "w") as f:
+            json.dump({"records": records, "verdicts": verdicts}, f, indent=1)
+        os.replace(path + ".tmp", path)
+    except OSError:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

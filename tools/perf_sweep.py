@@ -22,8 +22,13 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def run_variant(dtype: str, batch: int, timeout: int = 560) -> dict:
-    env = dict(os.environ, SPARKNET_BENCH_DTYPE=dtype, SPARKNET_BENCH_BATCH=str(batch))
+def run_variant(dtype: str, batch: int, timeout: int = 900) -> dict:
+    # sweep variants are single measurements: no per-variant extra
+    # protocol, and a wedged tunnel should fail the variant after one
+    # probe attempt instead of eating the timeout in retries
+    env = dict(os.environ, SPARKNET_BENCH_DTYPE=dtype,
+               SPARKNET_BENCH_BATCH=str(batch), SPARKNET_BENCH_EXTRA="0")
+    env.setdefault("SPARKNET_BENCH_PROBE_ATTEMPTS", "1")
     try:
         out = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench.py")],
