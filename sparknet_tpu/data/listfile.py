@@ -67,7 +67,12 @@ def _transformer(lp: Message, seed: int | None):
 
 
 class ImageDataSource:
-    """Infinite minibatch stream for one ImageData layer."""
+    """Infinite minibatch stream for one ImageData layer.
+
+    Decodes a batch's images through a thread pool (PIL releases the GIL
+    in its C decode/resize paths, so this scales on the multi-core hosts
+    of a TPU VM — the role of the reference's per-executor parallelism);
+    ``SPARKNET_DECODE_WORKERS`` overrides the pool size, 1 = serial."""
 
     def __init__(self, layer_param: Message, *, train: bool, seed: int = 0):
         self.lp = layer_param
@@ -106,19 +111,36 @@ class ImageDataSource:
         skip = p.get_int("rand_skip", 0)
         self._pos = int(self._rs.randint(0, skip)) if skip > 1 else 0
         self.xform = _transformer(layer_param, seed)
+        # resolved HERE (not at first batch) so config errors fail early
+        # and the value can't drift with later env changes
+        from sparknet_tpu.data.minibatch import decode_workers
+
+        self.workers = decode_workers()
+
+    def _decode_pool(self):
+        if not hasattr(self, "_pool"):
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = (
+                ThreadPoolExecutor(self.workers, thread_name_prefix="decode")
+                if self.workers > 1 else None
+            )
+        return self._pool
 
     def __call__(self, _it: int) -> dict[str, np.ndarray]:
-        imgs, labels = [], []
-        while len(imgs) < self.batch:
+        paths, labels = [], []
+        while len(paths) < self.batch:
             if self._pos >= len(self.lines):
                 self._pos = 0
                 if self.shuffle:  # reshuffle each epoch (image_data_layer.cpp:143)
                     self._rs.shuffle(self.lines)
             rel, label = self.lines[self._pos]
             self._pos += 1
-            imgs.append(_read_image(os.path.join(self.root, rel), self.color,
-                                    self.new_h, self.new_w))
+            paths.append(os.path.join(self.root, rel))
             labels.append(label)
+        read = lambda p: _read_image(p, self.color, self.new_h, self.new_w)
+        pool = self._decode_pool()
+        imgs = list(pool.map(read, paths)) if pool else [read(p) for p in paths]
         data = self.xform(np.stack(imgs), self.train)
         return {self.tops[0]: data,
                 self.tops[1]: np.asarray(labels, np.int32)}

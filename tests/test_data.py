@@ -150,6 +150,21 @@ def test_make_minibatches_compressed_decodes_and_drops_bad():
     assert 99 not in np.concatenate([b[1] for b in out])
 
 
+def test_make_minibatches_compressed_pooled_matches_serial():
+    """Thread-pooled decode yields byte-identical batches in identical
+    order to the serial path, including broken-image drops."""
+    rs = np.random.RandomState(1)
+    samples = [(_jpeg_bytes(rs.randint(0, 255, (16, 16, 3)).astype(np.uint8)), k)
+               for k in range(9)]
+    samples.insert(4, (b"broken", 99))
+    serial = list(make_minibatches_compressed(samples, 3, 8, 8, workers=1))
+    pooled = list(make_minibatches_compressed(samples, 3, 8, 8, workers=4))
+    assert len(serial) == len(pooled) == 3
+    for (si, sl), (pi, pl) in zip(serial, pooled):
+        np.testing.assert_array_equal(si, pi)
+        np.testing.assert_array_equal(sl, pl)
+
+
 def test_compute_mean_streaming_matches_direct():
     rs = np.random.RandomState(0)
     images = rs.randint(0, 255, (30, 3, 5, 5)).astype(np.uint8)

@@ -86,6 +86,18 @@ def test_image_data_transform_and_shuffle(image_list):
     np.testing.assert_array_equal(src(1)["label"], src_same(1)["label"])
 
 
+def test_image_data_pooled_decode_matches_serial(image_list, monkeypatch):
+    root, listfile = image_list
+    monkeypatch.setenv("SPARKNET_DECODE_WORKERS", "1")
+    serial = ImageDataSource(_image_layer(listfile, root), train=False)
+    monkeypatch.setenv("SPARKNET_DECODE_WORKERS", "4")
+    pooled = ImageDataSource(_image_layer(listfile, root), train=False)
+    for it in range(3):
+        a, b = serial(it), pooled(it)
+        np.testing.assert_array_equal(a["data"], b["data"])
+        np.testing.assert_array_equal(a["label"], b["label"])
+
+
 def test_image_data_rejects_half_resize(image_list):
     root, listfile = image_list
     lp = parse(

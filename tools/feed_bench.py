@@ -50,6 +50,40 @@ def bench_transform(backend: str, batch: int, iters: int) -> dict:
     }
 
 
+def bench_decode(batch: int, iters: int, workers: int) -> dict:
+    """JPEG decode throughput, serial vs thread-pooled (PIL's C decode
+    releases the GIL, so the pool scales with host cores — the
+    per-executor decode parallelism of the reference's Spark ingest)."""
+    import io
+
+    from PIL import Image
+
+    from sparknet_tpu.data.minibatch import make_minibatches_compressed
+
+    rs = np.random.RandomState(0)
+    jpegs = []
+    for _ in range(batch):
+        buf = io.BytesIO()
+        Image.fromarray(rs.randint(0, 255, (256, 256, 3), np.uint8)).save(
+            buf, format="JPEG")
+        jpegs.append((buf.getvalue(), 0))
+
+    def run_once():
+        return sum(1 for _ in make_minibatches_compressed(
+            jpegs, batch, 227, 227, workers=workers))
+
+    assert run_once() == 1
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        run_once()
+    dt_ms = (time.perf_counter() - t0) / iters * 1e3
+    return {
+        "metric": f"feed_decode_workers{workers}_ms_per_batch",
+        "value": round(dt_ms, 2),
+        "unit": f"ms/{batch}-img batch (256px jpeg -> 227px chw)",
+    }
+
+
 def bench_prefetch(batch: int, iters: int) -> dict:
     """Producer/consumer overlap: batches/s through the device prefetcher
     with a 10 ms synthetic producer (the decode+augment stand-in)."""
@@ -95,6 +129,13 @@ def main() -> int:
     else:
         print(json.dumps({"metric": "feed_transform_native_ms_per_batch",
                           "skipped": "libsparknet_native unavailable"}))
+    import os
+
+    decode_iters = max(args.iters // 4, 2)  # decode is the slow leg
+    print(json.dumps(bench_decode(args.batch, decode_iters, workers=1)))
+    n = min(os.cpu_count() or 1, 8)
+    if n > 1:
+        print(json.dumps(bench_decode(args.batch, decode_iters, workers=n)))
     print(json.dumps(bench_prefetch(args.batch, args.iters)))
     return 0
 
