@@ -1076,20 +1076,50 @@ def cmd_bench(args) -> int:
 
 
 def cmd_device_query(args) -> int:
-    """ref: caffe.cpp:110-150 device_query()."""
-    import jax
+    """ref: caffe.cpp:110-150 device_query().
 
-    for d in jax.devices():
-        print(
-            json.dumps(
-                {
-                    "id": d.id,
-                    "platform": d.platform,
-                    "device_kind": d.device_kind,
-                    "process_index": d.process_index,
-                }
-            )
-        )
+    Probes the backend from a disposable subprocess first (``--timeout``
+    seconds): a wedged remote relay otherwise hangs PJRT client creation
+    FOREVER with no way to interrupt — a device query must never do that."""
+    timeout = getattr(args, "timeout", 300.0)
+    # dial from a subprocess we can abandon (inline rather than importing
+    # repo-root bench.py — installed wheels don't ship it).  The parent's
+    # platform pin must reach the child through the CONFIG route (the env
+    # var alone loses to site hooks).
+    import os as _os
+    import subprocess
+    import sys as _sys
+
+    code = (
+        "import os, jax, json\n"
+        "p = os.environ.get('SPARKNET_DEVICE_QUERY_PLATFORM')\n"
+        "if p: jax.config.update('jax_platforms', p)\n"
+        "print('\\n'.join(json.dumps({'id': d.id, 'platform': d.platform,"
+        " 'device_kind': d.device_kind, 'process_index': d.process_index})"
+        " for d in jax.devices()))\n"
+    )
+    env = dict(_os.environ)
+    # read a parent platform pin WITHOUT importing jax here (the child
+    # pays that import anyway; a config pin implies jax is already loaded)
+    _jax = sys.modules.get("jax")
+    if _jax is not None and _jax.config.jax_platforms:
+        env["SPARKNET_DEVICE_QUERY_PLATFORM"] = _jax.config.jax_platforms
+    try:
+        out = subprocess.run([_sys.executable, "-c", code],
+                             capture_output=True, text=True, env=env,
+                             timeout=timeout if timeout > 0 else None)
+    except subprocess.TimeoutExpired:
+        print(json.dumps({
+            "error": f"backend did not answer within {timeout:.0f}s "
+            "(wedged tunnel?); re-run with --timeout 0 to wait forever",
+        }))
+        return 1
+    sys_out = out.stdout.strip()
+    if out.returncode != 0:
+        tail = (out.stderr or out.stdout).strip().splitlines()[-1:]
+        print(json.dumps({"error": tail[0][:300] if tail else "no output"}))
+        return 1
+    print(sys_out)
     return 0
 
 
@@ -1287,6 +1317,8 @@ def main(argv=None) -> int:
     sp.set_defaults(fn=cmd_bench)
 
     sp = sub.add_parser("device_query", help="show devices")
+    sp.add_argument("--timeout", type=float, default=300.0,
+                    help="backend dial timeout in seconds (0 = wait forever)")
     sp.set_defaults(fn=cmd_device_query)
 
     args = p.parse_args(argv)

@@ -121,10 +121,17 @@ class ImageDataSource:
         if not hasattr(self, "_pool"):
             from concurrent.futures import ThreadPoolExecutor
 
-            self._pool = (
-                ThreadPoolExecutor(self.workers, thread_name_prefix="decode")
-                if self.workers > 1 else None
-            )
+            if self.workers > 1:
+                import weakref
+
+                self._pool = ThreadPoolExecutor(
+                    self.workers, thread_name_prefix="decode")
+                # pools hold non-daemon threads: tie shutdown to THIS
+                # source's lifetime, not the interpreter's (a trainer
+                # rebuilding sources must not accumulate idle threads)
+                weakref.finalize(self, self._pool.shutdown, wait=False)
+            else:
+                self._pool = None
         return self._pool
 
     def __call__(self, _it: int) -> dict[str, np.ndarray]:

@@ -50,11 +50,12 @@ def decode_workers(cap: int = 8) -> int:
     return min(_os.cpu_count() or 1, cap)
 
 
-def _decoded_pairs(samples, height, width, workers):
+def _decoded_pairs(samples, height, width, workers, chunk):
     """(decoded_or_None, label) stream; ``workers`` > 1 decodes each
-    batch-sized chunk through a thread pool (PIL's C decode path releases
-    the GIL — the multi-core TPU-VM analog of the reference's
-    per-executor decode parallelism).  Order is preserved either way."""
+    ``chunk``-sized run of samples through a thread pool (PIL's C decode
+    path releases the GIL — the multi-core TPU-VM analog of the
+    reference's per-executor decode parallelism).  Order is preserved
+    either way; time-to-first-pair buffers at most ``chunk`` samples."""
     if workers <= 1:
         for data, label in samples:
             yield decode_jpeg(data, height, width), label
@@ -70,7 +71,7 @@ def _decoded_pairs(samples, height, width, workers):
 
         for s in samples:
             buf.append(s)
-            if len(buf) >= 64:  # chunk size: amortize pool dispatch
+            if len(buf) >= chunk:
                 yield from flush(buf)
                 buf = []
         if buf:
@@ -91,7 +92,8 @@ def make_minibatches_compressed(
     if workers == 0:
         workers = decode_workers()
     imgs, labels = [], []
-    for arr, label in _decoded_pairs(samples, height, width, workers):
+    for arr, label in _decoded_pairs(samples, height, width, workers,
+                                     chunk=batch_size):
         if arr is None:
             continue
         imgs.append(arr)

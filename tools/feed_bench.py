@@ -72,7 +72,8 @@ def bench_decode(batch: int, iters: int, workers: int) -> dict:
         return sum(1 for _ in make_minibatches_compressed(
             jpegs, batch, 227, 227, workers=workers))
 
-    assert run_once() == 1
+    n = run_once()  # warmup OUTSIDE the timed loop (and not in an assert:
+    assert n == 1   # python -O must not silently drop the warmup)
     t0 = time.perf_counter()
     for _ in range(iters):
         run_once()
