@@ -1080,16 +1080,33 @@ def cmd_device_query(args) -> int:
 
     Probes the backend from a disposable subprocess first (``--timeout``
     seconds): a wedged remote relay otherwise hangs PJRT client creation
-    FOREVER with no way to interrupt — a device query must never do that."""
-    timeout = getattr(args, "timeout", 300.0)
-    # dial from a subprocess we can abandon (inline rather than importing
-    # repo-root bench.py — installed wheels don't ship it).  The parent's
-    # platform pin must reach the child through the CONFIG route (the env
-    # var alone loses to site hooks).
-    import os as _os
-    import subprocess
-    import sys as _sys
+    FOREVER with no way to interrupt — a device query must never do that.
+    A cpu-pinned platform (``--platform cpu`` / env / conftest) lists
+    in-process: no relay exists there, and no subprocess cost."""
 
+    def row(d):
+        return {"id": d.id, "platform": d.platform,
+                "device_kind": d.device_kind, "process_index": d.process_index}
+
+    import subprocess
+
+    # read a parent platform pin WITHOUT importing jax here (a config pin
+    # implies jax is already loaded)
+    _jax = sys.modules.get("jax")
+    pinned = (_jax.config.jax_platforms if _jax is not None else None) \
+        or (os.environ.get("JAX_PLATFORMS", "").strip() or None)
+    if pinned == "cpu" or args.timeout <= 0:
+        import jax
+
+        if pinned:
+            jax.config.update("jax_platforms", pinned)
+        for d in jax.devices():
+            print(json.dumps(row(d)))
+        return 0
+
+    # dial from a subprocess we can abandon; the parent's platform pin
+    # reaches the child through the CONFIG route (env alone loses to
+    # site hooks)
     code = (
         "import os, jax, json\n"
         "p = os.environ.get('SPARKNET_DEVICE_QUERY_PLATFORM')\n"
@@ -1098,28 +1115,24 @@ def cmd_device_query(args) -> int:
         " 'device_kind': d.device_kind, 'process_index': d.process_index})"
         " for d in jax.devices()))\n"
     )
-    env = dict(_os.environ)
-    # read a parent platform pin WITHOUT importing jax here (the child
-    # pays that import anyway; a config pin implies jax is already loaded)
-    _jax = sys.modules.get("jax")
-    if _jax is not None and _jax.config.jax_platforms:
-        env["SPARKNET_DEVICE_QUERY_PLATFORM"] = _jax.config.jax_platforms
+    env = dict(os.environ)
+    if pinned:
+        env["SPARKNET_DEVICE_QUERY_PLATFORM"] = pinned
     try:
-        out = subprocess.run([_sys.executable, "-c", code],
+        out = subprocess.run([sys.executable, "-c", code],
                              capture_output=True, text=True, env=env,
-                             timeout=timeout if timeout > 0 else None)
+                             timeout=args.timeout)
     except subprocess.TimeoutExpired:
         print(json.dumps({
-            "error": f"backend did not answer within {timeout:.0f}s "
+            "error": f"backend did not answer within {args.timeout:.0f}s "
             "(wedged tunnel?); re-run with --timeout 0 to wait forever",
         }))
         return 1
-    sys_out = out.stdout.strip()
     if out.returncode != 0:
         tail = (out.stderr or out.stdout).strip().splitlines()[-1:]
         print(json.dumps({"error": tail[0][:300] if tail else "no output"}))
         return 1
-    print(sys_out)
+    print(out.stdout.strip())
     return 0
 
 
