@@ -232,6 +232,62 @@ def test_image_data_prototxt_trains_end_to_end(image_list):
     assert all(np.isfinite(l) for l in losses)
 
 
+def test_cli_train_imagedata_proto(image_list, tmp_path, monkeypatch, capsys):
+    """`tpunet train --data proto` end to end through main() on an
+    ImageData prototxt (the finetune_flickr_style CLI flow)."""
+    from sparknet_tpu.cli import main
+
+    root, listfile = image_list
+    net = tmp_path / "net.prototxt"
+    net.write_text(
+        'name: "t" '
+        'layer { name: "d" type: "ImageData" top: "data" top: "label" '
+        f'image_data_param {{ source: "{listfile}" root_folder: "{root}/" '
+        "batch_size: 3 new_height: 9 new_width: 9 } "
+        "transform_param { crop_size: 8 scale: 0.01 } } "
+        'layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip" '
+        "inner_product_param { num_output: 3 "
+        'weight_filler { type: "xavier" } } } '
+        'layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" '
+        'bottom: "label" top: "loss" }'
+    )
+    solver = tmp_path / "solver.prototxt"
+    solver.write_text(f'net: "{net}"\nbase_lr: 0.01\nlr_policy: "fixed"\n'
+                      "max_iter: 3\ndisplay: 1\n")
+    monkeypatch.chdir(tmp_path)
+    assert main(["train", "--solver", str(solver), "--data", "proto",
+                 "--iterations", "3"]) == 0
+    out = capsys.readouterr().out
+    assert "loss" in out
+
+
+def test_cli_train_windowdata_proto(window_file, tmp_path, monkeypatch, capsys):
+    """`tpunet train --data proto` on a WindowData prototxt (the
+    pascal-detection CLI flow): fg/bg sampling feeds a tiny window head."""
+    from sparknet_tpu.cli import main
+
+    net = tmp_path / "net.prototxt"
+    net.write_text(
+        'name: "w" '
+        'layer { name: "d" type: "WindowData" top: "data" top: "label" '
+        f'window_data_param {{ source: "{window_file}" batch_size: 4 '
+        "fg_threshold: 0.5 bg_threshold: 0.5 fg_fraction: 0.25 } "
+        "transform_param { crop_size: 12 mean_value: 50 } } "
+        'layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip" '
+        "inner_product_param { num_output: 3 "
+        'weight_filler { type: "xavier" } } } '
+        'layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" '
+        'bottom: "label" top: "loss" }'
+    )
+    solver = tmp_path / "solver.prototxt"
+    solver.write_text(f'net: "{net}"\nbase_lr: 0.001\nlr_policy: "fixed"\n'
+                      "max_iter: 2\ndisplay: 1\n")
+    monkeypatch.chdir(tmp_path)
+    assert main(["train", "--solver", str(solver), "--data", "proto",
+                 "--iterations", "2"]) == 0
+    assert "loss" in capsys.readouterr().out
+
+
 def test_source_from_net_no_listfile_layer():
     npz = parse(
         'name: "plain" input: "data" input_dim: 1 input_dim: 3 '
