@@ -458,8 +458,15 @@ def main() -> int:
     if deadline > 0 and not forced_cpu:
         threading.Thread(target=watchdog, daemon=True).start()
 
+    # Sweeps/one-off variants (tools/perf_sweep.py) set
+    # SPARKNET_BENCH_RECORD_LAST=0: last-good holds the HEADLINE config's
+    # evidence for partial_record's metric+dtype fallback, and a variant
+    # run overwriting it (e.g. f32 over the bf16 headline) would orphan
+    # that fallback exactly as measured_run's docstring warns.
+    record_last = os.environ.get("SPARKNET_BENCH_RECORD_LAST", "1") != "0"
     rec = measured_run(batch, iters, warmup, model, crop, dtype_name, phase,
-                       on_accel=on_accel, result_holder=result_holder)
+                       on_accel=on_accel, result_holder=result_holder,
+                       record_last=record_last)
     done.set()
     emit(rec)
 

@@ -10,7 +10,8 @@ subprocess probe first, generous deadlines, one TPU process at a time.
     python tools/pallas_bench.py            # both kernels, fwd+bwd
     python tools/pallas_bench.py --op lrn   # one kernel
 
-Prints one JSON record per (op, direction, impl) with median ms, and a
+Prints one JSON record per (op, direction, impl) with amortized ms/iter
+(chained-iteration mean — see _time_fn; NOT a per-call median), and a
 final verdict line per op: promote pallas, keep XLA, or unmeasured.
 Decision rule (VERDICT round 2 item 7): the winner at the bench shapes
 becomes the default; a kernel that loses stays opt-in or gets deleted.
@@ -22,7 +23,6 @@ import argparse
 import functools
 import json
 import os
-import statistics
 import sys
 import time
 
@@ -40,19 +40,33 @@ else:
     ATTN_SHAPE = (8, 8, 1024, 64)  # (batch, heads, seq, head_dim)
 
 
-def _time_fn(fn, args, iters=10, warmup=3):
+def _fence(args):
+    """Force execution of everything `args` depends on by pulling a scalar
+    to the host.  On remote-relay backends (axon) ``block_until_ready``
+    can return before the chain has actually executed — the same lesson
+    bench.py's measured_run encodes; a value fetch is the reliable fence
+    (round-3 on-chip runs showed per-call block_until_ready timing
+    understating LRN forward by >20x vs its bandwidth roofline)."""
     import jax
 
+    leaf = jax.tree_util.tree_leaves(args)[0]
+    float(leaf.sum())
+
+
+def _time_fn(fn, args, chain, iters=20, warmup=3):
+    """ms/iter over `iters` invocations chained through `chain(args, out)
+    -> next_args` so each call consumes the previous call's output: the
+    device can't overlap or elide iterations, and one fence at the end
+    times real execution with dispatch overhead amortized."""
+    a = args
     for _ in range(warmup):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    times = []
+        a = chain(a, fn(*a))
+    _fence(a)
+    t0 = time.perf_counter()
     for _ in range(iters):
-        t0 = time.perf_counter()
-        out = fn(*args)
-        jax.block_until_ready(out)
-        times.append((time.perf_counter() - t0) * 1e3)
-    return statistics.median(times)
+        a = chain(a, fn(*a))
+    _fence(a)
+    return (time.perf_counter() - t0) * 1e3 / iters
 
 
 def bench_lrn(records):
@@ -71,8 +85,12 @@ def bench_lrn(records):
         vjp = jax.jit(lambda x, g, f=fwd: jax.vjp(f, x)[1](g)[0])
         try:
             results[impl] = {
-                "fwd_ms": round(_time_fn(fwd, (x,)), 3),
-                "bwd_ms": round(_time_fn(vjp, (x, grads)), 3),
+                # fwd: feed the (shape-preserving) output back in; bwd:
+                # feed dx back as x, keeping the cotangent fixed
+                "fwd_ms": round(_time_fn(fwd, (x,),
+                                         lambda a, out: (out,)), 3),
+                "bwd_ms": round(_time_fn(vjp, (x, grads),
+                                         lambda a, out: (out, a[1])), 3),
             }
         except Exception as e:
             results[impl] = {"error": repr(e)[:300]}
@@ -100,8 +118,14 @@ def bench_flash(records):
         vjp = jax.jit(lambda q, k, v, g, f=fwd: jax.vjp(f, q, k, v)[1](g))
         try:
             results[impl] = {
-                "fwd_ms": round(_time_fn(fwd, (q, k, v)), 3),
-                "bwd_ms": round(_time_fn(vjp, (q, k, v, g)), 3),
+                # fwd output has q's shape -> chain it into q; bwd
+                # (dq, dk, dv) chain into (q, k, v), cotangent fixed
+                "fwd_ms": round(_time_fn(
+                    fwd, (q, k, v),
+                    lambda a, out: (out, a[1], a[2])), 3),
+                "bwd_ms": round(_time_fn(
+                    vjp, (q, k, v, g),
+                    lambda a, out: (out[0], out[1], out[2], a[3])), 3),
             }
         except Exception as e:
             results[impl] = {"error": repr(e)[:300]}

@@ -26,8 +26,12 @@ def run_variant(dtype: str, batch: int, timeout: int = 900) -> dict:
     # sweep variants are single measurements: no per-variant extra
     # protocol, and a wedged tunnel should fail the variant after one
     # probe attempt instead of eating the timeout in retries
+    # RECORD_LAST=0: sweep variants must not overwrite the headline
+    # config's last-good evidence file (bench.py's partial_record
+    # fallback matches it by metric+dtype)
     env = dict(os.environ, SPARKNET_BENCH_DTYPE=dtype,
-               SPARKNET_BENCH_BATCH=str(batch), SPARKNET_BENCH_EXTRA="0")
+               SPARKNET_BENCH_BATCH=str(batch), SPARKNET_BENCH_EXTRA="0",
+               SPARKNET_BENCH_RECORD_LAST="0")
     env.setdefault("SPARKNET_BENCH_PROBE_ATTEMPTS", "1")
     try:
         out = subprocess.run(
