@@ -244,6 +244,9 @@ def record_last_good(rec: dict) -> None:
     # moment, and a half-written last-good file would silently destroy the
     # very evidence this file exists to preserve
     try:
+        rec = dict(rec)
+        rec["recorded_utc"] = time.strftime(
+            "%Y-%m-%d %H:%M:%SZ", time.gmtime())
         tmp = LAST_GOOD_PATH + ".tmp"
         with open(tmp, "w") as f:
             json.dump(rec, f, indent=1)
