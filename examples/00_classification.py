@@ -1,0 +1,102 @@
+"""Classifying images with a trained net — the reference's
+00-classification notebook (ref: caffe/examples/00-classification.ipynb),
+TPU-native and self-contained.
+
+The notebook downloads CaffeNet weights and classifies a cat through
+``caffe.Classifier`` (deploy prototxt + .caffemodel, 10-crop oversample).
+Zero-egress equivalent: train cifar10_quick on a synthetic 10-class
+image task, snapshot a ``.caffemodel``, then load it back through
+:class:`sparknet_tpu.models.classifier.Classifier` — same deploy-time
+surface (deploy prototxt with net-level inputs, Transformer
+preprocessing, center-crop vs 10-crop oversampled prediction).
+
+Run:  python examples/00_classification.py  [--platform cpu]
+"""
+
+import sys
+
+import numpy as np
+
+if "--platform" in sys.argv:
+    import jax
+
+    jax.config.update("jax_platforms", sys.argv[sys.argv.index("--platform") + 1])
+
+from sparknet_tpu import models
+from sparknet_tpu.models.classifier import Classifier
+from sparknet_tpu.net import TPUNet
+from sparknet_tpu.proto import parse
+
+# Deploy variant of cifar10_quick: net-level inputs + Softmax head, layer
+# names matching the train net so the caffemodel params map by name (the
+# notebook's deploy.prototxt plays this role for CaffeNet).
+DEPLOY = """
+name: "CIFAR10_quick_deploy"
+input: "data"
+input_dim: 10 input_dim: 3 input_dim: 32 input_dim: 32
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 32 kernel_size: 5 pad: 2 } }
+layer { name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 3 stride: 2 } }
+layer { name: "relu1" type: "ReLU" bottom: "pool1" top: "pool1" }
+layer { name: "conv2" type: "Convolution" bottom: "pool1" top: "conv2"
+  convolution_param { num_output: 32 kernel_size: 5 pad: 2 } }
+layer { name: "relu2" type: "ReLU" bottom: "conv2" top: "conv2" }
+layer { name: "pool2" type: "Pooling" bottom: "conv2" top: "pool2"
+  pooling_param { pool: AVE kernel_size: 3 stride: 2 } }
+layer { name: "conv3" type: "Convolution" bottom: "pool2" top: "conv3"
+  convolution_param { num_output: 64 kernel_size: 5 pad: 2 } }
+layer { name: "relu3" type: "ReLU" bottom: "conv3" top: "conv3" }
+layer { name: "pool3" type: "Pooling" bottom: "conv3" top: "pool3"
+  pooling_param { pool: AVE kernel_size: 3 stride: 2 } }
+layer { name: "ip1" type: "InnerProduct" bottom: "pool3" top: "ip1"
+  inner_product_param { num_output: 64 } }
+layer { name: "ip2" type: "InnerProduct" bottom: "ip1" top: "ip2"
+  inner_product_param { num_output: 10 } }
+layer { name: "prob" type: "Softmax" bottom: "ip2" top: "prob" }
+"""
+
+
+def make_images(n, seed):
+    """(H, W, C) float images at raw-pixel scale: class k brightens one
+    8x8 block (see the fillers' raw-pixel calibration in the zoo)."""
+    rs = np.random.RandomState(seed)
+    y = rs.randint(0, 10, n)
+    x = rs.randn(n, 32, 32, 3).astype(np.float32) * 40.0
+    for i, k in enumerate(y):
+        x[i, (k % 4) * 8 : (k % 4) * 8 + 8, (k // 4) * 8 : (k // 4) * 8 + 8, :] += 80.0
+    return x, y
+
+
+def train_batches(batch=100, seed=0):
+    while True:
+        seed += 1
+        x, y = make_images(batch, seed)
+        yield {"data": x.transpose(0, 3, 1, 2), "label": y.astype(np.int32)}
+
+
+def main():
+    # -- train + snapshot (the notebook's "download pretrained weights") --
+    net = TPUNet(models.cifar10_quick_solver(), models.cifar10_quick(batch=100))
+    net.set_train_data(train_batches())
+    net.train(150)
+    path = net.save_caffemodel("/tmp/cifar10_quick_example.caffemodel")
+    print("snapshotted:", path)
+
+    # -- deploy-time classification, pycaffe Classifier surface --
+    clf = Classifier(parse(DEPLOY), pretrained_file=path)
+    images, labels = make_images(50, seed=999)
+
+    center = clf.predict(list(images), oversample=False)
+    ten_crop = clf.predict(list(images), oversample=True)
+    for name, probs in (("center-crop", center), ("10-crop", ten_crop)):
+        assert probs.shape == (50, 10)
+        assert np.allclose(probs.sum(1), 1.0, atol=1e-3)  # softmax rows
+        acc = float((probs.argmax(1) == labels).mean())
+        print(f"{name} accuracy on held-out images: {acc:.2f}")
+        assert acc > 0.5, f"deploy-time {name} accuracy stuck at {acc}"
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

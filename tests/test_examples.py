@@ -14,7 +14,9 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # per-script timeout: the distributed walkthrough compiles three
 # shard_map programs on an 8-device host mesh (~6 min locally)
 SCRIPTS = {
+    "00_classification.py": 560,
     "01_learning_lenet.py": 560,
+    "07_siamese.py": 560,
     "02_brewing_logreg.py": 560,
     "03_fine_tuning.py": 560,
     "net_surgery.py": 560,
