@@ -1,0 +1,331 @@
+"""Differential testing against torch (CPU) as an independent oracle.
+
+The reference validates layer math with hand-derived GradientChecker
+bounds (ref: caffe/src/caffe/test/test_convolution_layer.cpp et al.);
+gradient checks here live in test_gradients.py.  This file adds what the
+reference could not: a second, independently-implemented framework
+computing the same math.  Each case runs a sparknet_tpu op and the
+equivalent torch functional on identical inputs/weights and requires
+agreement to float32 tolerance — catching semantic drift (layout, group
+handling, normalization constants) that self-consistent gradient checks
+cannot see.
+
+Only configurations whose semantics are *defined identically* in both
+frameworks are compared (e.g. AVE pooling is compared on exact-tiling
+windows: Caffe's padded-divisor edge rule intentionally differs from
+torch's and is pinned by the Caffe-semantics tests in test_compiler.py).
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from sparknet_tpu.common import Phase  # noqa: E402
+from sparknet_tpu.ops import create_layer  # noqa: E402
+from sparknet_tpu.proto import parse  # noqa: E402
+
+
+def make_layer(prototxt: str, phase=Phase.TRAIN):
+    msg = parse(prototxt)
+    return create_layer(msg.get_all("layer")[0], phase)
+
+
+def apply_layer(layer, params, inputs):
+    out = layer.apply(
+        [jnp.asarray(p) for p in params],
+        {},
+        [jnp.asarray(x) for x in inputs],
+        train=False,
+        rng=jax.random.key(0),
+    )
+    return [np.asarray(o) for o in out.outputs]
+
+
+def t(x):
+    return torch.from_numpy(np.asarray(x))
+
+
+ATOL = 2e-4  # f32 accumulation-order noise across two frameworks
+RTOL = 2e-4
+
+
+class TestConvolution:
+    @pytest.mark.parametrize(
+        "stride,pad,group,dilation",
+        [(1, 0, 1, 1), (2, 1, 1, 1), (1, 2, 1, 2), (1, 1, 2, 1), (3, 2, 4, 1)],
+    )
+    def test_forward(self, rng, stride, pad, group, dilation):
+        n, cin, cout, k = 2, 8, 12, 3
+        x = rng.randn(n, cin, 12, 10).astype(np.float32)
+        w = rng.randn(cout, cin // group, k, k).astype(np.float32)
+        b = rng.randn(cout).astype(np.float32)
+        layer = make_layer(
+            f"""layer {{ name: "c" type: "Convolution" bottom: "x" top: "y"
+              convolution_param {{ num_output: {cout} kernel_size: {k}
+                stride: {stride} pad: {pad} group: {group}
+                dilation: {dilation} }} }}"""
+        )
+        (ours,) = apply_layer(layer, [w, b], [x])
+        theirs = F.conv2d(
+            t(x), t(w), t(b), stride=stride, padding=pad,
+            dilation=dilation, groups=group,
+        ).numpy()
+        np.testing.assert_allclose(ours, theirs, atol=ATOL, rtol=RTOL)
+
+    def test_grad_wrt_input_and_weight(self, rng):
+        n, cin, cout, k = 2, 4, 6, 3
+        x = rng.randn(n, cin, 8, 8).astype(np.float32)
+        w = rng.randn(cout, cin, k, k).astype(np.float32)
+        b = rng.randn(cout).astype(np.float32)
+        layer = make_layer(
+            f"""layer {{ name: "c" type: "Convolution" bottom: "x" top: "y"
+              convolution_param {{ num_output: {cout} kernel_size: {k}
+                stride: 1 pad: 1 }} }}"""
+        )
+
+        def loss(xa, wa, ba):
+            out = layer.apply(
+                [wa, ba], {}, [xa], train=True, rng=jax.random.key(0)
+            )
+            return jnp.sum(out.outputs[0] ** 2)
+
+        gx, gw, gb = jax.grad(loss, argnums=(0, 1, 2))(
+            jnp.asarray(x), jnp.asarray(w), jnp.asarray(b)
+        )
+
+        xt, wt, bt = t(x).requires_grad_(), t(w).requires_grad_(), t(b).requires_grad_()
+        F.conv2d(xt, wt, bt, stride=1, padding=1).pow(2).sum().backward()
+        np.testing.assert_allclose(np.asarray(gx), xt.grad.numpy(), atol=1e-3, rtol=1e-3)
+        np.testing.assert_allclose(np.asarray(gw), wt.grad.numpy(), atol=1e-3, rtol=1e-3)
+        np.testing.assert_allclose(np.asarray(gb), bt.grad.numpy(), atol=1e-3, rtol=1e-3)
+
+
+class TestDeconvolution:
+    def test_forward(self, rng):
+        n, cin, cout, k, stride = 2, 6, 4, 4, 2
+        x = rng.randn(n, cin, 5, 7).astype(np.float32)
+        # Caffe deconv weight (in, out/group, kh, kw) == torch conv_transpose2d
+        w = rng.randn(cin, cout, k, k).astype(np.float32)
+        b = rng.randn(cout).astype(np.float32)
+        layer = make_layer(
+            f"""layer {{ name: "d" type: "Deconvolution" bottom: "x" top: "y"
+              convolution_param {{ num_output: {cout} kernel_size: {k}
+                stride: {stride} pad: 1 }} }}"""
+        )
+        (ours,) = apply_layer(layer, [w, b], [x])
+        theirs = F.conv_transpose2d(t(x), t(w), t(b), stride=stride, padding=1).numpy()
+        np.testing.assert_allclose(ours, theirs, atol=ATOL, rtol=RTOL)
+
+
+class TestPooling:
+    @pytest.mark.parametrize("pad", [0, 1])
+    def test_max_ceil_mode(self, rng, pad):
+        # Caffe pooling is always ceil-mode; torch matches with
+        # ceil_mode=True (both clip windows to the real input for MAX)
+        x = rng.randn(2, 3, 9, 11).astype(np.float32)
+        layer = make_layer(
+            f"""layer {{ name: "p" type: "Pooling" bottom: "x" top: "y"
+              pooling_param {{ pool: MAX kernel_size: 3 stride: 2 pad: {pad} }} }}"""
+        )
+        (ours,) = apply_layer(layer, [], [x])
+        theirs = F.max_pool2d(
+            t(x), kernel_size=3, stride=2, padding=pad, ceil_mode=True
+        ).numpy()
+        np.testing.assert_allclose(ours, theirs, atol=ATOL, rtol=RTOL)
+
+    def test_ave_exact_tiling(self, rng):
+        # exact-tiling window: no edge/padding divisor ambiguity between
+        # the two frameworks' AVE rules
+        x = rng.randn(2, 4, 8, 8).astype(np.float32)
+        layer = make_layer(
+            """layer { name: "p" type: "Pooling" bottom: "x" top: "y"
+              pooling_param { pool: AVE kernel_size: 2 stride: 2 } }"""
+        )
+        (ours,) = apply_layer(layer, [], [x])
+        theirs = F.avg_pool2d(t(x), kernel_size=2, stride=2).numpy()
+        np.testing.assert_allclose(ours, theirs, atol=ATOL, rtol=RTOL)
+
+    def test_global_ave(self, rng):
+        x = rng.randn(2, 5, 7, 7).astype(np.float32)
+        layer = make_layer(
+            """layer { name: "p" type: "Pooling" bottom: "x" top: "y"
+              pooling_param { pool: AVE global_pooling: true } }"""
+        )
+        (ours,) = apply_layer(layer, [], [x])
+        theirs = F.adaptive_avg_pool2d(t(x), 1).numpy()
+        np.testing.assert_allclose(ours, theirs, atol=ATOL, rtol=RTOL)
+
+
+class TestLRN:
+    def test_across_channels(self, rng):
+        # both define: x / (k + alpha/n * sum_window x^2)^beta
+        x = rng.randn(2, 16, 6, 6).astype(np.float32)
+        layer = make_layer(
+            """layer { name: "l" type: "LRN" bottom: "x" top: "y"
+              lrn_param { local_size: 5 alpha: 0.0001 beta: 0.75 k: 2.0 } }"""
+        )
+        (ours,) = apply_layer(layer, [], [x])
+        theirs = F.local_response_norm(
+            t(x), size=5, alpha=1e-4, beta=0.75, k=2.0
+        ).numpy()
+        np.testing.assert_allclose(ours, theirs, atol=ATOL, rtol=RTOL)
+
+
+class TestInnerProductAndLosses:
+    def test_inner_product(self, rng):
+        x = rng.randn(4, 3, 4, 4).astype(np.float32)
+        w = rng.randn(10, 48).astype(np.float32)
+        b = rng.randn(10).astype(np.float32)
+        layer = make_layer(
+            """layer { name: "ip" type: "InnerProduct" bottom: "x" top: "y"
+              inner_product_param { num_output: 10 } }"""
+        )
+        (ours,) = apply_layer(layer, [w, b], [x])
+        # Caffe flattens NCHW trailing axes; torch .view(N, -1) is the same
+        theirs = F.linear(t(x).view(4, -1), t(w), t(b)).numpy()
+        np.testing.assert_allclose(ours, theirs, atol=ATOL, rtol=RTOL)
+
+    def test_softmax_with_loss(self, rng):
+        logits = rng.randn(8, 10).astype(np.float32)
+        labels = rng.randint(0, 10, 8).astype(np.int32)
+        layer = make_layer(
+            """layer { name: "loss" type: "SoftmaxWithLoss"
+              bottom: "ip" bottom: "label" top: "loss" }"""
+        )
+        (ours,) = apply_layer(layer, [], [logits, labels])
+        theirs = F.cross_entropy(t(logits), t(labels).long()).item()
+        np.testing.assert_allclose(float(ours), theirs, atol=ATOL, rtol=RTOL)
+
+    def test_softmax_loss_grad(self, rng):
+        logits = rng.randn(8, 10).astype(np.float32)
+        labels = rng.randint(0, 10, 8).astype(np.int32)
+        layer = make_layer(
+            """layer { name: "loss" type: "SoftmaxWithLoss"
+              bottom: "ip" bottom: "label" top: "loss" }"""
+        )
+
+        def loss(la):
+            out = layer.apply([], {}, [la, jnp.asarray(labels)],
+                              train=True, rng=jax.random.key(0))
+            return out.outputs[0].reshape(())
+
+        g = jax.grad(loss)(jnp.asarray(logits))
+        lt = t(logits).requires_grad_()
+        F.cross_entropy(lt, t(labels).long()).backward()
+        np.testing.assert_allclose(np.asarray(g), lt.grad.numpy(),
+                                   atol=1e-5, rtol=1e-4)
+
+    def test_sigmoid_cross_entropy(self, rng):
+        # Caffe normalizes the summed elementwise BCE by batch size
+        # (ref: sigmoid_cross_entropy_loss_layer.cpp)
+        logits = rng.randn(6, 5).astype(np.float32)
+        targets = (rng.rand(6, 5) > 0.5).astype(np.float32)
+        layer = make_layer(
+            """layer { name: "loss" type: "SigmoidCrossEntropyLoss"
+              bottom: "x" bottom: "t" top: "loss" }"""
+        )
+        (ours,) = apply_layer(layer, [], [logits, targets])
+        theirs = (
+            F.binary_cross_entropy_with_logits(
+                t(logits), t(targets), reduction="sum"
+            ).item() / 6
+        )
+        np.testing.assert_allclose(float(ours), theirs, atol=ATOL, rtol=RTOL)
+
+    def test_euclidean_loss(self, rng):
+        # Caffe: sum((a-b)^2) / (2*N)
+        a = rng.randn(4, 7).astype(np.float32)
+        b = rng.randn(4, 7).astype(np.float32)
+        layer = make_layer(
+            """layer { name: "loss" type: "EuclideanLoss"
+              bottom: "a" bottom: "b" top: "loss" }"""
+        )
+        (ours,) = apply_layer(layer, [], [a, b])
+        theirs = F.mse_loss(t(a), t(b), reduction="sum").item() / (2 * 4)
+        np.testing.assert_allclose(float(ours), theirs, atol=ATOL, rtol=RTOL)
+
+
+class _TorchLeNet(torch.nn.Module):
+    """torch twin of models.lenet (ref: caffe/examples/mnist/lenet.prototxt)."""
+
+    def __init__(self):
+        super().__init__()
+        self.conv1 = torch.nn.Conv2d(1, 20, 5)
+        self.conv2 = torch.nn.Conv2d(20, 50, 5)
+        self.ip1 = torch.nn.Linear(50 * 4 * 4, 500)
+        self.ip2 = torch.nn.Linear(500, 10)
+
+    def forward(self, x):
+        x = F.max_pool2d(self.conv1(x), 2, 2, ceil_mode=True)
+        x = F.max_pool2d(self.conv2(x), 2, 2, ceil_mode=True)
+        x = F.relu(self.ip1(x.view(x.shape[0], -1)))
+        return self.ip2(x)
+
+
+class TestLeNetEndToEnd:
+    """Whole-model twin test: same weights, same input -> same logits,
+    same loss, same parameter gradients (the strongest cross-framework
+    statement: every layer, the flatten boundary, and autodiff agree)."""
+
+    def _build(self, rng):
+        from sparknet_tpu import models
+        from sparknet_tpu.compiler.graph import Network
+
+        net = Network(models.lenet(batch=4), Phase.TRAIN)
+        variables = net.init(jax.random.key(3))
+
+        tnet = _TorchLeNet()
+        with torch.no_grad():
+            for name, mod in (
+                ("conv1", tnet.conv1), ("conv2", tnet.conv2),
+                ("ip1", tnet.ip1), ("ip2", tnet.ip2),
+            ):
+                w, b = variables.params[name]
+                mod.weight.copy_(t(np.asarray(w)))
+                mod.bias.copy_(t(np.asarray(b)))
+        return net, variables, tnet
+
+    def test_forward_loss_and_grads(self, rng):
+        net, variables, tnet = self._build(rng)
+        x = rng.randn(4, 1, 28, 28).astype(np.float32)
+        y = rng.randint(0, 10, 4).astype(np.int32)
+        feeds = {"data": jnp.asarray(x), "label": jnp.asarray(y)}
+
+        from sparknet_tpu.compiler.graph import NetVars
+
+        def loss_fn(params):
+            v = NetVars(params, variables.state)
+            blobs, _, loss = net.apply(v, feeds, rng=jax.random.key(0),
+                                       train=True)
+            return loss.reshape(()), blobs["ip2"]
+
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            variables.params
+        )
+
+        xt = t(x)
+        tl = tnet(xt)
+        tloss = F.cross_entropy(tl, t(y).long())
+        tloss.backward()
+
+        np.testing.assert_allclose(np.asarray(logits), tl.detach().numpy(),
+                                   atol=1e-3, rtol=1e-3)
+        np.testing.assert_allclose(float(loss), tloss.item(), atol=1e-4, rtol=1e-4)
+        for name, mod in (
+            ("conv1", tnet.conv1), ("conv2", tnet.conv2),
+            ("ip1", tnet.ip1), ("ip2", tnet.ip2),
+        ):
+            gw, gb = grads[name]
+            np.testing.assert_allclose(
+                np.asarray(gw), mod.weight.grad.numpy(), atol=1e-3, rtol=1e-3,
+                err_msg=f"{name} weight grad",
+            )
+            np.testing.assert_allclose(
+                np.asarray(gb), mod.bias.grad.numpy(), atol=1e-3, rtol=1e-3,
+                err_msg=f"{name} bias grad",
+            )
