@@ -145,6 +145,13 @@ def _build_step(batch: int, model: str, crop: int, dtype_name: str):
 
     net_param = getattr(models, model)(batch)
     solver_cfg = getattr(models, f"{model}_solver")()
+    # A/B knob: the bf16 step is HBM-bound (the roofline's bytes term
+    # dominates), so recomputing activations under grad can trade cheap
+    # MXU flops for traffic. Off by default — flip on to measure.
+    if os.environ.get("SPARKNET_BENCH_REMAT", "0") == "1":
+        import dataclasses
+
+        solver_cfg = dataclasses.replace(solver_cfg, remat=True)
     solver = Solver(solver_cfg, net_param)
     step, variables, slots, key = solver.jitted_train_step(donate=True)
 
