@@ -15,6 +15,7 @@ never sits on the jitted step's critical path.
 
 from sparknet_tpu.data.cifar import CifarLoader  # noqa: F401
 from sparknet_tpu.data.sampler import MinibatchSampler  # noqa: F401
+from sparknet_tpu.data.device_transform import DeviceAugment  # noqa: F401
 from sparknet_tpu.data.transform import DataTransformer, TransformConfig  # noqa: F401
 from sparknet_tpu.data.minibatch import (  # noqa: F401
     compute_mean,

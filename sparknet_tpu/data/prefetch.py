@@ -37,10 +37,17 @@ class DevicePrefetcher:
         sharding=None,
         depth: int = PREFETCH_COUNT,
         start_iter: int = 0,
+        device_fn: Callable[[dict[str, Any], int], dict[str, Any]] | None = None,
     ):
+        """``device_fn(feeds, it)`` post-processes device-resident feeds —
+        e.g. :class:`~sparknet_tpu.data.device_transform.DeviceAugment`
+        so the host ships uint8 and the crop/mirror/mean run in XLA.  The
+        worker thread only *dispatches* it (async), so it overlaps the
+        previous step's compute like the transfer does."""
         self._data_fn = data_fn
         self._num = num_iters
         self._sharding = sharding
+        self._device_fn = device_fn
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._err: BaseException | None = None
         self._start = start_iter
@@ -62,6 +69,8 @@ class DevicePrefetcher:
                     }
                 else:
                     feeds = jax.device_put(feeds)
+                if self._device_fn is not None:
+                    feeds = self._device_fn(feeds, it)
                 if not self._put(feeds):
                     return
             self._put(_DONE)

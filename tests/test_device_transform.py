@@ -1,0 +1,103 @@
+"""DeviceAugment: the on-device (XLA) twin of the host DataTransformer.
+
+TEST mode must be bit-identical to the host path; TRAIN mode must draw
+from exactly the space of valid (offset, flip) crops with the same
+mean→crop→mirror→scale order (ref: data_transformer.cpp:19-119).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparknet_tpu.data.device_transform import DeviceAugment
+from sparknet_tpu.data.prefetch import DevicePrefetcher
+from sparknet_tpu.data.transform import DataTransformer, TransformConfig
+
+
+@pytest.fixture
+def u8_batch(rng):
+    return (rng.rand(6, 3, 12, 10) * 255).astype(np.uint8)
+
+
+def test_test_mode_matches_host_exactly(u8_batch, rng):
+    mean = rng.rand(3, 12, 10).astype(np.float32) * 100
+    cfg = TransformConfig(crop_size=8, mirror=True, mean_image=mean, scale=0.5)
+    host = DataTransformer(cfg)(u8_batch, train=False)
+    dev = DeviceAugment(cfg)(jnp.asarray(u8_batch), jax.random.key(0),
+                             train=False)
+    np.testing.assert_allclose(np.asarray(dev), host, atol=1e-5, rtol=1e-6)
+
+
+def test_test_mode_mean_value(u8_batch):
+    cfg = TransformConfig(crop_size=6, mean_value=(10.0, 20.0, 30.0))
+    host = DataTransformer(cfg)(u8_batch, train=False)
+    dev = DeviceAugment(cfg)(jnp.asarray(u8_batch), jax.random.key(1),
+                             train=False)
+    np.testing.assert_allclose(np.asarray(dev), host, atol=1e-5, rtol=1e-6)
+
+
+def test_train_outputs_are_valid_crops(rng):
+    """Every TRAIN sample must equal some (offset, flip) window of the
+    mean-subtracted input — the exact candidate space of the host path."""
+    x = (rng.rand(8, 2, 6, 7) * 255).astype(np.uint8)
+    cfg = TransformConfig(crop_size=4, mirror=True)
+    out = np.asarray(DeviceAugment(cfg)(jnp.asarray(x), jax.random.key(7)))
+    xf = x.astype(np.float32)
+    for i in range(len(x)):
+        candidates = []
+        for ho in range(6 - 4 + 1):
+            for wo in range(7 - 4 + 1):
+                win = xf[i, :, ho : ho + 4, wo : wo + 4]
+                candidates.append(win)
+                candidates.append(win[:, :, ::-1])
+        assert any(np.allclose(out[i], w, atol=1e-4) for w in candidates), i
+
+
+def test_mirror_statistics_and_correctness(rng):
+    x = (rng.rand(512, 1, 4, 4) * 255).astype(np.uint8)
+    cfg = TransformConfig(mirror=True)
+    out = np.asarray(DeviceAugment(cfg)(jnp.asarray(x), jax.random.key(3)))
+    xf = x.astype(np.float32)
+    flipped = np.array(
+        [not np.allclose(out[i], xf[i]) for i in range(len(x))]
+    )
+    assert 0.3 < flipped.mean() < 0.7  # fair coin
+    for i in np.where(flipped)[0][:16]:
+        np.testing.assert_allclose(out[i], xf[i, :, :, ::-1], atol=1e-5)
+
+
+def test_jit_and_dtype(u8_batch):
+    cfg = TransformConfig(crop_size=8, mirror=True)
+    aug = DeviceAugment(cfg)
+    f = jax.jit(lambda x, k: aug(x, k, train=True))
+    y = f(jnp.asarray(u8_batch), jax.random.key(0))
+    assert y.shape == (6, 3, 8, 8) and y.dtype == jnp.float32
+
+
+def test_rejects_native_backend_and_double_mean(rng):
+    with pytest.raises(ValueError, match="backend"):
+        DeviceAugment(TransformConfig(backend="native"))
+    with pytest.raises(ValueError, match="not both"):
+        DeviceAugment(TransformConfig(mean_value=(1.0,),
+                                      mean_image=np.zeros((1, 2, 2), np.float32)))
+
+
+def test_prefetcher_device_fn_integration(rng):
+    """uint8 host batches -> device_put -> DeviceAugment in the worker."""
+    batches = [(rng.rand(4, 3, 10, 10) * 255).astype(np.uint8)
+               for _ in range(3)]
+    aug = DeviceAugment(TransformConfig(crop_size=8, mirror=True))
+    fetcher = DevicePrefetcher(
+        lambda it: {"data": batches[it]},
+        num_iters=3,
+        device_fn=lambda feeds, it: {
+            "data": aug(feeds["data"], jax.random.key(it))
+        },
+    )
+    with fetcher:
+        got = list(fetcher)
+    assert len(got) == 3
+    for feeds in got:
+        assert feeds["data"].shape == (4, 3, 8, 8)
+        assert feeds["data"].dtype == jnp.float32
