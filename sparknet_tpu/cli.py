@@ -56,6 +56,12 @@ def _data_fns(args, net):
     synthetic stream seeds per process."""
     import jax
 
+    if (getattr(args, "augment", "host") == "device"
+            and not args.data.startswith("cifar:")):
+        raise SystemExit(
+            "--augment device is currently wired to the cifar: source "
+            "(other sources transform on the host)")
+
     pid, nproc = jax.process_index(), jax.process_count()
 
     if args.data == "proto":
@@ -112,7 +118,8 @@ def _data_fns(args, net):
         from sparknet_tpu.data import CifarLoader, DataTransformer, TransformConfig
 
         loader = CifarLoader(args.data[6:])
-        xform = DataTransformer(TransformConfig(mean_image=loader.mean_image))
+        xform_cfg = TransformConfig(mean_image=loader.mean_image)
+        xform = DataTransformer(xform_cfg)
         xtr, ytr = loader.train_images, loader.train_labels
         xte, yte = loader.test_images, loader.test_labels
 
@@ -120,12 +127,47 @@ def _data_fns(args, net):
             raise SystemExit(
                 f"--batch {batch} exceeds dataset size {min(len(ytr), len(yte))}")
 
-        def train_fn(it):
-            lo = ((it * nproc + pid) * batch) % (len(ytr) - batch + 1)
-            return {
-                "data": xform(xtr[lo : lo + batch], True),
-                "label": ytr[lo : lo + batch].astype(np.int32),
+        if getattr(args, "augment", "host") == "device":
+            # ship raw uint8 over the feed link; mean-subtract runs
+            # in-graph via DeviceAugment in the prefetcher's device_fn
+            # (4x fewer host->HBM bytes than f32 feeds)
+            if getattr(args, "prefetch", 0) <= 0:
+                raise SystemExit(
+                    "--augment device rides the async feed: pass "
+                    "--prefetch N (the DeviceAugment dispatch belongs on "
+                    "the prefetch thread, not the step loop)")
+            if (getattr(args, "tau", 1) > 1
+                    or getattr(args, "distributed", False)
+                    or getattr(args, "elastic_alpha", 0.0) > 0):
+                raise SystemExit(
+                    "--augment device is wired to the single-replica "
+                    "prefetch path; the distributed trainer packs its "
+                    "own tau feeds (use --augment host there)")
+            import jax as _jax
+
+            from sparknet_tpu.data import DeviceAugment
+
+            aug = DeviceAugment(xform_cfg)
+            base_key = _jax.random.key(getattr(args, "seed", 0) or 0)
+
+            def train_fn(it):
+                lo = ((it * nproc + pid) * batch) % (len(ytr) - batch + 1)
+                return {
+                    "data": xtr[lo : lo + batch],
+                    "label": ytr[lo : lo + batch].astype(np.int32),
+                }
+
+            train_fn.device_fn = lambda feeds, it: {
+                **feeds,
+                "data": aug(feeds["data"], _jax.random.fold_in(base_key, it)),
             }
+        else:
+            def train_fn(it):
+                lo = ((it * nproc + pid) * batch) % (len(ytr) - batch + 1)
+                return {
+                    "data": xform(xtr[lo : lo + batch], True),
+                    "label": ytr[lo : lo + batch].astype(np.int32),
+                }
 
         def test_fn(b):
             # eval streams stay IDENTICAL across processes (only training
@@ -382,6 +424,7 @@ def cmd_train(args) -> int:
                 pf_ctx = DevicePrefetcher(
                     train_fn, iters, depth=args.prefetch,
                     start_iter=solver.iter,
+                    device_fn=getattr(train_fn, "device_fn", None),
                 )
                 pf_iter = iter(pf_ctx)
 
@@ -1169,6 +1212,11 @@ def main(argv=None) -> int:
     sp.add_argument("--prefetch", type=int, default=0,
                     help="async device-feed queue depth (0 = off; the "
                     "reference's PREFETCH_COUNT is 3)")
+    sp.add_argument("--augment", choices=["host", "device"], default="host",
+                    help="where the data transform runs: host (numpy/C++ "
+                    "DataTransformer) or device (ship uint8, "
+                    "mean/crop/mirror in XLA via DeviceAugment; requires "
+                    "--prefetch; cifar: source)")
     sp.add_argument("--distributed", action="store_true", help="use the device mesh")
     sp.add_argument("--elastic-alpha", type=float, default=0.0,
                     help="EASGD coupling strength (~0.9/num_workers); "

@@ -267,6 +267,36 @@ def test_cli_train_cifar_tau(cifar_dir, tmp_path, monkeypatch):
     assert rc == 0
 
 
+def test_cli_train_cifar_device_augment(cifar_dir, tmp_path, monkeypatch):
+    """--augment device: uint8 over the feed link, mean-subtract in XLA
+    on the prefetch thread (DeviceAugment via device_fn)."""
+    from sparknet_tpu.cli import main
+
+    monkeypatch.chdir(tmp_path)
+    rc = main([
+        "train", "--solver", "zoo:cifar10_quick", "--batch", "4",
+        "--data", f"cifar:{cifar_dir}", "--iterations", "4",
+        "--prefetch", "2", "--augment", "device",
+    ])
+    assert rc == 0
+
+
+def test_cli_device_augment_guards(cifar_dir, tmp_path, monkeypatch):
+    from sparknet_tpu.cli import main
+
+    monkeypatch.chdir(tmp_path)
+    base = ["train", "--solver", "zoo:cifar10_quick", "--batch", "4",
+            "--iterations", "2"]
+    with pytest.raises(SystemExit, match="--prefetch"):
+        main(base + ["--data", f"cifar:{cifar_dir}", "--augment", "device"])
+    with pytest.raises(SystemExit, match="cifar"):
+        main(base + ["--data", "synthetic", "--prefetch", "2",
+                     "--augment", "device"])
+    with pytest.raises(SystemExit, match="distributed"):
+        main(base + ["--data", f"cifar:{cifar_dir}", "--prefetch", "2",
+                     "--augment", "device", "--tau", "2"])
+
+
 def test_cli_time_lenet(capsys):
     from sparknet_tpu.cli import main
 
