@@ -771,10 +771,18 @@ def cmd_extract_features(args) -> int:
 
     net_param, solver_cfg = _build_net_and_solver(args)
     net = TPUNet(solver_cfg, net_param)
+    if args.snapshot and getattr(args, "weights", ""):
+        raise SystemExit("--snapshot and --weights are mutually exclusive")
     if args.snapshot:
         # --snapshot is a .solverstate.npz (what `train --output` writes);
         # restore via the solver, like cmd_train/cmd_test
         net.solver.restore(args.snapshot)
+    elif getattr(args, "weights", ""):
+        # the reference tool takes a .caffemodel directly
+        # (extract_features.cpp: pretrained_net_param argv)
+        _load_weights_into(
+            net.solver, args.weights, strict_shapes=True, require_match=True
+        )
     _, test_fn = _data_fns(args, net.test_net)
     app = FeaturizerApp(net, feature_blob=args.blob)
     feats = list(
@@ -1277,6 +1285,9 @@ def main(argv=None) -> int:
     common(sp)
     sp.add_argument("--blob", required=True, help="blob name, e.g. ip1")
     sp.add_argument("--out", required=True, help="output .npy")
+    sp.add_argument("--weights", default="",
+                    help=".caffemodel/.h5 to score with (the reference "
+                    "tool's pretrained_net_param argument)")
     sp.set_defaults(fn=cmd_extract_features)
 
     sp = sub.add_parser("draw", help="net prototxt -> Graphviz DOT")

@@ -690,6 +690,17 @@ def test_cli_test_weights(tmp_path, monkeypatch, capsys):
               "--data", "synthetic", "--snapshot", "m.solverstate.npz",
               "--weights", "m.caffemodel"])
 
+    # extract_features from the same caffemodel (the reference tool's
+    # pretrained_net_param argument, extract_features.cpp)
+    capsys.readouterr()
+    assert main([
+        "extract_features", "--solver", "zoo:lenet", "--batch", "8",
+        "--data", "synthetic", "--iterations", "2",
+        "--weights", "m.caffemodel", "--blob", "ip1", "--out", "feats.npy",
+    ]) == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["shape"] == [16, 500]  # 2 batches x 8, ip1 width
+
 
 def test_cli_bench_brew(capsys, monkeypatch):
     """tpunet bench: the headline benchmark as a brew (one JSON line)."""
