@@ -36,15 +36,107 @@ def _build_net_and_solver(args):
         solver_cfg = getattr(models, f"{name}_solver")()
         return net_param, solver_cfg
     solver_msg = parse_file(args.solver)
-    net_param = load_solver_net(solver_msg, root="")
+    net_param = load_solver_net(solver_msg, root=_net_root(solver_msg, args.solver))
     return net_param, SolverConfig.from_proto(solver_msg)
 
 
-def _feed_shapes(net):
+def _net_root(solver_msg, solver_path: str) -> str:
+    """Root for the solver's relative ``net:``/``train_net:`` path.
+
+    Caffe resolves it against the CWD (the tool is run from the caffe
+    root — ref: examples/cifar10/train_full.sh invokes
+    ``build/tools/caffe`` with ``examples/...`` paths).  When that
+    fails, walk up from the solver file's own directory until the
+    relative path resolves, so ``tpunet train --solver
+    /any/tree/examples/cifar10/x_solver.prototxt`` works from any CWD.
+    """
+    rel = next(
+        (solver_msg.get_str(f) for f in ("net", "train_net")
+         if solver_msg.has(f)),
+        "",
+    )
+    if not rel or os.path.isabs(rel) or os.path.exists(rel):
+        return ""
+    d = os.path.dirname(os.path.abspath(solver_path))
+    while True:
+        if os.path.exists(os.path.join(d, rel)):
+            return d
+        parent = os.path.dirname(d)
+        if parent == d:
+            return ""  # let load_solver_net raise the plain not-found
+        d = parent
+
+
+def _feed_shapes(net, args=None):
     shapes = net.feed_shapes()
+    if args is not None:
+        shapes.update(_db_peek_shapes(args, net))
     if not shapes:
-        raise SystemExit("net declares no input shapes; use RDD/Input layers")
+        raise SystemExit(
+            "net declares no input shapes; use RDD/Input layers, keep the "
+            "DB at data_param.source on disk, or stream one with --data "
+            "db:<path> (a Data layer's geometry comes from its DB — ref: "
+            "data_layer.cpp DataLayerSetUp)"
+        )
     return shapes
+
+
+def _db_peek_shapes(args, net) -> dict:
+    """Shapes for ``Data``-layer tops peeked from the user's ``--data db:``
+    path — Caffe parity (geometry comes from the DB, data_layer.cpp:40-48)
+    with the streamed DB standing in for a ``data_param.source`` that isn't
+    on this machine.  Empty dict when nothing needs peeking."""
+    data = getattr(args, "data", "") or ""
+    if not data.startswith("db:"):
+        return {}
+    known = net.feed_shapes()
+    missing = [
+        l for l in net.input_layers
+        if getattr(l, "TYPE", "") == "Data"
+        and any(t not in known for t in l.tops)
+    ]
+    if not missing:
+        return {}
+    import jax
+
+    from sparknet_tpu.data.createdb import peek_db_shape
+
+    # expand {proc} to THIS process: in the per-worker-DB layout a host
+    # may hold only its own shard (cmd_train initializes jax.distributed
+    # before any Solver is built, so the index is correct here)
+    path = data[3:].split(",")[0].replace("{proc}", str(jax.process_index()))
+    try:
+        chw = peek_db_shape(path)
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"--data db: {path}: {e}") from None
+    out = {}
+    for l in missing:
+        shapes = l.shapes_for_chw(chw)
+        if shapes:
+            out.update(zip(l.tops, shapes))
+    return out
+
+
+def _peeked_feed_shapes(args, net_param):
+    """--data db: shapes for a throwaway TRAIN-phase probe net (shared by
+    every Solver/TPUNet construction site)."""
+    if not (getattr(args, "data", "") or "").startswith("db:"):
+        return None  # the probe Network below would be wasted work
+    from sparknet_tpu.common import Phase
+    from sparknet_tpu.compiler.graph import Network
+
+    return _db_peek_shapes(args, Network(net_param, Phase.TRAIN)) or None
+
+
+def _make_solver(solver_cfg, net_param, args):
+    """Solver whose train net can shape-infer even when its prototxt uses
+    DB-backed ``Data`` layers: feed shapes peeked from --data db: fill in
+    what the layer declarations leave open."""
+    from sparknet_tpu.solvers.solver import Solver
+
+    return Solver(
+        solver_cfg, net_param, feed_shapes=_peeked_feed_shapes(args, net_param)
+    )
 
 
 def _data_fns(args, net):
@@ -110,7 +202,7 @@ def _data_fns(args, net):
 
         return train_src, eval_src
 
-    shapes = _feed_shapes(net)
+    shapes = _feed_shapes(net, args)
     data_shape = shapes["data"]
     batch = data_shape[0]
 
@@ -196,20 +288,79 @@ def _data_fns(args, net):
         test_path = (paths[1] if len(paths) > 1 else paths[0]).replace(
             "{proc}", "0"
         )
-        # transform_param.scale parity (ref: lenet_train_test.prototxt
-        # scale: 0.00390625 — DataLayer scales raw bytes before the net)
-        scale = getattr(args, "data_scale", 0.0) or 1.0
+        # transform_param parity (ref: data_transformer.cpp: mean ->
+        # crop [random in TRAIN, center in TEST] -> mirror -> scale —
+        # the reference's DataLayer transforms every record).  The
+        # phase net's own Data layer declares the params; --data-scale
+        # overrides the scale field (lenet_train_test.prototxt's
+        # 0.00390625 without a prototxt edit).
+        tp = next(
+            (l.lp.get_msg("transform_param") for l in net.input_layers
+             if getattr(l, "TYPE", "") == "Data"),
+            None,
+        )
+        crop = tp.get_int("crop_size", 0) if tp else 0
+        mirror = tp.get_bool("mirror", False) if tp else False
+        mean_vals = (
+            tuple(float(v) for v in tp.get_all("mean_value")) if tp else ()
+        )
+        mean_img = None
+        if tp:
+            mf = tp.get_str("mean_file")
+            if mf:
+                # Caffe CHECK-fails on an unreadable mean_file; silently
+                # training without mean subtraction would be a wrong-
+                # result bug, not a convenience.  Relative paths resolve
+                # against the CWD (Caffe) with the same walk-up fallback
+                # as net: paths.
+                if not os.path.exists(mf) and getattr(args, "solver", ""):
+                    d = os.path.dirname(os.path.abspath(args.solver))
+                    while True:
+                        cand = os.path.join(d, mf)
+                        if os.path.exists(cand):
+                            mf = cand
+                            break
+                        parent = os.path.dirname(d)
+                        if parent == d:
+                            break
+                        d = parent
+                if not os.path.exists(mf):
+                    raise SystemExit(
+                        f"transform_param.mean_file {mf!r} not found "
+                        "(generate one with `tpunet compute_image_mean`, "
+                        "or remove the field to train without mean "
+                        "subtraction)"
+                    )
+                from sparknet_tpu.data.transform import load_mean_file
+
+                mean_img = load_mean_file(mf)
+        scale = (
+            getattr(args, "data_scale", 0.0)
+            or (tp.get_float("scale", 1.0) if tp else 1.0)
+        )
         # one shared DB across a multi-process job: shard by batch
         # interleave (process p takes batches p, p+n, ...) — correct but
         # every host decodes everything; the {proc} per-worker layout is
         # the efficient path
         shared = "{proc}" not in paths[0] and nproc > 1
 
-        def db_stream(path, stride=1, offset=0):
+        def db_stream(path, stride=1, offset=0, train=True):
             """Lazy cursor: nothing opens until the first call, so
             eval-only subcommands never touch the train DB; errors
             surface as clean SystemExits at first use."""
             state: dict = {}
+            xform = None
+            if crop or mirror or mean_img is not None or mean_vals:
+                from sparknet_tpu.data import DataTransformer, TransformConfig
+
+                try:
+                    xform = DataTransformer(TransformConfig(
+                        scale=scale, mirror=mirror, crop_size=crop,
+                        mean_value=mean_vals, mean_image=mean_img,
+                        seed=1234 + pid,
+                    ))
+                except ValueError as e:  # e.g. mean_image AND mean_value
+                    raise SystemExit(f"transform_param: {e}") from None
 
             def fn(_):
                 if "iter" not in state:
@@ -220,18 +371,26 @@ def _data_fns(args, net):
                             b = next(state["iter"])
                     except (OSError, ValueError) as e:
                         raise SystemExit(f"--data db: {path}: {e}") from None
+                else:
+                    for _ in range(stride - 1):
+                        next(state["iter"])
+                    b = next(state["iter"])
+                if xform is not None:
+                    try:
+                        b = dict(b, data=xform(b["data"], train))
+                    except ValueError as e:  # e.g. crop > record size
+                        raise SystemExit(f"--data db: {path}: {e}") from None
+                elif scale != 1.0:
+                    b = dict(b, data=b["data"] * scale)
+                if "checked" not in state:
+                    state["checked"] = True
+                    # post-transform: the net sees cropped geometry
                     if tuple(b["data"].shape[1:]) != tuple(data_shape[1:]):
                         raise SystemExit(
                             f"{path}: db images {tuple(b['data'].shape[1:])} "
                             f"do not match the net's data blob "
                             f"{tuple(data_shape[1:])}"
                         )
-                else:
-                    for _ in range(stride - 1):
-                        next(state["iter"])
-                    b = next(state["iter"])
-                if scale != 1.0:
-                    b = dict(b, data=b["data"] * scale)
                 return b
 
             return fn
@@ -240,7 +399,7 @@ def _data_fns(args, net):
             db_stream(train_path,
                       stride=nproc if shared else 1,
                       offset=pid if shared else 0),
-            db_stream(test_path),
+            db_stream(test_path, train=False),
         )
 
     if args.data == "synthetic":
@@ -340,7 +499,7 @@ def cmd_train(args) -> int:
             process_id=args.process_id,
         )
     net_param, solver_cfg = _build_net_and_solver(args)
-    solver = Solver(solver_cfg, net_param)
+    solver = _make_solver(solver_cfg, net_param, args)
     if args.snapshot:
         solver.restore(args.snapshot)
     elif getattr(args, "weights", ""):
@@ -505,7 +664,7 @@ def cmd_test(args) -> int:
         # never what the user meant
         raise SystemExit("test needs --weights or --snapshot to score")
     net_param, solver_cfg = _build_net_and_solver(args)
-    solver = Solver(solver_cfg, net_param)
+    solver = _make_solver(solver_cfg, net_param, args)
     if args.snapshot:
         solver.restore(args.snapshot)
     else:
@@ -537,7 +696,7 @@ def cmd_time(args) -> int:
 
         from sparknet_tpu.solvers.solver import Solver
 
-        solver = Solver(solver_cfg, net_param)
+        solver = _make_solver(solver_cfg, net_param, args)
         train_fn, _ = _data_fns(args, solver.train_net)
         feeds = jax.device_put(train_fn(0))
         step, v, s, key = solver.jitted_train_step(donate=True)
@@ -563,7 +722,7 @@ def cmd_time(args) -> int:
         # per-op HLO cost breakdown on TPU, where the layer loop is fused)
         from sparknet_tpu.solvers.solver import Solver
 
-        solver = Solver(solver_cfg, net_param)
+        solver = _make_solver(solver_cfg, net_param, args)
         train_fn, _ = _data_fns(args, solver.train_net)
         feeds = jax.device_put(train_fn(0))
         step, v, s, key = solver.jitted_train_step(donate=False)
@@ -613,7 +772,7 @@ def _time_trace(args, net_param, solver_cfg) -> int:
     from sparknet_tpu.solvers.solver import Solver
     from sparknet_tpu.utils.op_profile import layer_time_table
 
-    solver = Solver(solver_cfg, net_param)
+    solver = _make_solver(solver_cfg, net_param, args)
     train_fn, _ = _data_fns(args, solver.train_net)
     feeds = jax.device_put(train_fn(0))
     step, v, s, key = solver.jitted_train_step(donate=False)
@@ -770,7 +929,9 @@ def cmd_extract_features(args) -> int:
     from sparknet_tpu.net import TPUNet
 
     net_param, solver_cfg = _build_net_and_solver(args)
-    net = TPUNet(solver_cfg, net_param)
+    net = TPUNet(
+        solver_cfg, net_param, feed_shapes=_peeked_feed_shapes(args, net_param)
+    )
     if args.snapshot and getattr(args, "weights", ""):
         raise SystemExit("--snapshot and --weights are mutually exclusive")
     if args.snapshot:

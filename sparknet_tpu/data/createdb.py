@@ -18,6 +18,7 @@ write their partition into per-worker LMDB/LevelDBs through the C API
 
 from __future__ import annotations
 
+import functools
 import struct
 from typing import Iterable, Iterator
 
@@ -113,6 +114,21 @@ def convert_db(src: str, dst: str, backend: str = "record") -> int:
                 writer.commit()
         writer.commit()
     return n
+
+
+@functools.lru_cache(maxsize=64)
+def peek_db_shape(path: str) -> tuple[int, ...]:
+    """(C, H, W) of the first record — Caffe parity: a DataLayer's blob
+    geometry is defined by its DB, read at setup from datum 0 (ref:
+    data_layer.cpp:40-48 DataLayerSetUp -> data_transformer InferBlobShape).
+    Cached per path: shape inference consults it from several sites per
+    run and a training DB's geometry never changes mid-run."""
+    db, decode = _open_reader(path)
+    with db:
+        for _, value in db:
+            image, _ = decode(value)
+            return tuple(image.shape)
+    raise ValueError(f"record db {path!r} is empty")
 
 
 def db_mean(path: str, batch_size: int = 256) -> np.ndarray:

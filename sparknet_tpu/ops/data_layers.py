@@ -44,12 +44,42 @@ def _transform_shape(lp, base_shape):
 @register
 class Data(InputLayer):
     """LMDB/LevelDB-backed source in the reference (ref: data_layer.cpp);
-    here a named input whose batch size comes from data_param."""
+    here a named input whose batch size comes from data_param.
+
+    Geometry follows Caffe: the DB itself defines the blob shape, read
+    from the first datum at setup (ref: data_layer.cpp:40-48).  When
+    ``data_param.source`` exists on disk we peek it the same way, so a
+    reference train_val prototxt shape-infers with no surgery; when it
+    doesn't, shapes come from the feed dict (the ``--data db:`` CLI path
+    peeks the user's DB instead)."""
 
     TYPE = "Data"
 
     def batch_size(self) -> int:
         return self.lp.get_msg("data_param").get_int("batch_size", 0)
+
+    def shapes_for_chw(self, chw, batch_override=None):
+        """Top shapes given a peeked record geometry: the first top is
+        the (cropped) image, every further top a per-sample scalar."""
+        n = batch_override or self.batch_size()
+        if not n:
+            return None
+        chw = _transform_shape(self.lp, tuple(chw))
+        return [(n, *chw)] + [(n,)] * (len(self.tops) - 1)
+
+    def blob_shapes(self, batch_override=None):
+        import os
+
+        source = self.lp.get_msg("data_param").get_str("source")
+        if not (source and os.path.exists(source)):
+            return None
+        from sparknet_tpu.data.createdb import peek_db_shape
+
+        try:
+            chw = peek_db_shape(source)
+        except (OSError, ValueError):
+            return None  # unreadable/empty db: fall back to feed shapes
+        return self.shapes_for_chw(chw, batch_override)
 
 
 @register

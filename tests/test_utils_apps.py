@@ -256,6 +256,36 @@ def test_cli_train_and_test_zoo_synthetic(tmp_path, monkeypatch, capsys):
     assert "accuracy" in scores
 
 
+def test_net_root_walks_up_from_solver_file(tmp_path, monkeypatch):
+    """A solver whose relative ``net:`` path is rooted at the tree top
+    (the Caffe layout: run from the caffe root) must still resolve when
+    tpunet runs from an unrelated CWD — cli._net_root walks up from the
+    solver file (ref: examples/cifar10/train_full.sh runs build/tools/
+    caffe from the repo root with examples/... paths)."""
+    import argparse
+
+    from sparknet_tpu.cli import _build_net_and_solver
+
+    root = tmp_path / "tree"
+    (root / "examples" / "toy").mkdir(parents=True)
+    (root / "examples" / "toy" / "net.prototxt").write_text(
+        'name: "toy"\n'
+        'layer { name: "data" type: "Input" top: "data"\n'
+        "  input_param { shape { dim: 2 dim: 3 } } }\n"
+        'layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"\n'
+        "  inner_product_param { num_output: 4 } }\n"
+    )
+    solver = root / "examples" / "toy" / "solver.prototxt"
+    solver.write_text(
+        'net: "examples/toy/net.prototxt"\nbase_lr: 0.1\nmax_iter: 1\n'
+    )
+    monkeypatch.chdir(tmp_path)  # NOT the tree root: CWD-relative fails
+    args = argparse.Namespace(solver=str(solver), batch=None)
+    net_param, cfg = _build_net_and_solver(args)
+    assert net_param.get_str("name") == "toy"
+    assert cfg.base_lr == 0.1
+
+
 def test_cli_train_cifar_tau(cifar_dir, tmp_path, monkeypatch):
     from sparknet_tpu.cli import main
 

@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""Babysit the fragile remote-TPU relay and spend healthy windows well.
+
+The axon relay serving this environment's one v5e chip wedges for hours
+and heals at random (docs/TUNNEL_LOG_r3.md); a healthy window lasts
+5-30 minutes.  Manual use of a window loses minutes to human/agent
+latency, so this runner automates the round's protocol:
+
+1. **Dial untimed.**  A disposable subprocess creates the PJRT client.
+   Against a dead backend the axon client fails on its own at ~1505 s;
+   against a healthy one it returns in under a minute.  The dial is
+   never killed mid-handshake (a killed client can wedge the relay —
+   round-1 operational finding).
+2. **On green, drain the job queue in order.**  Each job runs as its
+   own subprocess with a deadline; stdout/stderr are banked to
+   ``docs/evidence_r3/<job>.txt`` as they stream (evidence survives a
+   mid-job wedge).  A job that exceeds its deadline gets SIGTERM, a
+   grace period, then SIGKILL — and the runner goes back to dialing,
+   because a hung job almost always means the window closed.
+3. **Journal everything** to ``docs/evidence_r3/journal.jsonl`` —
+   dials, outcomes, job rcs, durations — so the tunnel log can be
+   reconstructed after the fact.
+
+Usage:
+    python tools/tpu_window_runner.py tools/tpu_queue_r3.json &
+
+Queue file format (JSON):
+    {"max_hours": 10,
+     "jobs": [{"name": "trace", "argv": ["python", "-m", ...],
+               "env": {"K": "V"}, "deadline_s": 1200,
+               "needs": "other_job_name"  # optional: skip unless that
+                                          # job has rc==0 on record
+              }, ...]}
+
+Jobs are idempotent from the queue's point of view: a job is DONE once
+a journal entry records rc==0 for it; the runner re-attempts failed
+jobs in later windows (max_attempts per job, default 3).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EVIDENCE_DIR = os.path.join(REPO, "docs", "evidence_r3")
+JOURNAL = os.path.join(EVIDENCE_DIR, "journal.jsonl")
+
+DIAL_CODE = "import jax; print(jax.devices()[0].platform)"
+
+
+def log(event: dict) -> None:
+    event = dict(event)
+    event["utc"] = time.strftime("%Y-%m-%d %H:%M:%SZ", time.gmtime())
+    os.makedirs(EVIDENCE_DIR, exist_ok=True)
+    with open(JOURNAL, "a") as f:
+        f.write(json.dumps(event) + "\n")
+    print(json.dumps(event), flush=True)
+
+
+def load_done() -> dict[str, int]:
+    """job name -> number of attempts; negative = succeeded."""
+    state: dict[str, int] = {}
+    try:
+        with open(JOURNAL) as f:
+            for line in f:
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if ev.get("event") == "job_end":
+                    n = ev["job"]
+                    if ev.get("rc") == 0:
+                        state[n] = -1
+                    elif state.get(n, 0) >= 0:
+                        state[n] = state.get(n, 0) + 1
+    except OSError:
+        pass
+    return state
+
+
+def dial() -> bool:
+    """One untimed dial.  True iff an accelerator answered."""
+    t0 = time.time()
+    log({"event": "dial_start"})
+    proc = subprocess.Popen(
+        [sys.executable, "-c", DIAL_CODE],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=REPO,
+    )
+    out, err = proc.communicate()  # untimed on purpose: see module doc
+    dt = round(time.time() - t0, 1)
+    platform = out.strip().splitlines()[-1] if out.strip() else ""
+    ok = proc.returncode == 0 and platform not in ("", "cpu")
+    tail = "" if ok else (err or out).strip().splitlines()[-1:]
+    log({"event": "dial_end", "ok": ok, "dt_s": dt,
+         "platform": platform or None,
+         "error": tail[0][:200] if tail else None})
+    return ok
+
+
+def run_job(job: dict) -> int | None:
+    """Run one job with a deadline.  Returns rc, or None on timeout."""
+    name = job["name"]
+    deadline = float(job.get("deadline_s", 1200))
+    env = dict(os.environ)
+    env.update(job.get("env", {}))
+    # jobs may run from another cwd (e.g. to resolve a prototxt's
+    # relative mean_file Caffe-style); the framework must stay importable
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    os.makedirs(EVIDENCE_DIR, exist_ok=True)
+    out_path = os.path.join(EVIDENCE_DIR, f"{name}.txt")
+    log({"event": "job_start", "job": name, "argv": job["argv"],
+         "deadline_s": deadline})
+    t0 = time.time()
+    # append mode: earlier attempts' output stays visible for forensics
+    with open(out_path, "a") as out:
+        out.write(f"\n=== attempt {time.strftime('%H:%M:%SZ', time.gmtime())}"
+                  f" argv={job['argv']}\n")
+        out.flush()
+        proc = subprocess.Popen(
+            job["argv"], stdout=out, stderr=subprocess.STDOUT,
+            env=env, cwd=job.get("cwd", REPO),
+        )
+        try:
+            proc.wait(timeout=deadline)
+            rc: int | None = proc.returncode
+        except subprocess.TimeoutExpired:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+            rc = None
+    log({"event": "job_end", "job": name, "rc": rc,
+         "dt_s": round(time.time() - t0, 1),
+         "timed_out": rc is None})
+    return rc
+
+
+def main() -> int:
+    if len(sys.argv) != 2:
+        print(__doc__)
+        return 2
+    with open(sys.argv[1]) as f:
+        spec = json.load(f)
+    jobs = spec["jobs"]
+    max_attempts = int(spec.get("max_attempts", 3))
+    stop_at = time.time() + float(spec.get("max_hours", 10)) * 3600
+    log({"event": "runner_start", "queue": sys.argv[1],
+         "jobs": [j["name"] for j in jobs]})
+
+    def next_pending(skip: set[str] = frozenset()):
+        state = load_done()
+        for j in jobs:
+            attempts = state.get(j["name"], 0)
+            if j["name"] in skip or attempts < 0 or attempts >= max_attempts:
+                continue
+            need = j.get("needs")
+            if need and state.get(need, 0) >= 0:
+                continue  # dependency not yet green
+            return j
+        return None
+
+    while time.time() < stop_at:
+        if next_pending() is None:
+            log({"event": "runner_done", "reason": "queue drained"})
+            return 0
+        if not dial():
+            continue  # the dial itself was the backoff (~25 min on dead)
+        # Window open: drain everything runnable, re-deriving the next
+        # job from the journal after each run so (a) a job's dependents
+        # run in the SAME window once it goes green, and (b) a job a
+        # human ran in parallel isn't repeated.  A job that fails gets
+        # one shot per window (`attempted`); a job that HANGS means the
+        # window closed, so back to dialing.
+        attempted: set[str] = set()
+        while True:
+            job = next_pending(skip=attempted)
+            if job is None:
+                break
+            attempted.add(job["name"])
+            rc = run_job(job)
+            if rc is None:
+                break
+    log({"event": "runner_done", "reason": "max_hours reached"})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
