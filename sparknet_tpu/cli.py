@@ -139,6 +139,42 @@ def _make_solver(solver_cfg, net_param, args):
     )
 
 
+def _attach_device_augment(train_fn, cfg, pid):
+    """Attach the in-XLA transform as the prefetcher's ``device_fn`` —
+    one key policy for every source (deterministic per process, like the
+    host transformer's ``seed=1234 + pid``; hosts decorrelate by pid)."""
+    import jax as _jax
+
+    from sparknet_tpu.data import DeviceAugment
+
+    try:
+        aug = DeviceAugment(cfg)
+    except ValueError as e:
+        raise SystemExit(f"transform_param: {e}") from None
+    base_key = _jax.random.key(1234 + pid)
+    train_fn.device_fn = lambda feeds, it: {
+        **feeds,
+        "data": aug(feeds["data"], _jax.random.fold_in(base_key, it)),
+    }
+    return train_fn
+
+
+def _device_augment_guards(args):
+    """Shared preconditions for --augment device (any source)."""
+    if getattr(args, "prefetch", 0) <= 0:
+        raise SystemExit(
+            "--augment device rides the async feed: pass --prefetch N "
+            "(the DeviceAugment dispatch belongs on the prefetch thread, "
+            "not the step loop)")
+    if (getattr(args, "tau", 1) > 1
+            or getattr(args, "distributed", False)
+            or getattr(args, "elastic_alpha", 0.0) > 0):
+        raise SystemExit(
+            "--augment device is wired to the single-replica prefetch "
+            "path; the distributed trainer packs its own tau feeds "
+            "(use --augment host there)")
+
+
 def _data_fns(args, net):
     """(train_fn, test_fn) from --data.
 
@@ -149,17 +185,17 @@ def _data_fns(args, net):
     import jax
 
     if (getattr(args, "augment", "host") == "device"
-            and not args.data.startswith("cifar:")):
+            and not args.data.startswith(("cifar:", "db:"))):
         raise SystemExit(
-            "--augment device is currently wired to the cifar: source "
+            "--augment device is wired to the cifar: and db: sources "
             "(other sources transform on the host)")
 
     pid, nproc = jax.process_index(), jax.process_count()
 
     if args.data == "proto":
         # the net's OWN data-layer params drive the host stream — a
-        # reference ImageData/WindowData/HDF5Data prototxt trains end to
-        # end with no surgery (ref: image_data_layer.cpp,
+        # reference Data/ImageData/WindowData/HDF5Data prototxt trains end
+        # to end with no surgery (ref: data_layer.cpp, image_data_layer.cpp,
         # window_data_layer.cpp, hdf5_data_layer.cpp read these sources
         # inside the layer; here the host reader replaces the layer's
         # prefetch thread).  Handled before any feed-shape deref: these
@@ -167,7 +203,8 @@ def _data_fns(args, net):
         from sparknet_tpu.data.listfile import source_from_net
 
         try:
-            train_src = source_from_net(net, seed=1234 + pid)
+            train_src = source_from_net(
+                net, seed=1234 + pid, anchor=getattr(args, "solver", ""))
         except (OSError, ValueError, LookupError) as e:
             raise SystemExit(f"--data proto: {e}") from None
 
@@ -182,7 +219,8 @@ def _data_fns(args, net):
         def eval_src(b):
             if "src" not in eval_state:
                 try:
-                    eval_state["src"] = source_from_net(net, seed=4321)
+                    eval_state["src"] = source_from_net(
+                        net, seed=4321, anchor=getattr(args, "solver", ""))
                 except (OSError, ValueError, LookupError) as e:
                     raise SystemExit(f"--data proto (eval): {e}") from None
             return eval_state["src"](b)
@@ -223,24 +261,7 @@ def _data_fns(args, net):
             # ship raw uint8 over the feed link; mean-subtract runs
             # in-graph via DeviceAugment in the prefetcher's device_fn
             # (4x fewer host->HBM bytes than f32 feeds)
-            if getattr(args, "prefetch", 0) <= 0:
-                raise SystemExit(
-                    "--augment device rides the async feed: pass "
-                    "--prefetch N (the DeviceAugment dispatch belongs on "
-                    "the prefetch thread, not the step loop)")
-            if (getattr(args, "tau", 1) > 1
-                    or getattr(args, "distributed", False)
-                    or getattr(args, "elastic_alpha", 0.0) > 0):
-                raise SystemExit(
-                    "--augment device is wired to the single-replica "
-                    "prefetch path; the distributed trainer packs its "
-                    "own tau feeds (use --augment host there)")
-            import jax as _jax
-
-            from sparknet_tpu.data import DeviceAugment
-
-            aug = DeviceAugment(xform_cfg)
-            base_key = _jax.random.key(getattr(args, "seed", 0) or 0)
+            _device_augment_guards(args)
 
             def train_fn(it):
                 lo = ((it * nproc + pid) * batch) % (len(ytr) - batch + 1)
@@ -249,10 +270,7 @@ def _data_fns(args, net):
                     "label": ytr[lo : lo + batch].astype(np.int32),
                 }
 
-            train_fn.device_fn = lambda feeds, it: {
-                **feeds,
-                "data": aug(feeds["data"], _jax.random.fold_in(base_key, it)),
-            }
+            _attach_device_augment(train_fn, xform_cfg, pid)
         else:
             def train_fn(it):
                 lo = ((it * nproc + pid) * batch) % (len(ytr) - batch + 1)
@@ -310,30 +328,19 @@ def _data_fns(args, net):
             if mf:
                 # Caffe CHECK-fails on an unreadable mean_file; silently
                 # training without mean subtraction would be a wrong-
-                # result bug, not a convenience.  Relative paths resolve
-                # against the CWD (Caffe) with the same walk-up fallback
-                # as net: paths.
-                if not os.path.exists(mf) and getattr(args, "solver", ""):
-                    d = os.path.dirname(os.path.abspath(args.solver))
-                    while True:
-                        cand = os.path.join(d, mf)
-                        if os.path.exists(cand):
-                            mf = cand
-                            break
-                        parent = os.path.dirname(d)
-                        if parent == d:
-                            break
-                        d = parent
-                if not os.path.exists(mf):
-                    raise SystemExit(
-                        f"transform_param.mean_file {mf!r} not found "
-                        "(generate one with `tpunet compute_image_mean`, "
-                        "or remove the field to train without mean "
-                        "subtraction)"
-                    )
-                from sparknet_tpu.data.transform import load_mean_file
+                # result bug.  CWD-relative first (Caffe), then walk-up
+                # from the solver file, like net: paths.
+                from sparknet_tpu.data.transform import (
+                    load_mean_file,
+                    resolve_mean_file,
+                )
 
-                mean_img = load_mean_file(mf)
+                try:
+                    mean_img = load_mean_file(resolve_mean_file(
+                        mf, getattr(args, "solver", "")
+                    ))
+                except ValueError as e:
+                    raise SystemExit(str(e)) from None
         scale = (
             getattr(args, "data_scale", 0.0)
             or (tp.get_float("scale", 1.0) if tp else 1.0)
@@ -344,13 +351,22 @@ def _data_fns(args, net):
         # the efficient path
         shared = "{proc}" not in paths[0] and nproc > 1
 
+        device_aug = getattr(args, "augment", "host") == "device"
+        if device_aug:
+            _device_augment_guards(args)
+
         def db_stream(path, stride=1, offset=0, train=True):
             """Lazy cursor: nothing opens until the first call, so
             eval-only subcommands never touch the train DB; errors
             surface as clean SystemExits at first use."""
             state: dict = {}
+            # with --augment device the TRAIN stream ships raw uint8 and
+            # the transform runs in XLA (device_fn below); eval batches
+            # stay host-transformed (off the hot loop, deterministic)
+            raw = device_aug and train
             xform = None
-            if crop or mirror or mean_img is not None or mean_vals:
+            if not raw and (crop or mirror or mean_img is not None
+                            or mean_vals):
                 from sparknet_tpu.data import DataTransformer, TransformConfig
 
                 try:
@@ -365,7 +381,10 @@ def _data_fns(args, net):
             def fn(_):
                 if "iter" not in state:
                     try:
-                        state["iter"] = db_minibatches(path, batch, loop=True)
+                        state["iter"] = db_minibatches(
+                            path, batch, loop=True,
+                            dtype=np.uint8 if raw else np.float32,
+                        )
                         b = next(state["iter"])
                         for _ in range(offset):
                             b = next(state["iter"])
@@ -380,27 +399,42 @@ def _data_fns(args, net):
                         b = dict(b, data=xform(b["data"], train))
                     except ValueError as e:  # e.g. crop > record size
                         raise SystemExit(f"--data db: {path}: {e}") from None
-                elif scale != 1.0:
+                elif not raw and scale != 1.0:
                     b = dict(b, data=b["data"] * scale)
                 if "checked" not in state:
                     state["checked"] = True
-                    # post-transform: the net sees cropped geometry
-                    if tuple(b["data"].shape[1:]) != tuple(data_shape[1:]):
+                    got = tuple(b["data"].shape[1:])
+                    want = tuple(data_shape[1:])
+                    if raw and crop:
+                        # device_fn crops later: records must be at least
+                        # net-sized with matching channels
+                        ok = (got[0] == want[0]
+                              and got[1] >= want[1] and got[2] >= want[2])
+                    else:
+                        # post-transform (or crop-free raw, where the
+                        # device augment leaves geometry unchanged): the
+                        # net sees this exact shape
+                        ok = got == want
+                    if not ok:
                         raise SystemExit(
-                            f"{path}: db images {tuple(b['data'].shape[1:])} "
-                            f"do not match the net's data blob "
-                            f"{tuple(data_shape[1:])}"
+                            f"{path}: db images {got} do not match the "
+                            f"net's data blob {want}"
                         )
                 return b
 
             return fn
 
-        return (
-            db_stream(train_path,
-                      stride=nproc if shared else 1,
-                      offset=pid if shared else 0),
-            db_stream(test_path, train=False),
-        )
+        train_fn = db_stream(train_path,
+                             stride=nproc if shared else 1,
+                             offset=pid if shared else 0)
+        if device_aug:
+            from sparknet_tpu.data import TransformConfig
+
+            _attach_device_augment(train_fn, TransformConfig(
+                scale=scale, mirror=mirror, crop_size=crop,
+                mean_value=mean_vals, mean_image=mean_img,
+            ), pid)
+        return train_fn, db_stream(test_path, train=False)
 
     if args.data == "synthetic":
         rs = np.random.RandomState(pid)
@@ -520,7 +554,9 @@ def cmd_train(args) -> int:
         from sparknet_tpu.data.listfile import source_from_net
 
         try:
-            test_fn = source_from_net(solver.test_net, seed=4321)
+            test_fn = source_from_net(
+                solver.test_net, seed=4321,
+                anchor=getattr(args, "solver", ""))
         except LookupError:
             pass
         except (OSError, ValueError) as e:
@@ -1363,8 +1399,9 @@ def main(argv=None) -> int:
         sp.add_argument("--solver", help="solver prototxt path or zoo:<name>")
         sp.add_argument("--data", default="synthetic",
                         help="cifar:<dir> | db:<path>[,<test_path>] | proto "
-                        "(stream from the net's own ImageData/WindowData/"
-                        "HDF5Data layers) | synthetic")
+                        "(stream from the net's own Data/ImageData/WindowData/"
+                        "HDF5Data layers — the caffe-train-from-solver flow) "
+                        "| synthetic")
         sp.add_argument("--data-scale", type=float, default=0.0,
                         help="multiply db feeds by this (transform_param."
                         "scale parity, e.g. 0.00390625 for lenet)")

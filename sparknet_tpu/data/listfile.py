@@ -1,6 +1,7 @@
-"""Host-side readers for the listfile-driven reference data layers.
+"""Host-side readers for the self-describing reference data layers.
 
-These give `ImageData`, `WindowData`, and `HDF5Data` prototxts a real
+These give `Data` (LMDB/record DB), `ImageData`, `WindowData`, and
+`HDF5Data` prototxts a real
 feed path (the layers themselves stay feed-declaration shells in-graph —
 the TPU-first inversion of Caffe's in-layer prefetch threads: the host
 produces numpy batches, `tpunet train --data proto` / `DevicePrefetcher`
@@ -47,15 +48,22 @@ def _read_image(path: str, color: bool, new_h: int = 0, new_w: int = 0) -> np.nd
     return arr.transpose(2, 0, 1)
 
 
-def _transformer(lp: Message, seed: int | None):
-    """DataTransformer from a layer's transform_param."""
-    from sparknet_tpu.data.transform import DataTransformer, TransformConfig, load_mean_file
+def _transformer(lp: Message, seed: int | None, anchor: str = ""):
+    """DataTransformer from a layer's transform_param.  ``anchor`` (the
+    solver/net file that declared the layer) lets a relative mean_file
+    resolve by walk-up when it isn't CWD-relative."""
+    from sparknet_tpu.data.transform import (
+        DataTransformer,
+        TransformConfig,
+        load_mean_file,
+        resolve_mean_file,
+    )
 
     tp = lp.get_msg("transform_param")
     mean_image = None
     mean_file = tp.get_str("mean_file", "")
     if mean_file:
-        mean_image = load_mean_file(mean_file)
+        mean_image = load_mean_file(resolve_mean_file(mean_file, anchor))
     return DataTransformer(TransformConfig(
         scale=tp.get_float("scale", 1.0),
         mirror=tp.get_bool("mirror", False),
@@ -74,7 +82,8 @@ class ImageDataSource:
     of a TPU VM — the role of the reference's per-executor parallelism);
     ``SPARKNET_DECODE_WORKERS`` overrides the pool size, 1 = serial."""
 
-    def __init__(self, layer_param: Message, *, train: bool, seed: int = 0):
+    def __init__(self, layer_param: Message, *, train: bool, seed: int = 0,
+                 anchor: str = ""):
         self.lp = layer_param
         p = layer_param.get_msg("image_data_param")
         self.batch = p.get_int("batch_size", 0)
@@ -110,7 +119,7 @@ class ImageDataSource:
             self._rs.shuffle(self.lines)
         skip = p.get_int("rand_skip", 0)
         self._pos = int(self._rs.randint(0, skip)) if skip > 1 else 0
-        self.xform = _transformer(layer_param, seed)
+        self.xform = _transformer(layer_param, seed, anchor)
         # resolved HERE (not at first batch) so config errors fail early
         # and the value can't drift with later env changes
         from sparknet_tpu.data.minibatch import decode_workers
@@ -156,7 +165,8 @@ class ImageDataSource:
 class WindowDataSource:
     """Infinite fg/bg-sampled window stream for one WindowData layer."""
 
-    def __init__(self, layer_param: Message, *, train: bool, seed: int = 0):
+    def __init__(self, layer_param: Message, *, train: bool, seed: int = 0,
+                 anchor: str = ""):
         self.lp = layer_param
         p = layer_param.get_msg("window_data_param")
         self.batch = p.get_int("batch_size", 0)
@@ -325,7 +335,8 @@ class Hdf5DataSource:
     (hdf5_data_layer.cpp LoadHDF5FileData / Next); ``shuffle`` permutes
     the file order each epoch and the rows within each file, seeded."""
 
-    def __init__(self, layer_param: Message, *, train: bool, seed: int = 0):
+    def __init__(self, layer_param: Message, *, train: bool, seed: int = 0,
+                 anchor: str = ""):
         p = layer_param.get_msg("hdf5_data_param")
         self.batch = p.get_int("batch_size", 0)
         if self.batch <= 0:
@@ -386,26 +397,81 @@ class Hdf5DataSource:
         return out
 
 
+class DataDbSource:
+    """Infinite minibatch stream for one DB-backed ``Data`` layer (ref:
+    data_layer.cpp: a DataReader walks the LMDB cursor forever and the
+    DataTransformer crops/mirrors/means each datum).  The prototxt's own
+    ``data_param.source`` must exist on this host; ``--data db:<path>``
+    covers the DB-lives-elsewhere case."""
+
+    def __init__(self, layer_param: Message, *, train: bool, seed: int = 0,
+                 anchor: str = ""):
+        self.lp = layer_param
+        p = layer_param.get_msg("data_param")
+        self.batch = p.get_int("batch_size", 0)
+        if self.batch <= 0:
+            raise ValueError("data_param.batch_size must be set")
+        self.source = p.get_str("source", "")
+        if not self.source:
+            raise ValueError("data_param.source must be set")
+        if not os.path.exists(self.source):
+            raise ValueError(
+                f"data_param.source {self.source!r} not found on this host "
+                "(stream a local DB with --data db:<path> instead)"
+            )
+        self.train = train
+        self.tops = list(layer_param.get_all("top"))
+        self.xform = _transformer(layer_param, seed, anchor)
+        # rand_skip decorrelates workers (data_layer.cpp:23-31); datum
+        # granularity needs cursor surgery, batch granularity decorrelates
+        # the same way
+        skip = p.get_int("rand_skip", 0)
+        self._skip_batches = (
+            int(np.random.RandomState(seed).randint(0, skip)) // self.batch
+            if skip > 1 else 0
+        )
+        self._iter = None
+
+    def __call__(self, _it: int) -> dict[str, np.ndarray]:
+        if self._iter is None:
+            from sparknet_tpu.data.createdb import db_minibatches
+
+            # uint8: the transformer casts to f32 anyway; a float
+            # stream would pay a second full-size copy per batch
+            self._iter = db_minibatches(
+                self.source, self.batch, loop=True, dtype=np.uint8)
+            for _ in range(self._skip_batches):
+                next(self._iter)
+        b = next(self._iter)
+        out = {self.tops[0]: self.xform(b["data"], self.train)}
+        if len(self.tops) > 1:
+            out[self.tops[1]] = b["label"]
+        return out
+
+
 _SOURCES = {
+    "Data": DataDbSource,
     "ImageData": ImageDataSource,
     "WindowData": WindowDataSource,
     "HDF5Data": Hdf5DataSource,
 }
 
 
-def source_from_net(net, *, seed: int = 0):
-    """Build the host stream for the first listfile-driven data layer in a
-    compiled Network (its phase decides train-time augmentation)."""
+def source_from_net(net, *, seed: int = 0, anchor: str = ""):
+    """Build the host stream for the first self-describing data layer in a
+    compiled Network (its phase decides train-time augmentation).
+    ``anchor``: the solver/net prototxt path, for mean_file walk-up."""
     from sparknet_tpu.common import Phase
 
     for layer in net.input_layers:
         cls = _SOURCES.get(layer.type)
         if cls is not None:
-            return cls(layer.lp, train=net.phase == Phase.TRAIN, seed=seed)
+            return cls(layer.lp, train=net.phase == Phase.TRAIN, seed=seed,
+                       anchor=anchor)
     # LookupError (not ValueError): "this net has no such layer" is a
     # recoverable capability probe — callers fall back (e.g. a train-only
     # prototxt's TEST view) — while bad layer params stay fatal
     raise LookupError(
-        "net has no ImageData/WindowData/HDF5Data layer in this phase "
+        "net has no Data/ImageData/WindowData/HDF5Data layer in this phase "
         f"(input layers: {[l.type for l in net.input_layers]})"
     )

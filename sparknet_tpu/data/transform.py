@@ -31,6 +31,33 @@ def load_mean_file(path: str) -> np.ndarray:
     return load_mean_binaryproto(path)
 
 
+def resolve_mean_file(path: str, anchor: str = "") -> str:
+    """Resolve a transform_param.mean_file the way net: paths resolve:
+    CWD-relative first (Caffe), then walking up from ``anchor`` (the
+    solver/net file that declared it).  A missing mean_file raises a
+    clear error instead of silently training without mean subtraction
+    (Caffe CHECK-fails, ref: data_transformer.cpp ReadProtoFromBinaryFile)."""
+    import os
+
+    if os.path.exists(path):
+        return path
+    if anchor and not os.path.isabs(path):
+        d = os.path.dirname(os.path.abspath(anchor))
+        while True:
+            cand = os.path.join(d, path)
+            if os.path.exists(cand):
+                return cand
+            parent = os.path.dirname(d)
+            if parent == d:
+                break
+            d = parent
+    raise ValueError(
+        f"transform_param.mean_file {path!r} not found (generate one with "
+        "`tpunet compute_image_mean`, or remove the field to train "
+        "without mean subtraction)"
+    )
+
+
 @dataclasses.dataclass
 class TransformConfig:
     """ref: TransformationParameter (caffe.proto:399-426)."""

@@ -294,5 +294,5 @@ def test_source_from_net_no_listfile_layer():
         "input_dim: 4 input_dim: 4"
     )
     net = Network(npz, Phase.TRAIN)
-    with pytest.raises(LookupError, match="no ImageData/WindowData/HDF5Data"):
+    with pytest.raises(LookupError, match="no Data/ImageData/WindowData/HDF5Data"):
         source_from_net(net)

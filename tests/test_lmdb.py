@@ -324,6 +324,45 @@ def test_cli_train_data_layer_crop_from_db(tmp_path, monkeypatch):
     ]) == 0
 
 
+def test_cli_train_data_proto_streams_own_source(tmp_path, monkeypatch):
+    """``tpunet train --solver x --data proto`` with a Data-layer net whose
+    data_param.source is on disk = the ``caffe train --solver=x`` flow:
+    the net's own DB streams, transform_param applies, nothing else needed
+    (ref: data_layer.cpp DataReader + DataTransformer)."""
+    import numpy as np
+
+    monkeypatch.chdir(tmp_path)
+
+    from sparknet_tpu.cli import main
+    from sparknet_tpu.data.createdb import create_db
+
+    rs = np.random.RandomState(0)
+    samples = [(rs.randint(0, 255, (3, 14, 14)).astype(np.uint8), i % 3)
+               for i in range(16)]
+    create_db(str(tmp_path / "own_lmdb"), samples, backend="lmdb")
+
+    (tmp_path / "net.prototxt").write_text(
+        'name: "selffeed"\n'
+        'layer { name: "d" type: "Data" top: "data" top: "label"\n'
+        '  data_param { source: "own_lmdb" batch_size: 4 }\n'
+        "  transform_param { crop_size: 12 scale: 0.0039 }\n"
+        "}\n"
+        'layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"\n'
+        "  inner_product_param { num_output: 3 } }\n"
+        'layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" '
+        'bottom: "label" top: "loss" }\n'
+    )
+    (tmp_path / "solver.prototxt").write_text(
+        'net: "net.prototxt"\nbase_lr: 0.01\nmax_iter: 2\ndisplay: 0\n'
+    )
+    assert main([
+        "train", "--solver", str(tmp_path / "solver.prototxt"),
+        "--data", "proto", "--iterations", "2",
+        "--output", str(tmp_path / "out"),
+    ]) == 0
+    assert (tmp_path / "out.solverstate.npz").exists()
+
+
 def test_data_layer_peeks_its_own_source(tmp_path, monkeypatch):
     """When data_param.source IS on disk, the net shape-infers with no
     feed help at all — Network.feed_shapes() carries the peeked geometry

@@ -311,6 +311,46 @@ def test_cli_train_cifar_device_augment(cifar_dir, tmp_path, monkeypatch):
     assert rc == 0
 
 
+def test_cli_train_db_device_augment(tmp_path, monkeypatch):
+    """--augment device on a db: source — records larger than the net's
+    blob ship as raw uint8 and crop/mirror/scale run in XLA on the
+    prefetch thread (the ImageNet 256-px-DB → 227-crop recipe shape)."""
+    import numpy as np
+
+    from sparknet_tpu.cli import main
+    from sparknet_tpu.data.createdb import create_db
+
+    monkeypatch.chdir(tmp_path)
+    rs = np.random.RandomState(0)
+    samples = [(rs.randint(0, 255, (3, 16, 16)).astype(np.uint8), i % 4)
+               for i in range(24)]
+    db = str(tmp_path / "aug_lmdb")
+    create_db(db, samples, backend="lmdb")
+
+    (tmp_path / "net.prototxt").write_text(
+        'name: "devaug"\n'
+        'layer { name: "d" type: "Data" top: "data" top: "label"\n'
+        '  data_param { source: "gone_lmdb" batch_size: 6 }\n'
+        "  transform_param { crop_size: 12 mirror: true scale: 0.0039 }\n"
+        "}\n"
+        'layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"\n'
+        "  inner_product_param { num_output: 4 } }\n"
+        'layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" '
+        'bottom: "label" top: "loss" }\n'
+    )
+    (tmp_path / "solver.prototxt").write_text(
+        'net: "net.prototxt"\nbase_lr: 0.01\nmax_iter: 4\ndisplay: 0\n'
+    )
+    rc = main([
+        "train", "--solver", str(tmp_path / "solver.prototxt"),
+        "--data", f"db:{db}", "--iterations", "4",
+        "--prefetch", "2", "--augment", "device",
+        "--output", str(tmp_path / "out"),
+    ])
+    assert rc == 0
+    assert (tmp_path / "out.solverstate.npz").exists()
+
+
 def test_cli_device_augment_guards(cifar_dir, tmp_path, monkeypatch):
     from sparknet_tpu.cli import main
 
