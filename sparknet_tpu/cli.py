@@ -1461,15 +1461,17 @@ def main(argv=None) -> int:
     sp.add_argument("--listfile", required=True, help='lines of "relpath label"')
     sp.add_argument("--db", required=True, help="output record DB path")
     sp.add_argument("--resize", type=int, default=256)
-    sp.add_argument("--backend", choices=("record", "lmdb"), default="record",
-                    help="output format (lmdb = Caffe-compatible)")
+    sp.add_argument("--backend", choices=("record", "lmdb", "leveldb"),
+                    default="record",
+                    help="output format (lmdb/leveldb = Caffe-compatible)")
     sp.set_defaults(fn=cmd_convert_imageset)
 
     sp = sub.add_parser("convert_db",
-                        help="convert LMDB <-> native record DB")
-    sp.add_argument("--src", required=True, help="source DB (either format)")
+                        help="convert between LMDB / LevelDB / native "
+                        "record DB (source auto-detected)")
+    sp.add_argument("--src", required=True, help="source DB (any format)")
     sp.add_argument("--dst", required=True, help="destination path")
-    sp.add_argument("--backend", choices=("record", "lmdb"),
+    sp.add_argument("--backend", choices=("record", "lmdb", "leveldb"),
                     default="record", help="destination format")
     sp.set_defaults(fn=cmd_convert_db)
 

@@ -11,7 +11,11 @@ write their partition into per-worker LMDB/LevelDBs through the C API
   Datum role, ref: caffe.proto:30-41, without the protobuf dependency);
 - ``lmdb`` — real LMDB environments with protobuf ``Datum`` values, the
   reference's own format (ref: db_lmdb.cpp), via the clean-room codec in
-  :mod:`sparknet_tpu.data.lmdb_io` — existing Caffe datasets load as-is.
+  :mod:`sparknet_tpu.data.lmdb_io` — existing Caffe datasets load as-is;
+- ``leveldb`` — real LevelDB environments (ref: db_leveldb.cpp — the
+  backend CifarDBApp/CreateDB actually use), via the clean-room codec in
+  :mod:`sparknet_tpu.data.leveldb_io` (log replay + SSTables + snappy
+  block decode).
 
 ``db_minibatches`` auto-detects the backend per path.
 """
@@ -73,11 +77,16 @@ def _open_writer(path: str, backend: str):
         from sparknet_tpu.data.lmdb_io import LmdbWriter
 
         return LmdbWriter(path)
-    raise ValueError(f"unknown db backend {backend!r} (record | lmdb)")
+    if backend == "leveldb":
+        from sparknet_tpu.data.leveldb_io import LevelDbWriter
+
+        return LevelDbWriter(path)
+    raise ValueError(
+        f"unknown db backend {backend!r} (record | lmdb | leveldb)")
 
 
 def _value_encoder(backend: str):
-    if backend == "lmdb":
+    if backend in ("lmdb", "leveldb"):
         from sparknet_tpu.data.io_utils import array_to_datum
 
         return lambda image, label: array_to_datum(
@@ -87,13 +96,20 @@ def _value_encoder(backend: str):
 
 
 def _open_reader(path: str):
-    """(db, decode) for either backend; LMDB detected by meta magic."""
+    """(db, decode) for any backend; LMDB detected by meta magic,
+    LevelDB by its CURRENT file (both hold Caffe Datum values)."""
     from sparknet_tpu.data import lmdb_io
 
     if lmdb_io.is_lmdb(path):
         from sparknet_tpu.data.io_utils import datum_to_array
 
         return lmdb_io.LmdbReader(path), datum_to_array
+    from sparknet_tpu.data import leveldb_io
+
+    if leveldb_io.is_leveldb(path):
+        from sparknet_tpu.data.io_utils import datum_to_array
+
+        return leveldb_io.LevelDbReader(path), datum_to_array
     return RecordDB(path, "r"), decode_datum
 
 

@@ -1,0 +1,305 @@
+"""Clean-room LevelDB codec: round-trips + byte-level format invariants.
+
+ref: caffe/src/caffe/util/db_leveldb.cpp (the reference's LevelDB
+Cursor/Transaction).  No libleveldb exists in this environment, so the
+format is pinned the same two ways as the LMDB codec: round-trips
+through our own reader/writer, and byte-level invariants against the
+published on-disk layout (log record framing + CRC32C masking, SSTable
+footer magic, VersionEdit tags, snappy block encoding).
+"""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.data import leveldb_io
+from sparknet_tpu.data.leveldb_io import (
+    LevelDbReader,
+    LevelDbWriter,
+    crc32c,
+    crc_mask,
+    crc_unmask,
+    is_leveldb,
+    snappy_decompress,
+)
+
+
+def _write(path, items, sst=False):
+    with LevelDbWriter(str(path), sst=sst) as w:
+        for k, v in items:
+            w.put(k, v)
+    return str(path)
+
+
+class TestPrimitives:
+    def test_crc32c_known_vectors(self):
+        # RFC 3720 test vectors for CRC32C
+        assert crc32c(b"") == 0x00000000
+        assert crc32c(b"\x00" * 32) == 0x8A9136AA
+        assert crc32c(b"\xff" * 32) == 0x62A8AB43
+        assert crc32c(bytes(range(32))) == 0x46DD794E
+
+    def test_crc_mask_roundtrip(self):
+        for v in (0, 1, 0xDEADBEEF, 0xFFFFFFFF):
+            assert crc_unmask(crc_mask(v)) == v
+        # masking must actually change the value (the point of the mask)
+        assert crc_mask(0x12345678) != 0x12345678
+
+    def test_snappy_literal(self):
+        # tag: literal, len 5-1=4 -> (4<<2)|0
+        src = bytes([5, (4 << 2) | 0]) + b"hello"
+        assert snappy_decompress(src) == b"hello"
+
+    def test_snappy_copy1_rle(self):
+        # "aaaaaaaa": literal 'a' then copy1 len 7 offset 1 (overlap RLE)
+        src = bytes([8, (0 << 2) | 0]) + b"a" + bytes([((7 - 4) << 2) | 1, 1])
+        assert snappy_decompress(src) == b"a" * 8
+
+    def test_snappy_copy2(self):
+        # "abcdabcd": literal "abcd", copy2 len 4 offset 4
+        src = (bytes([8]) + bytes([(3 << 2) | 0]) + b"abcd"
+               + bytes([((4 - 1) << 2) | 2]) + struct.pack("<H", 4))
+        assert snappy_decompress(src) == b"abcdabcd"
+
+    def test_snappy_length_mismatch_rejected(self):
+        src = bytes([9, (4 - 1) << 2]) + b"hell"
+        with pytest.raises(ValueError, match="declared"):
+            snappy_decompress(src)
+
+    def test_log_fragmentation_roundtrip(self):
+        # a payload spanning >2 blocks exercises FIRST/MIDDLE/LAST
+        big = os.urandom(70_000)
+        raw = leveldb_io._write_log_records([b"small", big, b"tail"])
+        assert len(raw) > 2 * leveldb_io.BLOCK_SIZE
+        got = list(leveldb_io._log_records(raw))
+        assert got == [b"small", big, b"tail"]
+
+    def test_log_crc_detects_corruption(self):
+        raw = bytearray(leveldb_io._write_log_records([b"payload"]))
+        raw[9] ^= 0xFF  # flip a payload byte
+        with pytest.raises(ValueError, match="CRC"):
+            list(leveldb_io._log_records(bytes(raw)))
+
+
+class TestRoundTrip:
+    def test_log_only_db(self, tmp_path):
+        items = [(f"{i:08d}".encode(), f"value-{i}".encode()) for i in range(7)]
+        p = _write(tmp_path / "db", items)
+        assert is_leveldb(p)
+        with LevelDbReader(p) as r:
+            assert len(r) == 7
+            assert list(r) == items
+
+    def test_sst_db(self, tmp_path):
+        items = [(f"{i:08d}".encode(), os.urandom(40)) for i in range(500)]
+        p = _write(tmp_path / "db", items, sst=True)
+        with LevelDbReader(p) as r:
+            assert len(r) == 500
+            assert list(r) == sorted(items)
+
+    def test_sst_multi_block(self, tmp_path):
+        # values big enough to force several 4 KiB data blocks
+        items = [(f"{i:08d}".encode(), os.urandom(900)) for i in range(64)]
+        p = _write(tmp_path / "db", items, sst=True)
+        with LevelDbReader(p) as r:
+            assert dict(r) == dict(items)
+
+    def test_duplicate_key_last_wins(self, tmp_path):
+        p = _write(tmp_path / "db", [(b"k", b"first"), (b"k", b"second")])
+        with LevelDbReader(p) as r:
+            assert dict(r) == {b"k": b"second"}
+
+    def test_empty_db(self, tmp_path):
+        p = _write(tmp_path / "db", [])
+        with LevelDbReader(p) as r:
+            assert len(r) == 0
+
+    def test_log_overrides_sst(self, tmp_path):
+        """Memtable (log) entries are newer than flushed tables: the log
+        replay must win — the recovery-order rule."""
+        p = _write(tmp_path / "db", [(b"k", b"old"), (b"z", b"zv")], sst=True)
+        # append a live log with a higher sequence updating k
+        batch = leveldb_io._encode_batch(100, [(b"k", b"new")])
+        with open(os.path.join(p, "000006.log"), "wb") as f:
+            f.write(leveldb_io._write_log_records([batch]))
+        with LevelDbReader(p) as r:
+            assert dict(r) == {b"k": b"new", b"z": b"zv"}
+
+    def test_deletion_drops_key(self, tmp_path):
+        p = _write(tmp_path / "db", [(b"a", b"1"), (b"b", b"2")])
+        # hand-build a deletion batch in the live log (seq above writer's)
+        payload = bytearray(struct.pack("<QI", 50, 1))
+        payload.append(0)  # kTypeDeletion
+        payload.append(1)  # varint key len
+        payload += b"a"
+        raw = open(os.path.join(p, "000003.log"), "rb").read()
+        with open(os.path.join(p, "000003.log"), "wb") as f:
+            f.write(raw + leveldb_io._write_log_records([bytes(payload)]))
+        with LevelDbReader(p) as r:
+            assert dict(r) == {b"b": b"2"}
+
+
+class TestWriterValidation:
+    def test_refuses_existing_leveldb_dir(self, tmp_path):
+        """Overlaying a new DB on an old one would merge stale logs with
+        higher sequences over the fresh records — refuse loudly."""
+        p = _write(tmp_path / "db", [(b"k", b"v")])
+        with pytest.raises(ValueError, match="already holds"):
+            LevelDbWriter(p)
+
+    def test_key_validation(self, tmp_path):
+        w = LevelDbWriter(str(tmp_path / "db"))
+        with pytest.raises(ValueError, match="key"):
+            w.put(b"", b"v")
+        w.close()
+
+
+class TestFormatInvariants:
+    def test_current_and_manifest(self, tmp_path):
+        p = _write(tmp_path / "db", [(b"k", b"v")])
+        cur = open(os.path.join(p, "CURRENT"), "rb").read()
+        assert cur == b"MANIFEST-000002\n"
+        # manifest decodes as VersionEdits naming the bytewise comparator
+        state = {}
+        raw = open(os.path.join(p, "MANIFEST-000002"), "rb").read()
+        for payload in leveldb_io._log_records(raw):
+            leveldb_io._decode_version_edit(payload, state)
+        assert state["comparator"] == b"leveldb.BytewiseComparator"
+        assert state["last_seq"] == 1
+
+    def test_sst_footer_magic(self, tmp_path):
+        p = _write(tmp_path / "db", [(b"k", b"v")], sst=True)
+        raw = open(os.path.join(p, "000005.ldb"), "rb").read()
+        magic = struct.unpack_from("<Q", raw, len(raw) - 8)[0]
+        assert magic == 0xDB4775248B80FB57
+
+    def test_block_crc_detects_corruption(self, tmp_path):
+        p = _write(tmp_path / "db", [(b"key", b"value")], sst=True)
+        f = os.path.join(p, "000005.ldb")
+        raw = bytearray(open(f, "rb").read())
+        raw[2] ^= 0xFF  # flip a data-block byte
+        open(f, "wb").write(bytes(raw))
+        with pytest.raises(ValueError, match="CRC"):
+            with LevelDbReader(p) as r:
+                list(r)
+
+    def test_unknown_comparator_rejected(self, tmp_path):
+        p = _write(tmp_path / "db", [(b"k", b"v")])
+        edit = leveldb_io._encode_version_edit(
+            comparator=b"my.custom.Comparator", log_number=3,
+            next_file=4, last_seq=1,
+        )
+        with open(os.path.join(p, "MANIFEST-000002"), "wb") as f:
+            f.write(leveldb_io._write_log_records([edit]))
+        with pytest.raises(ValueError, match="comparator"):
+            LevelDbReader(p)
+
+    def test_snappy_compressed_block_reads(self, tmp_path):
+        """A table whose block carries compression byte 1 (snappy) —
+        what a stock leveldb build writes — must decode."""
+        # build an SST by hand with one snappy block: literal-only stream
+        entries = leveldb_io._encode_block(
+            [(b"k" + struct.pack("<Q", (1 << 8) | 1), b"vv")]
+        )
+        compressed = bytearray()
+        leveldb_io._put_varint(compressed, len(entries))
+        pos = 0
+        while pos < len(entries):  # chunk into <=60-byte literals
+            chunk = entries[pos : pos + 60]
+            compressed.append((len(chunk) - 1) << 2)
+            compressed += chunk
+            pos += len(chunk)
+        out = bytearray()
+        h_data = (0, len(compressed))
+        out += compressed
+        out.append(1)  # snappy
+        out += struct.pack(
+            "<I", crc_mask(crc32c(bytes(compressed) + b"\x01")))
+        # index block (uncompressed)
+        h = bytearray()
+        leveldb_io._put_varint(h, h_data[0])
+        leveldb_io._put_varint(h, h_data[1])
+        idx = leveldb_io._encode_block(
+            [(b"k" + struct.pack("<Q", (1 << 8) | 1), bytes(h))])
+        idx_handle = leveldb_io._append_block(out, idx)
+        mi_handle = leveldb_io._append_block(out, leveldb_io._encode_block([]))
+        footer = bytearray()
+        for v in (*mi_handle, *idx_handle):
+            leveldb_io._put_varint(footer, v)
+        footer += b"\x00" * (40 - len(footer))
+        footer += struct.pack("<Q", 0xDB4775248B80FB57)
+        out += footer
+        got = list(leveldb_io._sst_entries(bytes(out)))
+        assert got == [(1, 1, b"k", b"vv")]
+
+
+class TestDataLayerIngest:
+    """A LevelDB written by CreateDB feeds the Data-layer minibatch path
+    unchanged — the CifarDBApp flow on its actual backend."""
+
+    def _images(self, n, shape=(3, 8, 8)):
+        rs = np.random.RandomState(0)
+        return [
+            (rs.randint(0, 255, shape).astype(np.uint8), i % 10)
+            for i in range(n)
+        ]
+
+    def test_leveldb_feeds_db_minibatches(self, tmp_path):
+        from sparknet_tpu.data.createdb import create_db, db_minibatches
+
+        samples = self._images(20)
+        p = str(tmp_path / "caffe_leveldb")
+        n = create_db(p, samples, backend="leveldb")
+        assert n == 20 and is_leveldb(p)
+        batches = list(db_minibatches(p, 8))
+        assert len(batches) == 2
+        np.testing.assert_array_equal(
+            batches[0]["data"][0], samples[0][0].astype(np.float32)
+        )
+        assert batches[0]["label"][:4].tolist() == [0, 1, 2, 3]
+
+    def test_convert_leveldb_to_lmdb(self, tmp_path):
+        from sparknet_tpu.data.createdb import convert_db, create_db, db_minibatches
+
+        samples = self._images(12)
+        src = str(tmp_path / "ldb")
+        dst = str(tmp_path / "mdb")
+        create_db(src, samples, backend="leveldb")
+        assert convert_db(src, dst, backend="lmdb") == 12
+        batches = list(db_minibatches(dst, 12))
+        np.testing.assert_array_equal(
+            batches[0]["data"],
+            np.stack([s[0] for s in samples]).astype(np.float32),
+        )
+
+    def test_cli_train_from_leveldb(self, tmp_path, monkeypatch):
+        """tpunet train --data db:<leveldb> — backend: LEVELDB parity for
+        the cifar10_full-style prototxt."""
+        from sparknet_tpu.cli import main
+        from sparknet_tpu.data.createdb import create_db
+
+        monkeypatch.chdir(tmp_path)
+        samples = self._images(24, shape=(3, 12, 12))
+        db = str(tmp_path / "train_leveldb")
+        create_db(db, samples, backend="leveldb")
+        (tmp_path / "net.prototxt").write_text(
+            'name: "ldbnet"\n'
+            'layer { name: "d" type: "Data" top: "data" top: "label"\n'
+            '  data_param { source: "train_leveldb" batch_size: 8\n'
+            "    backend: LEVELDB }\n"
+            "}\n"
+            'layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"\n'
+            "  inner_product_param { num_output: 4 } }\n"
+            'layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" '
+            'bottom: "label" top: "loss" }\n'
+        )
+        (tmp_path / "solver.prototxt").write_text(
+            'net: "net.prototxt"\nbase_lr: 0.01\nmax_iter: 2\ndisplay: 0\n'
+        )
+        assert main([
+            "train", "--solver", str(tmp_path / "solver.prototxt"),
+            "--data", "proto", "--iterations", "2",
+            "--output", str(tmp_path / "out"),
+        ]) == 0
