@@ -27,19 +27,54 @@ class CifarDBApp:
     DBs once, then train reading through the DB cursor."""
 
     def __init__(self, data_dir: str, db_dir: str, batch: int = 100,
-                 log_dir: str = "."):
+                 log_dir: str = ".", backend: str = "record"):
+        """``backend``: record (native) | lmdb | leveldb — the latter two
+        are the reference's own on-disk formats (CifarDBApp.scala writes
+        LevelDB through the C API)."""
         self.log = EventLogger(log_dir, prefix="cifar_db_log")
         self.batch = batch
-        self.train_db = os.path.join(db_dir, "cifar_train.sndb")
-        self.test_db = os.path.join(db_dir, "cifar_test.sndb")
+        exts = {"record": ".sndb", "lmdb": "_lmdb", "leveldb": "_leveldb"}
+        if backend not in exts:
+            raise ValueError(
+                f"unknown db backend {backend!r} ({' | '.join(exts)})")
+        ext = exts[backend]
+        self.train_db = os.path.join(db_dir, f"cifar_train{ext}")
+        self.test_db = os.path.join(db_dir, f"cifar_test{ext}")
         mean_path = os.path.join(db_dir, "mean.npy")
         os.makedirs(db_dir, exist_ok=True)
-        if not (os.path.exists(self.train_db) and os.path.exists(self.test_db)):
+
+        def ready(path: str) -> bool:
+            """Materialization completeness, not mere existence: the
+            directory backends create their dir immediately but write
+            content at close(), so a crash mid-materialize leaves a
+            half-DB that exists() would wrongly reuse."""
+            if backend == "record":
+                return os.path.exists(path)
+            if backend == "lmdb":
+                from sparknet_tpu.data.lmdb_io import is_lmdb
+
+                return is_lmdb(path)
+            from sparknet_tpu.data.leveldb_io import is_leveldb
+
+            return is_leveldb(path)
+
+        if not (ready(self.train_db) and ready(self.test_db)):
+            import shutil
+
+            for p in (self.train_db, self.test_db):
+                # clear partial leftovers: LevelDbWriter refuses to
+                # overlay an existing dir (and rightly so)
+                if os.path.isdir(p):
+                    shutil.rmtree(p)
+                elif os.path.exists(p):
+                    os.remove(p)
             self.log("materializing DBs")
             loader = CifarLoader(data_dir)
             create_db(self.train_db,
-                      zip(loader.train_images, loader.train_labels))
-            create_db(self.test_db, zip(loader.test_images, loader.test_labels))
+                      zip(loader.train_images, loader.train_labels),
+                      backend=backend)
+            create_db(self.test_db, zip(loader.test_images, loader.test_labels),
+                      backend=backend)
             self.mean_image = loader.mean_image
             np.save(mean_path, self.mean_image)
         elif os.path.exists(mean_path):

@@ -329,3 +329,125 @@ class TestLeNetEndToEnd:
                 np.asarray(gb), mod.bias.grad.numpy(), atol=1e-3, rtol=1e-3,
                 err_msg=f"{name} bias grad",
             )
+
+
+class TestBatchNorm:
+    """Caffe BatchNorm (no affine — pair with Scale) vs torch batch_norm.
+    Train mode: batch statistics, biased variance (E[x^2]-E[x]^2), matching
+    torch's training=True normalization; global mode: stored sums scaled by
+    scale_factor, the running-stat path (batch_norm_layer.cpp:27-56)."""
+
+    def test_train_mode_matches_batch_stats(self, rng):
+        x = rng.randn(4, 3, 5, 6).astype(np.float32) * 2 + 1
+        layer = make_layer(
+            """layer { name: "bn" type: "BatchNorm" bottom: "x" top: "y"
+              batch_norm_param { eps: 1e-5 } }"""
+        )
+        _, state = layer.init(jax.random.key(0), [x.shape])
+        out = layer.apply([], state, [jnp.asarray(x)], train=True,
+                          rng=jax.random.key(0))
+        theirs = F.batch_norm(
+            t(x), None, None, weight=None, bias=None,
+            training=True, eps=1e-5,
+        ).numpy()
+        np.testing.assert_allclose(
+            np.asarray(out.outputs[0]), theirs, atol=1e-4, rtol=1e-4
+        )
+
+    def test_global_stats_match_running_stats(self, rng):
+        x = rng.randn(4, 3, 5, 6).astype(np.float32)
+        rm = rng.randn(3).astype(np.float32)
+        rv = (rng.rand(3).astype(np.float32) + 0.5)
+        layer = make_layer(
+            """layer { name: "bn" type: "BatchNorm" bottom: "x" top: "y"
+              batch_norm_param { use_global_stats: true eps: 1e-5 } }"""
+        )
+        # Caffe stores SUMS + a scale factor; stored/scale = the stat
+        state = {
+            "mean": jnp.asarray(rm * 4.0),
+            "variance": jnp.asarray(rv * 4.0),
+            "scale_factor": jnp.asarray([4.0]),
+        }
+        out = layer.apply([], state, [jnp.asarray(x)], train=False,
+                          rng=jax.random.key(0))
+        theirs = F.batch_norm(
+            t(x), t(rm), t(rv), training=False, eps=1e-5
+        ).numpy()
+        np.testing.assert_allclose(
+            np.asarray(out.outputs[0]), theirs, atol=1e-4, rtol=1e-4
+        )
+
+
+class TestPReLU:
+    @pytest.mark.parametrize("shared", [False, True])
+    def test_forward_and_grad(self, rng, shared):
+        x = rng.randn(3, 4, 5, 5).astype(np.float32)
+        a = (rng.rand(1 if shared else 4).astype(np.float32) * 0.5)
+        layer = make_layer(
+            f"""layer {{ name: "p" type: "PReLU" bottom: "x" top: "y"
+              prelu_param {{ channel_shared: {'true' if shared else 'false'} }} }}"""
+        )
+
+        def loss(xa, aa):
+            out = layer.apply([aa], {}, [xa], train=True, rng=None)
+            return jnp.sum(out.outputs[0] ** 3)
+
+        (ours_fwd,) = apply_layer(layer, [a], [x])
+        gx, ga = jax.grad(loss, argnums=(0, 1))(jnp.asarray(x), jnp.asarray(a))
+
+        xt = t(x).requires_grad_()
+        at = t(a).requires_grad_()
+        theirs = F.prelu(xt, at)
+        theirs.pow(3).sum().backward()
+        np.testing.assert_allclose(ours_fwd, theirs.detach().numpy(),
+                                   atol=ATOL, rtol=RTOL)
+        np.testing.assert_allclose(np.asarray(gx), xt.grad.numpy(),
+                                   atol=1e-3, rtol=1e-3)
+        np.testing.assert_allclose(np.asarray(ga), at.grad.numpy(),
+                                   atol=1e-3, rtol=1e-3)
+
+
+class TestEmbed:
+    def test_forward_and_weight_grad(self, rng):
+        vocab, dim = 11, 7
+        idx = rng.randint(0, vocab, (4, 3)).astype(np.int32)
+        w = rng.randn(vocab, dim).astype(np.float32)
+        layer = make_layer(
+            f"""layer {{ name: "e" type: "Embed" bottom: "i" top: "y"
+              embed_param {{ input_dim: {vocab} num_output: {dim}
+                bias_term: false }} }}"""
+        )
+        (ours,) = apply_layer(layer, [w], [idx])
+        theirs = F.embedding(t(idx).long(), t(w))
+        np.testing.assert_allclose(ours, theirs.numpy(), atol=ATOL, rtol=RTOL)
+
+        def loss(wa):
+            out = layer.apply([wa], {}, [jnp.asarray(idx)], train=True, rng=None)
+            return jnp.sum(out.outputs[0] ** 2)
+
+        gw = jax.grad(loss)(jnp.asarray(w))
+        wt = t(w).requires_grad_()
+        F.embedding(t(idx).long(), wt).pow(2).sum().backward()
+        np.testing.assert_allclose(np.asarray(gw), wt.grad.numpy(),
+                                   atol=1e-3, rtol=1e-3)
+
+
+class TestMVN:
+    @pytest.mark.parametrize("across", [False, True])
+    def test_matches_manual_layer_norm_math(self, rng, across):
+        """MVN = instance/layer norm without affine; torch's
+        F.instance_norm / F.layer_norm are the oracles."""
+        x = rng.randn(3, 4, 6, 5).astype(np.float32) * 3 + 2
+        layer = make_layer(
+            f"""layer {{ name: "m" type: "MVN" bottom: "x" top: "y"
+              mvn_param {{ across_channels: {'true' if across else 'false'}
+                normalize_variance: true eps: 1e-9 }} }}"""
+        )
+        (ours,) = apply_layer(layer, [], [x])
+        if across:
+            theirs = F.layer_norm(t(x), x.shape[1:], eps=1e-9).numpy()
+        else:
+            theirs = F.instance_norm(t(x), eps=1e-9).numpy()
+        # MVN divides by (std + eps), torch by sqrt(var + eps): identical
+        # to float tolerance at these magnitudes
+        np.testing.assert_allclose(ours, theirs, atol=5e-4, rtol=5e-4)
