@@ -31,34 +31,25 @@ class CifarDBApp:
         """``backend``: record (native) | lmdb | leveldb — the latter two
         are the reference's own on-disk formats (CifarDBApp.scala writes
         LevelDB through the C API)."""
-        self.log = EventLogger(log_dir, prefix="cifar_db_log")
-        self.batch = batch
         exts = {"record": ".sndb", "lmdb": "_lmdb", "leveldb": "_leveldb"}
         if backend not in exts:
+            # validate BEFORE any side effect (the logger creates a file)
             raise ValueError(
                 f"unknown db backend {backend!r} ({' | '.join(exts)})")
+        self.log = EventLogger(log_dir, prefix="cifar_db_log")
+        self.batch = batch
         ext = exts[backend]
         self.train_db = os.path.join(db_dir, f"cifar_train{ext}")
         self.test_db = os.path.join(db_dir, f"cifar_test{ext}")
+        # a crash mid-materialize leaves readable-but-truncated DBs in
+        # EVERY backend (record commits every 1000; the dir backends
+        # write at close), so completeness is tracked by a marker
+        # written after both DBs + the mean land
+        done_marker = os.path.join(db_dir, f".materialized{ext}")
         mean_path = os.path.join(db_dir, "mean.npy")
         os.makedirs(db_dir, exist_ok=True)
 
-        def ready(path: str) -> bool:
-            """Materialization completeness, not mere existence: the
-            directory backends create their dir immediately but write
-            content at close(), so a crash mid-materialize leaves a
-            half-DB that exists() would wrongly reuse."""
-            if backend == "record":
-                return os.path.exists(path)
-            if backend == "lmdb":
-                from sparknet_tpu.data.lmdb_io import is_lmdb
-
-                return is_lmdb(path)
-            from sparknet_tpu.data.leveldb_io import is_leveldb
-
-            return is_leveldb(path)
-
-        if not (ready(self.train_db) and ready(self.test_db)):
+        if not os.path.exists(done_marker):
             import shutil
 
             for p in (self.train_db, self.test_db):
@@ -77,6 +68,8 @@ class CifarDBApp:
                       backend=backend)
             self.mean_image = loader.mean_image
             np.save(mean_path, self.mean_image)
+            with open(done_marker, "w") as f:
+                f.write("ok\n")
         elif os.path.exists(mean_path):
             self.log("reusing existing DBs + mean")
             from sparknet_tpu.data.transform import load_mean_file

@@ -22,6 +22,7 @@ SCRIPTS = {
     "net_surgery.py": 560,
     "04_distributed_training.py": 1100,
     "06_listfile_sources.py": 560,
+    "08_db_backends.py": 560,
 }
 
 

@@ -465,18 +465,20 @@ def test_db_apps_cifar_and_imagenet(tmp_path, cifar_dir):
     scores3 = app3.run(num_iters=2, test_batches=1)
     assert "accuracy" in scores3
 
-    # a crash mid-materialize leaves a half-DB dir: reconstruction must
-    # clear it and rebuild instead of wedging on reuse
+    # a crash mid-materialize leaves a half-DB (no done marker):
+    # reconstruction must clear and rebuild instead of wedging on reuse
     import shutil
 
     shutil.rmtree(str(tmp_path / "dbs_ldb" / "cifar_test_leveldb"))
     (tmp_path / "dbs_ldb" / "cifar_test_leveldb").mkdir()  # empty husk
+    os.remove(str(tmp_path / "dbs_ldb" / ".materialized_leveldb"))
     app4 = CifarDBApp(cifar_dir, str(tmp_path / "dbs_ldb"), batch=10,
                       log_dir=str(tmp_path), backend="leveldb")
     assert app4.run(num_iters=1, test_batches=1)["accuracy"] >= 0.0
 
     with pytest.raises(ValueError, match="unknown db backend"):
-        CifarDBApp(cifar_dir, str(tmp_path / "x"), backend="lvldb")
+        CifarDBApp(cifar_dir, str(tmp_path / "x"),
+                   log_dir=str(tmp_path), backend="lvldb")
 
     # tiny imagenet-style shard
     rs = np.random.RandomState(0)

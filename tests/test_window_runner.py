@@ -1,0 +1,147 @@
+"""tools/tpu_window_runner.py — queue/journal logic.
+
+The runner babysits the fragile TPU relay and spends short healthy
+windows on the evidence queue; its correctness decides whether scarce
+chip minutes turn into banked measurements, so the pure logic (journal
+accounting, dependency gating, per-window retry policy, deadline kill)
+is pinned here with the dial stubbed out.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def runner(tmp_path, monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "tpu_window_runner", os.path.join(ROOT, "tools", "tpu_window_runner.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "EVIDENCE_DIR", str(tmp_path / "evidence"))
+    monkeypatch.setattr(
+        mod, "JOURNAL", str(tmp_path / "evidence" / "journal.jsonl")
+    )
+    return mod
+
+
+def _queue(tmp_path, jobs, **kw):
+    p = tmp_path / "queue.json"
+    p.write_text(json.dumps({"max_hours": 0.01, "jobs": jobs, **kw}))
+    return str(p)
+
+
+def ok_job(name, needs=None):
+    j = {"name": name, "argv": [sys.executable, "-c", "print('done')"],
+         "deadline_s": 30}
+    if needs:
+        j["needs"] = needs
+    return j
+
+
+def fail_job(name):
+    return {"name": name, "argv": [sys.executable, "-c", "raise SystemExit(3)"],
+            "deadline_s": 30}
+
+
+def test_drains_dependency_chain_in_one_window(runner, tmp_path, monkeypatch):
+    """leg2 needs leg1: both must run in the SAME healthy window."""
+    monkeypatch.setattr(runner, "dial", lambda: True)
+    q = _queue(tmp_path, [ok_job("leg1"), ok_job("leg2", needs="leg1")])
+    monkeypatch.setattr(sys, "argv", ["runner", q])
+    assert runner.main() == 0
+    state = runner.load_done()
+    assert state == {"leg1": -1, "leg2": -1}
+
+
+def test_failed_job_gets_one_shot_per_window(runner, tmp_path, monkeypatch):
+    dials = []
+
+    def dial():
+        dials.append(1)
+        return len(dials) <= 3  # three windows, then stop dialing green
+
+    monkeypatch.setattr(runner, "dial", dial)
+    q = _queue(tmp_path, [fail_job("flaky"), ok_job("solid")],
+               max_attempts=2)
+    monkeypatch.setattr(sys, "argv", ["runner", q])
+    runner.main()
+    state = runner.load_done()
+    # flaky burned one attempt per window up to max_attempts=2; solid
+    # still ran (the failure didn't block the rest of the window)
+    assert state["flaky"] == 2
+    assert state["solid"] == -1
+
+
+def test_dependent_of_failed_job_never_runs(runner, tmp_path, monkeypatch):
+    monkeypatch.setattr(runner, "dial", lambda: True)
+    q = _queue(tmp_path, [fail_job("base"), ok_job("dep", needs="base")],
+               max_attempts=1)
+    monkeypatch.setattr(sys, "argv", ["runner", q])
+    runner.main()
+    state = runner.load_done()
+    assert state["base"] == 1
+    assert "dep" not in state
+    assert not os.path.exists(
+        os.path.join(runner.EVIDENCE_DIR, "dep.txt"))
+
+
+def test_timeout_kills_job_and_returns_to_dialing(runner, tmp_path, monkeypatch):
+    windows = []
+
+    def dial():
+        windows.append(1)
+        return len(windows) == 1  # one window only
+
+    monkeypatch.setattr(runner, "dial", dial)
+    hang = {"name": "hang",
+            "argv": [sys.executable, "-c", "import time; time.sleep(60)"],
+            "deadline_s": 2}
+    q = _queue(tmp_path, [hang, ok_job("after")])
+    monkeypatch.setattr(sys, "argv", ["runner", q])
+    runner.main()
+    state = runner.load_done()
+    # the hang counts as an attempt; 'after' did NOT run in that window
+    # (a hung job means the window closed)
+    assert state["hang"] == 1
+    assert "after" not in state
+    events = [json.loads(l) for l in open(runner.JOURNAL)]
+    end = [e for e in events if e.get("event") == "job_end"][0]
+    assert end["timed_out"] is True and end["rc"] is None
+
+
+def test_journal_marks_success_permanently(runner, tmp_path, monkeypatch):
+    """A second invocation skips already-green jobs (resume semantics)."""
+    monkeypatch.setattr(runner, "dial", lambda: True)
+    q = _queue(tmp_path, [ok_job("once")])
+    monkeypatch.setattr(sys, "argv", ["runner", q])
+    assert runner.main() == 0
+    n_before = sum(
+        1 for l in open(runner.JOURNAL)
+        if json.loads(l).get("event") == "job_start"
+    )
+    assert runner.main() == 0  # re-run: queue already drained
+    n_after = sum(
+        1 for l in open(runner.JOURNAL)
+        if json.loads(l).get("event") == "job_start"
+    )
+    assert n_before == n_after == 1
+
+
+def test_job_output_banked_to_evidence_file(runner, tmp_path, monkeypatch):
+    monkeypatch.setattr(runner, "dial", lambda: True)
+    q = _queue(tmp_path, [{
+        "name": "emits",
+        "argv": [sys.executable, "-c", "print('the-evidence-line')"],
+        "deadline_s": 30,
+    }])
+    monkeypatch.setattr(sys, "argv", ["runner", q])
+    runner.main()
+    out = open(os.path.join(runner.EVIDENCE_DIR, "emits.txt")).read()
+    assert "the-evidence-line" in out
