@@ -16,6 +16,7 @@ here): ``--data cifar:<dir>`` reads real CIFAR-10 binaries;
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
 import sys
@@ -134,9 +135,29 @@ def _make_solver(solver_cfg, net_param, args):
     what the layer declarations leave open."""
     from sparknet_tpu.solvers.solver import Solver
 
-    return Solver(
-        solver_cfg, net_param, feed_shapes=_peeked_feed_shapes(args, net_param)
-    )
+    with _clean_shape_errors():
+        return Solver(
+            solver_cfg, net_param,
+            feed_shapes=_peeked_feed_shapes(args, net_param),
+        )
+
+
+@contextlib.contextmanager
+def _clean_shape_errors():
+    """Turn the compiler's unknown-input-shape ValueError into an
+    actionable CLI exit (every net-construction site shares it)."""
+    try:
+        yield
+    except ValueError as e:
+        if "no shape known" not in str(e):
+            raise
+        raise SystemExit(
+            f"{e} — the net's data layers declare no geometry on this "
+            "host (a Data layer's shape comes from its DB, ref: "
+            "data_layer.cpp DataLayerSetUp); stream one with --data "
+            "db:<path>, keep data_param.source on disk, or use "
+            "Input/RDD layers"
+        ) from None
 
 
 def _attach_device_augment(train_fn, cfg, pid):
@@ -175,14 +196,39 @@ def _device_augment_guards(args):
             "(use --augment host there)")
 
 
+def _auto_data(args, net) -> str:
+    """Resolve the ``--data auto`` sentinel (the default): a net whose
+    own data layers are self-describing streams them — ``caffe train
+    --solver=x`` semantics — otherwise synthetic batches (zoo/RDD nets,
+    where smoke runs feed random data by design).  Declaration check
+    only (cheap, no file I/O): the proto branch builds the source and
+    raises the loud cannot-stream error for unreadable declared sources.
+    Returns ``args.data`` unchanged when it isn't ``auto``."""
+    if args.data != "auto":
+        return args.data
+    from sparknet_tpu.data.listfile import _SOURCES
+
+    if any(l.type in _SOURCES for l in net.input_layers):
+        return "proto"
+    return "synthetic"
+
+
 def _data_fns(args, net):
     """(train_fn, test_fn) from --data.
+
+    Resolves the ``auto`` sentinel IN PLACE (``args.data`` holds the
+    concrete mode afterwards — cmd_train's TEST-net source hookup reads
+    it; callers that need the mode resolved earlier call ``_auto_data``
+    themselves).
 
     In a multi-process job each process must stream DIFFERENT data (its
     own partition, ref: CifarApp.scala:118-130 per-executor RDD
     partitions): batch indices interleave by process id and the
     synthetic stream seeds per process."""
     import jax
+
+    was_auto = args.data == "auto"
+    args.data = _auto_data(args, net)
 
     if (getattr(args, "augment", "host") == "device"
             and not args.data.startswith(("cifar:", "db:"))):
@@ -206,7 +252,16 @@ def _data_fns(args, net):
             train_src = source_from_net(
                 net, seed=1234 + pid, anchor=getattr(args, "solver", ""))
         except (OSError, ValueError, LookupError) as e:
-            raise SystemExit(f"--data proto: {e}") from None
+            mode = "auto" if was_auto else "proto"
+            # never silently substitute random data for a declared
+            # source — a garbage model trained without error is the
+            # worst outcome
+            raise SystemExit(
+                f"--data {mode}: the net's data layer declares a source "
+                f"that cannot stream ({e}); pass --data db:<path> / "
+                "cifar:<dir> to point at the data, or --data synthetic "
+                "to smoke-run on random batches"
+            ) from None
 
         # Eval fallback: a SEPARATE lazily-built instance with a fixed
         # seed so every process scores the identical stream (the cifar/db
@@ -965,9 +1020,11 @@ def cmd_extract_features(args) -> int:
     from sparknet_tpu.net import TPUNet
 
     net_param, solver_cfg = _build_net_and_solver(args)
-    net = TPUNet(
-        solver_cfg, net_param, feed_shapes=_peeked_feed_shapes(args, net_param)
-    )
+    with _clean_shape_errors():
+        net = TPUNet(
+            solver_cfg, net_param,
+            feed_shapes=_peeked_feed_shapes(args, net_param),
+        )
     if args.snapshot and getattr(args, "weights", ""):
         raise SystemExit("--snapshot and --weights are mutually exclusive")
     if args.snapshot:
@@ -1397,8 +1454,10 @@ def main(argv=None) -> int:
 
     def common(sp):
         sp.add_argument("--solver", help="solver prototxt path or zoo:<name>")
-        sp.add_argument("--data", default="synthetic",
-                        help="cifar:<dir> | db:<path>[,<test_path>] | proto "
+        sp.add_argument("--data", default="auto",
+                        help="auto (default: the net's own data layers when "
+                        "they declare a streamable source, else synthetic) | "
+                        "cifar:<dir> | db:<path>[,<test_path>] | proto "
                         "(stream from the net's own Data/ImageData/WindowData/"
                         "HDF5Data layers — the caffe-train-from-solver flow) "
                         "| synthetic")

@@ -363,6 +363,67 @@ def test_cli_train_data_proto_streams_own_source(tmp_path, monkeypatch):
     assert (tmp_path / "out.solverstate.npz").exists()
 
 
+def test_cli_data_auto_streams_own_source(tmp_path, monkeypatch, capsys):
+    """Default --data (auto): a prototxt whose Data layer has a readable
+    source trains from IT — `caffe train --solver=x` semantics — with no
+    data flag at all."""
+    import numpy as np
+
+    monkeypatch.chdir(tmp_path)
+
+    from sparknet_tpu.cli import main
+    from sparknet_tpu.data.createdb import create_db
+
+    rs = np.random.RandomState(0)
+    samples = [(rs.randint(0, 255, (3, 10, 10)).astype(np.uint8), i % 3)
+               for i in range(12)]
+    create_db(str(tmp_path / "auto_lmdb"), samples, backend="lmdb")
+    (tmp_path / "net.prototxt").write_text(
+        'name: "auto"\n'
+        'layer { name: "d" type: "Data" top: "data" top: "label"\n'
+        '  data_param { source: "auto_lmdb" batch_size: 4 } }\n'
+        'layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"\n'
+        "  inner_product_param { num_output: 3 } }\n"
+        'layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" '
+        'bottom: "label" top: "loss" }\n'
+    )
+    (tmp_path / "solver.prototxt").write_text(
+        'net: "net.prototxt"\nbase_lr: 0.01\nmax_iter: 2\ndisplay: 0\n'
+    )
+    assert main([
+        "train", "--solver", str(tmp_path / "solver.prototxt"),
+        "--iterations", "2", "--output", str(tmp_path / "out"),
+    ]) == 0
+
+
+def test_cli_data_auto_missing_source_is_loud(tmp_path, monkeypatch):
+    """auto must NOT fall back to random noise when the net points at a
+    source that cannot stream — that silent substitution would train a
+    garbage model."""
+    import pytest
+
+    monkeypatch.chdir(tmp_path)
+
+    from sparknet_tpu.cli import main
+
+    (tmp_path / "net.prototxt").write_text(
+        'name: "x"\n'
+        'layer { name: "d" type: "ImageData" top: "data" top: "label"\n'
+        '  image_data_param { source: "no_such_list.txt" batch_size: 2 }\n'
+        "  transform_param { crop_size: 4 } }\n"
+        'layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"\n'
+        "  inner_product_param { num_output: 2 } }\n"
+        'layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" '
+        'bottom: "label" top: "loss" }\n'
+    )
+    (tmp_path / "solver.prototxt").write_text(
+        'net: "net.prototxt"\nbase_lr: 0.01\nmax_iter: 1\n'
+    )
+    with pytest.raises(SystemExit, match="cannot stream"):
+        main(["train", "--solver", str(tmp_path / "solver.prototxt"),
+              "--iterations", "1"])
+
+
 def test_data_layer_peeks_its_own_source(tmp_path, monkeypatch):
     """When data_param.source IS on disk, the net shape-infers with no
     feed help at all — Network.feed_shapes() carries the peeked geometry
