@@ -350,8 +350,16 @@ class Network:
         train: bool | None = None,
         start: str | None = None,
         end: str | None = None,
+        debug_sink: dict | None = None,
     ) -> tuple[dict[str, jax.Array], State, jax.Array]:
         """Forward pass. Returns (all blobs, updated state, total weighted loss).
+
+        ``debug_sink``: when a dict is passed, every executed layer
+        records ``(layer_name, top_name) -> mean(|output|)`` into it AT
+        EXECUTION TIME — in-place ops get their own entry with their own
+        post-op value, unlike the final blob dict where a rebind
+        overwrites its producer (ref: Net::ForwardDebugInfo,
+        net.cpp:658-683).
 
         ``start``/``end`` name the first/last layer to run — the partial
         execution of Net::ForwardFromTo (net.cpp:565-583; pycaffe's
@@ -441,6 +449,8 @@ class Network:
                 new_state[layer.name] = out_state
             for top, o in zip(layer.tops, out.outputs):
                 blob[top] = o
+                if debug_sink is not None and o.size:
+                    debug_sink[(layer.name, top)] = jnp.mean(jnp.abs(o))
             for w, o in zip(layer.loss_weights(), out.outputs):
                 if w != 0.0:
                     total_loss = total_loss + w * jnp.sum(o).astype(jnp.float32)

@@ -88,7 +88,7 @@ class ParallelTrainer:
         # driver loop should build batches for)
         self.num_local_workers = max(self.num_workers // self._mesh_procs, 1)
         self.iter = 0
-        self._step_fn = solver._make_train_step()
+        self._step_fn = solver._make_train_step(debug=False)
         self._rules = rules or ShardingRules()
         self._pshard = param_shardings(
             solver.train_net, solver.variables, self.mesh, self._rules

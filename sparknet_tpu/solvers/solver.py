@@ -86,6 +86,11 @@ class SolverConfig:
     # written alongside the solver state (ref: Solver::Snapshot
     # solver.cpp:447-466 model + state pair); "" skips the model file
     snapshot_format: str = "BINARYPROTO"
+    # per-iteration per-layer forward/param/grad abs-mean diagnostics
+    # (ref: SolverParameter.debug_info + Net::ForwardDebugInfo /
+    # BackwardDebugInfo, net.cpp:658-735) — computed in-graph as cheap
+    # reductions, printed each iteration
+    debug_info: bool = False
 
     @classmethod
     def from_proto(cls, m: Message) -> "SolverConfig":
@@ -129,6 +134,7 @@ class SolverConfig:
             snapshot_prefix=m.get_str("snapshot_prefix", ""),
             snapshot_after_train=m.get_bool("snapshot_after_train", True),
             snapshot_format=m.get_str("snapshot_format", "BINARYPROTO"),
+            debug_info=m.get_bool("debug_info", False),
         )
 
 
@@ -222,16 +228,26 @@ class Solver:
         self._eval_step = self._eval_steps[0]
 
     # ------------------------------------------------------------------
-    def _make_train_step(self):
+    def _make_train_step(self, debug: bool | None = None):
+        """``debug=None`` follows ``config.debug_info``; pass ``False``
+        for consumers that require the plain 3-tuple contract (the
+        distributed trainer packs its own feeds; the bench handle is a
+        public API)."""
         cfg = self.config
         net = self.train_net
         specs = self._specs
 
+        debug = cfg.debug_info if debug is None else debug
+
         def loss_fn(params, state, feeds, rng):
-            blobs, new_state, loss = net.apply(
-                NetVars(params=params, state=state), feeds, rng=rng
+            # execution-time capture only in debug mode: the reductions
+            # are cheap but extra outputs would defeat fusion otherwise
+            sink: dict = {} if debug else None
+            _, new_state, loss = net.apply(
+                NetVars(params=params, state=state), feeds, rng=rng,
+                debug_sink=sink,
             )
-            return loss, new_state
+            return loss, (new_state, sink if debug else {})
 
         if cfg.remat:
             loss_fn = jax.checkpoint(loss_fn)
@@ -243,28 +259,70 @@ class Solver:
                 # accumulation, solver.cpp:221-224 + Normalize)
                 def body(carry, micro):
                     gsum, state, lsum, k = carry
-                    (loss, new_state), g = jax.value_and_grad(loss_fn, has_aux=True)(
-                        variables.params, state, micro, k
-                    )
+                    (loss, (new_state, fwd)), g = jax.value_and_grad(
+                        loss_fn, has_aux=True
+                    )(variables.params, state, micro, k)
                     gsum = jax.tree_util.tree_map(jnp.add, gsum, g)
-                    return (gsum, new_state, lsum + loss, jax.random.fold_in(k, 1)), None
+                    return (
+                        (gsum, new_state, lsum + loss, jax.random.fold_in(k, 1)),
+                        fwd,  # debug: per-micro-batch means, last one shown
+                    )
 
                 zero_g = jax.tree_util.tree_map(jnp.zeros_like, variables.params)
-                (grads, new_state, loss_sum, _), _ = jax.lax.scan(
+                (grads, new_state, loss_sum, _), fwd_seq = jax.lax.scan(
                     body, (zero_g, variables.state, 0.0, rng), feeds
                 )
                 loss = loss_sum / cfg.iter_size
+                fwd = jax.tree_util.tree_map(lambda a: a[-1], fwd_seq)
             else:
-                (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                    variables.params, variables.state, feeds, rng
-                )
+                (loss, (new_state, fwd)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(variables.params, variables.state, feeds, rng)
             rate = learning_rate(cfg, it)
             new_params, new_slots = apply_update(
                 cfg, variables.params, grads, slots, specs, rate, it
             )
-            return NetVars(params=new_params, state=new_state), new_slots, loss
+            out = NetVars(params=new_params, state=new_state), new_slots, loss
+            if not debug:
+                return out
+            stats = {
+                "forward": fwd,
+                "param": {
+                    f"{ln}[{i}]": jnp.mean(jnp.abs(p))
+                    for ln, plist in variables.params.items()
+                    for i, p in enumerate(plist) if p.size
+                },
+                "diff": {
+                    f"{ln}[{i}]": jnp.mean(jnp.abs(g))
+                    for ln, glist in grads.items()
+                    for i, g in enumerate(glist) if g.size
+                },
+            }
+            return (*out, stats)
 
         return train_step
+
+    def _print_debug_info(self, stats) -> None:
+        """Caffe's per-iteration diagnostic lines (ref: net.cpp:658-735
+        ForwardDebugInfo / BackwardDebugInfo / UpdateDebugInfo): top-blob
+        data abs-means at execution time (in-place layers included),
+        param diff abs-means, param data abs-means."""
+        stats = jax.device_get(stats)  # ONE transfer, not one per scalar
+        for (layer, top), v in stats["forward"].items():
+            print(
+                f"    [Forward] Layer {layer}, top blob {top} "
+                f"data: {float(v):.6g}"
+            )
+        for name, v in stats["diff"].items():
+            print(
+                f"    [Backward] Layer {name.split('[')[0]}, "
+                f"param blob {name} diff: {float(v):.6g}"
+            )
+        for name, v in stats["param"].items():
+            print(
+                f"    [Update] Layer {name.split('[')[0]}, "
+                f"param blob {name} data: {float(v):.6g}"
+            )
 
     def _make_eval_step(self, net: Network):
         def eval_step(variables, feeds):
@@ -282,7 +340,8 @@ class Solver:
         call — thread the returned values, do not reuse ``self.variables``
         afterwards."""
         fn = jax.jit(
-            self._make_train_step(), donate_argnums=(0, 1) if donate else ()
+            self._make_train_step(debug=False),
+            donate_argnums=(0, 1) if donate else (),
         )
         return fn, self.variables, self.slots, self._key
 
@@ -295,9 +354,14 @@ class Solver:
         cfg = self.config
         for _ in range(num_iters):
             feeds = data_fn(self.iter)
-            self.variables, self.slots, loss = self._train_step(
+            out = self._train_step(
                 self.variables, self.slots, self.iter, feeds, self._key
             )
+            if cfg.debug_info:
+                self.variables, self.slots, loss, stats = out
+                self._print_debug_info(stats)
+            else:
+                self.variables, self.slots, loss = out
             # Keep losses as device arrays: blocking on float(loss) every
             # iteration would serialize host feed prep against device compute
             # (JAX async dispatch).  Materialize only at display/callback
