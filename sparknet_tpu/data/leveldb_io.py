@@ -33,8 +33,9 @@ pins the table read path in tests.
 
 Scope bounds (loud, like lmdb_io): no filter/meta blocks are written and
 bloom filters in read DBs are ignored (harmless — reads here are full
-scans, not point lookups); snappy COMPRESSION is not implemented (blocks
-write uncompressed, which leveldb accepts); comparators other than
+scans, not point lookups); writing compresses blocks only when
+``compress=True`` (a greedy literal+copy2 snappy encoder, kept per
+leveldb's >=12.5%-shrink rule); comparators other than
 ``leveldb.BytewiseComparator`` are rejected.
 """
 
@@ -47,6 +48,7 @@ __all__ = [
     "LevelDbReader",
     "LevelDbWriter",
     "is_leveldb",
+    "snappy_compress",
     "snappy_decompress",
 ]
 
@@ -96,7 +98,52 @@ def _put_varint(out: bytearray, v: int) -> None:
     out += _varint_bytes(v)
 
 
-# -- snappy (decode only) ----------------------------------------------
+# -- snappy block codec -------------------------------------------------
+
+
+def snappy_compress(src: bytes) -> bytes:
+    """Greedy snappy block encoder (literals + 2-byte-offset copies) —
+    the format stock leveldb writes per table block.  Correctness over
+    ratio: a simple 4-byte-hash matcher, always a valid stream for
+    :func:`snappy_decompress` (and real snappy) to decode."""
+    out = bytearray()
+    _put_varint(out, len(src))
+    n = len(src)
+
+    def emit_literal(lo: int, hi: int) -> None:
+        while lo < hi:
+            ln = min(hi - lo, 60)
+            out.append((ln - 1) << 2)
+            out.extend(src[lo : lo + ln])
+            lo += ln
+
+    table: dict[int, int] = {}
+    pos = lit_start = 0
+    while pos + 4 <= n:
+        key = int.from_bytes(src[pos : pos + 4], "little")
+        cand = table.get(key)
+        table[key] = pos
+        if (
+            cand is not None
+            and pos - cand <= 0xFFFF
+            and src[cand : cand + 4] == src[pos : pos + 4]
+        ):
+            length = 4
+            while (
+                pos + length < n
+                and length < 64
+                and src[cand + length] == src[pos + length]
+            ):
+                length += 1
+            emit_literal(lit_start, pos)
+            out.append(((length - 1) << 2) | 2)  # copy, 2-byte offset
+            out += (pos - cand).to_bytes(2, "little")
+            pos += length
+            lit_start = pos
+        else:
+            pos += 1
+    emit_literal(lit_start, n)
+    return bytes(out)
 
 
 def snappy_decompress(src: bytes) -> bytes:
@@ -332,16 +379,24 @@ def _encode_block(entries, restart_interval: int = 16) -> bytes:
     return bytes(out)
 
 
-def _append_block(out: bytearray, block: bytes) -> tuple[int, int]:
-    """Write block + [compression, crc] trailer; return its handle."""
-    handle = (len(out), len(block))
-    out += block
-    out.append(0)  # no compression
-    out += struct.pack("<I", crc_mask(crc32c(block + b"\x00")))
+def _append_block(out: bytearray, block: bytes,
+                  compress: bool = False) -> tuple[int, int]:
+    """Write block + [compression, crc] trailer; return its handle.
+    ``compress``: snappy the block, kept only if it actually shrinks by
+    >=12.5% (leveldb's own keep-compressed rule, table/table_builder.cc)."""
+    data, ctype = block, 0
+    if compress:
+        packed = snappy_compress(block)
+        if len(packed) < len(block) - len(block) // 8:
+            data, ctype = packed, 1
+    handle = (len(out), len(data))
+    out += data
+    out.append(ctype)
+    out += struct.pack("<I", crc_mask(crc32c(data + bytes([ctype]))))
     return handle
 
 
-def _encode_sst(items, seq_base: int = 1) -> bytes:
+def _encode_sst(items, seq_base: int = 1, compress: bool = False) -> bytes:
     """One SSTable holding ``items`` (sorted (key, value) pairs)."""
     out = bytearray()
     index_entries = []
@@ -353,7 +408,7 @@ def _encode_sst(items, seq_base: int = 1) -> bytes:
         nonlocal batch, batch_bytes
         if not batch:
             return
-        handle = _append_block(out, _encode_block(batch))
+        handle = _append_block(out, _encode_block(batch), compress)
         h = bytearray()
         _put_varint(h, handle[0])
         _put_varint(h, handle[1])
@@ -580,9 +635,11 @@ class LevelDbWriter:
     Same buffered-commit contract as ``LmdbWriter``: everything is
     written durably at ``close()``."""
 
-    def __init__(self, path: str, *, sst: bool = False):
+    def __init__(self, path: str, *, sst: bool = False,
+                 compress: bool = False):
         self.path = path
         self.sst = sst
+        self.compress = compress
         self._items: dict[bytes, bytes] = {}
         self._closed = False
         os.makedirs(path, exist_ok=True)
@@ -631,7 +688,8 @@ class LevelDbWriter:
         items = sorted(self._items.items())
         seq = len(items)
         if self.sst:
-            table = _encode_sst(items) if items else None
+            table = (_encode_sst(items, compress=self.compress)
+                     if items else None)
             new_files = []
             if table is not None:
                 smallest = items[0][0] + struct.pack(

@@ -93,3 +93,24 @@ def test_log_format_fragmentation_roundtrip(payloads):
 
     raw = leveldb_io._write_log_records(payloads)
     assert list(leveldb_io._log_records(raw)) == payloads
+
+
+@settings(**COMMON)
+@given(data=st.binary(min_size=0, max_size=20_000))
+def test_snappy_compress_roundtrip(data):
+    from sparknet_tpu.data.leveldb_io import snappy_compress
+
+    assert snappy_decompress(snappy_compress(data)) == data
+
+
+@settings(**COMMON)
+@given(data=st.binary(min_size=1, max_size=200))
+def test_snappy_compress_repetitive_shrinks_and_roundtrips(data):
+    """Repetitive input must both shrink (copies actually emitted) and
+    survive the round trip through the overlap-copy path."""
+    from sparknet_tpu.data.leveldb_io import snappy_compress
+
+    big = data * 64
+    packed = snappy_compress(big)
+    assert snappy_decompress(packed) == big
+    assert len(packed) < len(big)

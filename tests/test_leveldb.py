@@ -99,6 +99,22 @@ class TestRoundTrip:
             assert len(r) == 500
             assert list(r) == sorted(items)
 
+    def test_sst_compressed_blocks(self, tmp_path):
+        """compress=True writes snappy blocks (kept only when they
+        shrink >=12.5%, table_builder.cc rule) that read back exactly."""
+        # repetitive values compress well; random ones stay raw
+        items = [(f"{i:08d}".encode(), bytes([i % 7]) * 500)
+                 for i in range(64)]
+        p = str(tmp_path / "db")
+        with LevelDbWriter(p, sst=True, compress=True) as w:
+            for k, v in items:
+                w.put(k, v)
+        raw = open(os.path.join(p, "000005.ldb"), "rb").read()
+        uncompressed_size = sum(len(k) + len(v) + 8 for k, v in items)
+        assert len(raw) < uncompressed_size // 2  # compression engaged
+        with LevelDbReader(p) as r:
+            assert dict(r) == dict(items)
+
     def test_sst_multi_block(self, tmp_path):
         # values big enough to force several 4 KiB data blocks
         items = [(f"{i:08d}".encode(), os.urandom(900)) for i in range(64)]
