@@ -806,3 +806,45 @@ def test_cli_bench_brew(capsys, monkeypatch):
     assert rec["metric"] == "alexnet_train_images_per_sec_per_chip"
     assert rec["measured"] is True
     assert rec["value"] > 0
+
+
+def test_bench_require_measured_partial_exits_nonzero(tmp_path):
+    """SPARKNET_BENCH_REQUIRE_MEASURED=1: a partial (unmeasured) record
+    exits rc 4 so the window runner retries the job in a later window
+    instead of marking a wedge-raced bench as done."""
+    import subprocess
+    import sys as _sys
+
+    code = (
+        "import bench\n"
+        "bench.probe_backend = lambda **kw: "
+        "{'ok': False, 'reason': 'test wedge'}\n"
+        "bench.cost_model_estimate = lambda *a, **k: {}\n"
+        "import sys\n"
+        "sys.exit(bench.main())\n"
+    )
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # not cpu: take the probe path
+    env.update({
+        "SPARKNET_BENCH_REQUIRE_MEASURED": "1",
+        "SPARKNET_BENCH_BATCH": "4",
+        "PYTHONPATH": os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))),
+    })
+    out = subprocess.run(
+        [_sys.executable, "-c", code], capture_output=True, text=True,
+        env=env, timeout=300,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 4, (out.stdout + out.stderr)[-1500:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["measured"] is False and rec["partial"] is True
+
+    # without the knob the same partial record is an rc=0 answer
+    env.pop("SPARKNET_BENCH_REQUIRE_MEASURED")
+    out2 = subprocess.run(
+        [_sys.executable, "-c", code], capture_output=True, text=True,
+        env=env, timeout=300,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out2.returncode == 0, (out2.stdout + out2.stderr)[-1500:]
