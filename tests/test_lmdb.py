@@ -244,6 +244,29 @@ def test_cli_train_from_lmdb(tmp_path, capsys, monkeypatch):
     ]) == 0
 
 
+def _write_tiny_data_net(tmp_path, *, source, batch=4, num_output=3,
+                         transform_param="", name="tiny"):
+    """The minimal Data-layer train_val + solver pair the CLI tests share
+    (only source/batch/transform vary per case)."""
+    tp = (f"  transform_param {{ {transform_param} }}\n"
+          if transform_param else "")
+    (tmp_path / "net.prototxt").write_text(
+        f'name: "{name}"\n'
+        'layer { name: "d" type: "Data" top: "data" top: "label"\n'
+        f'  data_param {{ source: "{source}" batch_size: {batch} }}\n'
+        f"{tp}"
+        "}\n"
+        'layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"\n'
+        f"  inner_product_param {{ num_output: {num_output} }} }}\n"
+        'layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" '
+        'bottom: "label" top: "loss" }\n'
+    )
+    (tmp_path / "solver.prototxt").write_text(
+        'net: "net.prototxt"\nbase_lr: 0.01\nmax_iter: 2\ndisplay: 0\n'
+    )
+    return str(tmp_path / "solver.prototxt")
+
+
 def test_cli_train_data_layer_prototxt_from_db(tmp_path, capsys, monkeypatch):
     """A reference-style train_val prototxt whose source is a DB-backed
     ``Data`` layer (no declared geometry anywhere) trains end to end:
@@ -264,19 +287,8 @@ def test_cli_train_data_layer_prototxt_from_db(tmp_path, capsys, monkeypatch):
     db = str(tmp_path / "train_lmdb")
     create_db(db, samples, backend="lmdb")
 
-    (tmp_path / "net.prototxt").write_text(
-        'name: "dbnet"\n'
-        'layer { name: "d" type: "Data" top: "data" top: "label"\n'
-        '  data_param { source: "missing_on_this_host_lmdb" batch_size: 8 }\n'
-        "}\n"
-        'layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"\n'
-        "  inner_product_param { num_output: 4 } }\n"
-        'layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" '
-        'bottom: "label" top: "loss" }\n'
-    )
-    (tmp_path / "solver.prototxt").write_text(
-        'net: "net.prototxt"\nbase_lr: 0.01\nmax_iter: 2\ndisplay: 0\n'
-    )
+    _write_tiny_data_net(tmp_path, source="missing_on_this_host_lmdb",
+                         batch=8, num_output=4, name="dbnet")
     assert main([
         "train", "--solver", str(tmp_path / "solver.prototxt"),
         "--data", f"db:{db}", "--iterations", "2",
@@ -303,20 +315,10 @@ def test_cli_train_data_layer_crop_from_db(tmp_path, monkeypatch):
     db = str(tmp_path / "big_lmdb")
     create_db(db, samples, backend="lmdb")
 
-    (tmp_path / "net.prototxt").write_text(
-        'name: "cropnet"\n'
-        'layer { name: "d" type: "Data" top: "data" top: "label"\n'
-        '  data_param { source: "not_here_lmdb" batch_size: 8 }\n'
-        "  transform_param { crop_size: 10 mirror: true scale: 0.0039 }\n"
-        "}\n"
-        'layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"\n'
-        "  inner_product_param { num_output: 4 } }\n"
-        'layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" '
-        'bottom: "label" top: "loss" }\n'
-    )
-    (tmp_path / "solver.prototxt").write_text(
-        'net: "net.prototxt"\nbase_lr: 0.01\nmax_iter: 2\ndisplay: 0\n'
-    )
+    _write_tiny_data_net(
+        tmp_path, source="not_here_lmdb", batch=8, num_output=4,
+        transform_param="crop_size: 10 mirror: true scale: 0.0039",
+        name="cropnet")
     assert main([
         "train", "--solver", str(tmp_path / "solver.prototxt"),
         "--data", f"db:{db}", "--iterations", "2",
@@ -341,20 +343,9 @@ def test_cli_train_data_proto_streams_own_source(tmp_path, monkeypatch):
                for i in range(16)]
     create_db(str(tmp_path / "own_lmdb"), samples, backend="lmdb")
 
-    (tmp_path / "net.prototxt").write_text(
-        'name: "selffeed"\n'
-        'layer { name: "d" type: "Data" top: "data" top: "label"\n'
-        '  data_param { source: "own_lmdb" batch_size: 4 }\n'
-        "  transform_param { crop_size: 12 scale: 0.0039 }\n"
-        "}\n"
-        'layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"\n'
-        "  inner_product_param { num_output: 3 } }\n"
-        'layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" '
-        'bottom: "label" top: "loss" }\n'
-    )
-    (tmp_path / "solver.prototxt").write_text(
-        'net: "net.prototxt"\nbase_lr: 0.01\nmax_iter: 2\ndisplay: 0\n'
-    )
+    _write_tiny_data_net(
+        tmp_path, source="own_lmdb", batch=4,
+        transform_param="crop_size: 12 scale: 0.0039", name="selffeed")
     assert main([
         "train", "--solver", str(tmp_path / "solver.prototxt"),
         "--data", "proto", "--iterations", "2",
@@ -378,22 +369,44 @@ def test_cli_data_auto_streams_own_source(tmp_path, monkeypatch, capsys):
     samples = [(rs.randint(0, 255, (3, 10, 10)).astype(np.uint8), i % 3)
                for i in range(12)]
     create_db(str(tmp_path / "auto_lmdb"), samples, backend="lmdb")
-    (tmp_path / "net.prototxt").write_text(
-        'name: "auto"\n'
-        'layer { name: "d" type: "Data" top: "data" top: "label"\n'
-        '  data_param { source: "auto_lmdb" batch_size: 4 } }\n'
-        'layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"\n'
-        "  inner_product_param { num_output: 3 } }\n"
-        'layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" '
-        'bottom: "label" top: "loss" }\n'
-    )
-    (tmp_path / "solver.prototxt").write_text(
-        'net: "net.prototxt"\nbase_lr: 0.01\nmax_iter: 2\ndisplay: 0\n'
-    )
+    _write_tiny_data_net(tmp_path, source="auto_lmdb", name="auto")
     assert main([
         "train", "--solver", str(tmp_path / "solver.prototxt"),
         "--iterations", "2", "--output", str(tmp_path / "out"),
     ]) == 0
+
+
+def test_cli_time_and_extract_features_db_peek(tmp_path, monkeypatch, capsys):
+    """Every brew shares the DB-geometry peek: `time --hlo` and
+    `extract_features` on a Data-layer prototxt + --data db: work like
+    train/test do."""
+    import numpy as np
+
+    monkeypatch.chdir(tmp_path)
+
+    import json
+
+    from sparknet_tpu.cli import main
+    from sparknet_tpu.data.createdb import create_db
+
+    rs = np.random.RandomState(0)
+    samples = [(rs.randint(0, 255, (3, 10, 10)).astype(np.uint8), i % 3)
+               for i in range(16)]
+    db = str(tmp_path / "peek_lmdb")
+    create_db(db, samples, backend="lmdb")
+    _write_tiny_data_net(tmp_path, source="elsewhere_lmdb", name="peek")
+    assert main(["time", "--hlo", "--solver", str(tmp_path / "solver.prototxt"),
+                 "--data", f"db:{db}"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["flops_per_step"] > 0 and out["batch"] == 4
+
+    assert main(["extract_features",
+                 "--solver", str(tmp_path / "solver.prototxt"),
+                 "--data", f"db:{db}", "--blob", "ip",
+                 "--iterations", "2",
+                 "--out", str(tmp_path / "f.npy")]) == 0
+    feats = np.load(tmp_path / "f.npy")
+    assert feats.shape == (8, 3)  # 2 batches x 4, ip num_output 3
 
 
 def test_cli_data_auto_missing_source_is_loud(tmp_path, monkeypatch):
