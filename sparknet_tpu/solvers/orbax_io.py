@@ -68,8 +68,40 @@ def _check_meta(path: str, solver, expect_elastic: bool | None = None) -> None:
         )
 
 
-def save_orbax(solver, prefix: str) -> str:
-    """Write a snapshot; returns the checkpoint directory."""
+# one in-flight async save at a time: (checkpointer, path, meta).  The
+# next save (or an explicit wait_pending) finalizes it — orbax commits
+# atomically via tmp-dir rename, so the meta sidecar can only be
+# written after the commit lands.
+_PENDING: list = []
+
+
+def wait_pending() -> None:
+    """Block until any background save has committed, then write its
+    meta sidecar.  Registered via atexit on first use (an unawaited
+    async save is not durable); every save/restore path also calls it."""
+    while _PENDING:
+        ckptr, path, meta = _PENDING[-1]
+        try:
+            ckptr.wait_until_finished()
+        finally:
+            # close + drop even when the wait raises: never leak the
+            # checkpointer thread or retry a failed commit forever
+            ckptr.close()
+            _PENDING.pop()
+        # only a committed checkpoint gets its sidecar (a failed wait
+        # raised out above) — restores of sidecar-less dirs skip
+        # validation rather than validating against garbage
+        _write_meta(path, meta)
+
+
+def save_orbax(solver, prefix: str, *, background: bool = False) -> str:
+    """Write a snapshot; returns the checkpoint directory.
+
+    ``background=True`` uses orbax's AsyncCheckpointer: the call returns
+    as soon as device arrays are copied to host and the write streams
+    while training continues — the pod-scale pattern where a multi-GB
+    sharded snapshot must not stall the step loop.  The save commits at
+    the next save/:func:`wait_pending` call."""
     ocp = _tree()
     path = os.path.abspath(f"{prefix}.orbax")
     payload = {
@@ -78,9 +110,22 @@ def save_orbax(solver, prefix: str) -> str:
         "slots": solver.slots,
         "iter": np.asarray(solver.iter),
     }
+    meta = {"solver_type": solver.config.solver_type}
+    if background:
+        wait_pending()  # serialize in-flight saves (and free the last one)
+        if not _PENDING and not getattr(wait_pending, "_atexit", False):
+            import atexit
+
+            atexit.register(wait_pending)
+            wait_pending._atexit = True  # register once per process
+        ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+        ckptr.save(path, payload, force=True)
+        _PENDING.append((ckptr, path, meta))
+        return path
+    wait_pending()  # a sync save must not race an earlier async one
     with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
         ckptr.save(path, payload, force=True)
-    _write_meta(path, {"solver_type": solver.config.solver_type})
+    _write_meta(path, meta)
     return path
 
 
@@ -115,6 +160,7 @@ def save_trainer_orbax(trainer, prefix: str) -> str:
     params, optimizer slots, (EASGD) center — with each process writing
     only the shards it owns.  This is the true pod-scale path: unlike
     ``Solver.save``, nothing is gathered to one host first."""
+    wait_pending()  # a sync save must not race an earlier async one
     ocp = _tree()
     path = os.path.abspath(f"{prefix}.orbax")
     with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
@@ -131,6 +177,7 @@ def save_trainer_orbax(trainer, prefix: str) -> str:
 
 def restore_trainer_orbax(trainer, path: str) -> None:
     """Restore a trainer checkpoint in place with the live shardings."""
+    wait_pending()  # never read a checkpoint an async save is streaming
     ocp = _tree()
     path = _resolve_dir(path)
     _check_meta(
@@ -152,6 +199,7 @@ def restore_trainer_orbax(trainer, path: str) -> None:
 def restore_orbax(solver, path: str) -> None:
     """Restore params/state/slots/iter in place, preserving shardings of
     the solver's current arrays as the restore target."""
+    wait_pending()  # never read a checkpoint an async save is streaming
     ocp = _tree()
     path = _resolve_dir(path)
     _check_meta(path, solver)

@@ -493,16 +493,26 @@ class Solver:
     # ------------------------------------------------------------------
     # Snapshot/restore (ref: Solver::Snapshot/Restore solver.cpp:447-519 +
     # SGDSolver history snapshot sgd_solver.cpp:242+).
-    def save(self, prefix: str, format: str = "npz") -> str:
+    def save(self, prefix: str, format: str = "npz",
+             background: bool = False) -> str:
         """``format="npz"``: single-host flat archive. ``format="orbax"``:
         sharded pod-scale checkpoint (each process writes its own shards;
-        restores with the live shardings)."""
+        restores with the live shardings).  ``background=True`` (orbax
+        only) streams the write while training continues; the snapshot
+        commits at the next save or ``orbax_io.wait_pending()``."""
         if format == "orbax":
             from sparknet_tpu.solvers.orbax_io import save_orbax
 
-            out = save_orbax(self, prefix)
-            self._export_model_pair(prefix)
+            out = save_orbax(self, prefix, background=background)
+            if not background:
+                # background saves write the orbax state only: the
+                # .caffemodel companion gathers every param to host
+                # synchronously, which would stall the very step loop
+                # the async path exists to protect
+                self._export_model_pair(prefix)
             return out
+        if background:
+            raise ValueError("background saves need format='orbax'")
         if format != "npz":
             raise ValueError(f"unknown snapshot format {format!r} (npz|orbax)")
         path = f"{prefix}.solverstate.npz"

@@ -604,3 +604,40 @@ def test_debug_info_prints_per_layer_stats(capsys):
     solver2 = Solver(SolverConfig(base_lr=0.01), models.lenet(4))
     solver2.step(1, feed)
     assert "[Forward]" not in capsys.readouterr().out
+
+
+def test_orbax_background_snapshot(tmp_path):
+    """background=True streams the snapshot while training continues:
+    the save call must not block, the step loop keeps running, and the
+    checkpoint commits (with its meta sidecar) by the next restore —
+    wait_pending() guards every read path."""
+    pytest.importorskip("orbax.checkpoint")
+    from sparknet_tpu import models
+    from sparknet_tpu.solvers import orbax_io
+
+    cfg = SolverConfig(base_lr=0.01, momentum=0.9, solver_type="SGD")
+    s1 = Solver(cfg, models.lenet(4))
+    rs = np.random.RandomState(0)
+    fn = lambda it: {
+        "data": rs.randn(4, 1, 28, 28).astype(np.float32),
+        "label": rs.randint(0, 10, 4).astype(np.int32),
+    }
+    s1.step(2, fn)
+    at_snap = {k: [np.asarray(p).copy() for p in v]
+               for k, v in s1.variables.params.items()}
+    path = s1.save(str(tmp_path / "bg"), format="orbax", background=True)
+    s1.step(2, fn)  # training continues while the write streams
+
+    s2 = Solver(cfg, models.lenet(4))
+    s2.restore(path)  # wait_pending() inside finalizes the commit
+    assert s2.iter == 2
+    for lname, plist in s2.variables.params.items():
+        for i, p in enumerate(plist):
+            np.testing.assert_array_equal(np.asarray(p), at_snap[lname][i])
+    # sidecar landed after commit (solver-type validation active)
+    assert os.path.exists(os.path.join(path, "sparknet_meta.json"))
+    assert not orbax_io._PENDING
+
+    # npz + background is a loud error, not a silent sync save
+    with pytest.raises(ValueError, match="background"):
+        s1.save(str(tmp_path / "x"), background=True)
