@@ -21,6 +21,26 @@ from sparknet_tpu.data.minibatch import make_minibatches_compressed
 from sparknet_tpu.net import TPUNet
 from sparknet_tpu.utils import EventLogger
 
+_DB_EXTS = {"record": ".sndb", "lmdb": "_lmdb", "leveldb": "_leveldb"}
+
+
+def _check_backend(backend: str) -> str:
+    if backend not in _DB_EXTS:
+        raise ValueError(
+            f"unknown db backend {backend!r} ({' | '.join(_DB_EXTS)})")
+    return _DB_EXTS[backend]
+
+
+def _clear_db_path(path: str) -> None:
+    """Remove a leftover DB (dir or file) so a re-run can materialize
+    fresh — LevelDbWriter rightly refuses to overlay an existing env."""
+    import shutil
+
+    if os.path.isdir(path):
+        shutil.rmtree(path)
+    elif os.path.exists(path):
+        os.remove(path)
+
 
 class CifarDBApp:
     """CIFAR via record DB (ref: CifarDBApp.scala): materialize train/test
@@ -31,14 +51,10 @@ class CifarDBApp:
         """``backend``: record (native) | lmdb | leveldb — the latter two
         are the reference's own on-disk formats (CifarDBApp.scala writes
         LevelDB through the C API)."""
-        exts = {"record": ".sndb", "lmdb": "_lmdb", "leveldb": "_leveldb"}
-        if backend not in exts:
-            # validate BEFORE any side effect (the logger creates a file)
-            raise ValueError(
-                f"unknown db backend {backend!r} ({' | '.join(exts)})")
+        # validate BEFORE any side effect (the logger creates a file)
+        ext = _check_backend(backend)
         self.log = EventLogger(log_dir, prefix="cifar_db_log")
         self.batch = batch
-        ext = exts[backend]
         self.train_db = os.path.join(db_dir, f"cifar_train{ext}")
         self.test_db = os.path.join(db_dir, f"cifar_test{ext}")
         # a crash mid-materialize leaves readable-but-truncated DBs in
@@ -50,15 +66,8 @@ class CifarDBApp:
         os.makedirs(db_dir, exist_ok=True)
 
         if not os.path.exists(done_marker):
-            import shutil
-
             for p in (self.train_db, self.test_db):
-                # clear partial leftovers: LevelDbWriter refuses to
-                # overlay an existing dir (and rightly so)
-                if os.path.isdir(p):
-                    shutil.rmtree(p)
-                elif os.path.exists(p):
-                    os.remove(p)
+                _clear_db_path(p)  # partial leftovers block LevelDbWriter
             self.log("materializing DBs")
             loader = CifarLoader(data_dir)
             create_db(self.train_db,
@@ -121,14 +130,30 @@ class ImageNetCreateDBApp:
     infoFiles/ test-batch counts)."""
 
     def __init__(self, shard_dir: str, label_file: str, out_dir: str,
-                 num_workers: int = 1, resize: int = 256, batch: int = 256):
+                 num_workers: int = 1, resize: int = 256, batch: int = 256,
+                 backend: str = "record"):
         from sparknet_tpu.data import ImageNetLoader
 
+        self._ext = _check_backend(backend)
+        if backend != "record":
+            import sys
+
+            # the lmdb/leveldb writers buffer ALL records in RAM and
+            # write at close — fine for fixtures/CIFAR, an OOM at real
+            # ImageNet scale.  Materialize with the record backend and
+            # `tpunet convert_db` afterwards for those.
+            print(
+                f"ImageNetCreateDBApp: the {backend!r} writer buffers the "
+                "whole worker shard in memory; for ImageNet-scale runs "
+                "use backend='record' then convert_db",
+                file=sys.stderr,
+            )
         self.loader = ImageNetLoader(shard_dir, label_file)
         self.out_dir = out_dir
         self.num_workers = num_workers
         self.resize = resize
         self.batch = batch
+        self.backend = backend
         os.makedirs(out_dir, exist_ok=True)
 
     def run(self) -> dict:
@@ -136,7 +161,9 @@ class ImageNetCreateDBApp:
         mean_acc = None
         count = 0
         for w in range(self.num_workers):
-            db_path = os.path.join(self.out_dir, f"imagenet_w{w}.sndb")
+            db_path = os.path.join(
+                self.out_dir, f"imagenet_w{w}{self._ext}")
+            _clear_db_path(db_path)  # re-runs/crash leftovers rebuild
             batches = 0
 
             def samples():
@@ -152,7 +179,7 @@ class ImageNetCreateDBApp:
                     for img, label in zip(imgs, labels):
                         yield img, int(label)
 
-            n = create_db(db_path, samples())
+            n = create_db(db_path, samples(), backend=self.backend)
             info["workers"].append(
                 {"db": db_path, "records": n, "batches": batches}
             )

@@ -503,6 +503,18 @@ def test_db_apps_cifar_and_imagenet(tmp_path, cifar_dir):
     mean = np.load(info["mean"])
     assert mean.shape == (3, 32, 32)
 
+    # the reference's actual backend, per-worker LevelDBs
+    from sparknet_tpu.data.createdb import db_minibatches
+    from sparknet_tpu.data.leveldb_io import is_leveldb
+
+    creator2 = ImageNetCreateDBApp(
+        str(tmp_path), str(tmp_path / "labels.txt"),
+        str(tmp_path / "in_dbs_ldb"), resize=32, batch=2, backend="leveldb")
+    info2 = creator2.run()
+    db2 = info2["workers"][0]["db"]
+    assert is_leveldb(db2) and info2["workers"][0]["records"] == 4
+    assert next(db_minibatches(db2, 4))["data"].shape == (4, 3, 32, 32)
+
 
 def test_cli_time_fused(capsys):
     from sparknet_tpu.cli import main
