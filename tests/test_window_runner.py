@@ -28,6 +28,9 @@ def runner(tmp_path, monkeypatch):
     monkeypatch.setattr(
         mod, "JOURNAL", str(tmp_path / "evidence" / "journal.jsonl")
     )
+    # dial stubs return instantly; without this the fast-failure backoff
+    # would add real sleeps to every test with a failing dial
+    monkeypatch.setattr(mod, "MIN_DIAL_PERIOD_S", 0.05)
     return mod
 
 
@@ -52,7 +55,7 @@ def fail_job(name):
 
 def test_drains_dependency_chain_in_one_window(runner, tmp_path, monkeypatch):
     """leg2 needs leg1: both must run in the SAME healthy window."""
-    monkeypatch.setattr(runner, "dial", lambda: True)
+    monkeypatch.setattr(runner, "dial", lambda probe_id=0: True)
     q = _queue(tmp_path, [ok_job("leg1"), ok_job("leg2", needs="leg1")])
     monkeypatch.setattr(sys, "argv", ["runner", q])
     assert runner.main() == 0
@@ -63,7 +66,7 @@ def test_drains_dependency_chain_in_one_window(runner, tmp_path, monkeypatch):
 def test_failed_job_gets_one_shot_per_window(runner, tmp_path, monkeypatch):
     dials = []
 
-    def dial():
+    def dial(probe_id=0):
         dials.append(1)
         return len(dials) <= 3  # three windows, then stop dialing green
 
@@ -80,22 +83,28 @@ def test_failed_job_gets_one_shot_per_window(runner, tmp_path, monkeypatch):
 
 
 def test_dependent_of_failed_job_never_runs(runner, tmp_path, monkeypatch):
-    monkeypatch.setattr(runner, "dial", lambda: True)
+    monkeypatch.setattr(runner, "dial", lambda probe_id=0: True)
     q = _queue(tmp_path, [fail_job("base"), ok_job("dep", needs="base")],
                max_attempts=1)
     monkeypatch.setattr(sys, "argv", ["runner", q])
-    runner.main()
+    # a queue whose remaining jobs can never run is BLOCKED, not drained:
+    # rc 3 so a babysitting shell can tell "all green" from "gave up"
+    assert runner.main() == 3
     state = runner.load_done()
     assert state["base"] == 1
     assert "dep" not in state
     assert not os.path.exists(
         os.path.join(runner.EVIDENCE_DIR, "dep.txt"))
+    events = [json.loads(l) for l in open(runner.JOURNAL)]
+    done = [e for e in events if e.get("event") == "runner_done"][-1]
+    assert done["reason"] == "queue blocked"
+    assert set(done["blocked_jobs"]) == {"base", "dep"}
 
 
 def test_timeout_kills_job_and_returns_to_dialing(runner, tmp_path, monkeypatch):
     windows = []
 
-    def dial():
+    def dial(probe_id=0):
         windows.append(1)
         return len(windows) == 1  # one window only
 
@@ -107,18 +116,34 @@ def test_timeout_kills_job_and_returns_to_dialing(runner, tmp_path, monkeypatch)
     monkeypatch.setattr(sys, "argv", ["runner", q])
     runner.main()
     state = runner.load_done()
-    # the hang counts as an attempt; 'after' did NOT run in that window
-    # (a hung job means the window closed)
-    assert state["hang"] == 1
+    # a deadline kill means the WINDOW closed, not that the job failed:
+    # it must not burn one of the job's max_attempts (it is tallied
+    # separately under count_timeouts), and 'after' did NOT run in that
+    # window
+    assert "hang" not in state
+    assert runner.load_done(count_timeouts=True)["hang"] == 1
     assert "after" not in state
     events = [json.loads(l) for l in open(runner.JOURNAL)]
     end = [e for e in events if e.get("event") == "job_end"][0]
     assert end["timed_out"] is True and end["rc"] is None
 
 
+def test_chronic_hangs_eventually_block(runner, tmp_path, monkeypatch):
+    """A job that hangs in EVERY window is capped by max_timeouts so it
+    cannot eat healthy windows to round end."""
+    monkeypatch.setattr(runner, "dial", lambda probe_id=0: True)
+    hang = {"name": "chronic",
+            "argv": [sys.executable, "-c", "import time; time.sleep(60)"],
+            "deadline_s": 1}
+    q = _queue(tmp_path, [hang], max_timeouts=2)
+    monkeypatch.setattr(sys, "argv", ["runner", q])
+    assert runner.main() == 3
+    assert runner.load_done(count_timeouts=True)["chronic"] == 2
+
+
 def test_journal_marks_success_permanently(runner, tmp_path, monkeypatch):
     """A second invocation skips already-green jobs (resume semantics)."""
-    monkeypatch.setattr(runner, "dial", lambda: True)
+    monkeypatch.setattr(runner, "dial", lambda probe_id=0: True)
     q = _queue(tmp_path, [ok_job("once")])
     monkeypatch.setattr(sys, "argv", ["runner", q])
     assert runner.main() == 0
@@ -135,7 +160,7 @@ def test_journal_marks_success_permanently(runner, tmp_path, monkeypatch):
 
 
 def test_job_output_banked_to_evidence_file(runner, tmp_path, monkeypatch):
-    monkeypatch.setattr(runner, "dial", lambda: True)
+    monkeypatch.setattr(runner, "dial", lambda probe_id=0: True)
     q = _queue(tmp_path, [{
         "name": "emits",
         "argv": [sys.executable, "-c", "print('the-evidence-line')"],
@@ -145,3 +170,102 @@ def test_job_output_banked_to_evidence_file(runner, tmp_path, monkeypatch):
     runner.main()
     out = open(os.path.join(runner.EVIDENCE_DIR, "emits.txt")).read()
     assert "the-evidence-line" in out
+
+
+def test_probe_id_exported_to_job_env(runner, tmp_path, monkeypatch):
+    """Jobs see the dial's probe id so bench records carry provenance."""
+    monkeypatch.setattr(runner, "dial", lambda probe_id=0: True)
+    q = _queue(tmp_path, [{
+        "name": "probe_echo",
+        "argv": [sys.executable, "-c",
+                 "import os; print('probe=' + os.environ['SPARKNET_WINDOW_PROBE'])"],
+        "deadline_s": 30,
+    }])
+    monkeypatch.setattr(sys, "argv", ["runner", q])
+    assert runner.main() == 0
+    out = open(os.path.join(runner.EVIDENCE_DIR, "probe_echo.txt")).read()
+    assert "probe=1" in out
+
+
+def test_transitive_dead_dependency_blocks_not_spins(runner, tmp_path,
+                                                     monkeypatch):
+    """leg3 needs leg2 needs leg1: leg1 exhausting its attempts must mark
+    the WHOLE chain blocked (rc 3), not leave leg3 'pending' and the
+    runner dialing until max_hours then exiting 0."""
+    monkeypatch.setattr(runner, "dial", lambda probe_id=0: True)
+    q = _queue(tmp_path, [fail_job("leg1"), ok_job("leg2", needs="leg1"),
+                          ok_job("leg3", needs="leg2")], max_attempts=1)
+    monkeypatch.setattr(sys, "argv", ["runner", q])
+    assert runner.main() == 3
+    events = [json.loads(l) for l in open(runner.JOURNAL)]
+    done = [e for e in events if e.get("event") == "runner_done"][-1]
+    assert done["reason"] == "queue blocked"
+    assert set(done["blocked_jobs"]) == {"leg1", "leg2", "leg3"}
+
+
+def test_needs_typo_is_blocked_not_eternal(runner, tmp_path, monkeypatch):
+    monkeypatch.setattr(runner, "dial", lambda probe_id=0: True)
+    q = _queue(tmp_path, [ok_job("good"), ok_job("typo", needs="no-such-job")])
+    monkeypatch.setattr(sys, "argv", ["runner", q])
+    assert runner.main() == 3
+    assert runner.load_done()["good"] == -1
+
+
+def test_needs_cycle_is_blocked_not_false_drained(runner, tmp_path,
+                                                  monkeypatch):
+    """a needs b, b needs a: neither can ever run — that is rc 3 blocked,
+    not 'queue drained' success."""
+    monkeypatch.setattr(runner, "dial", lambda probe_id=0: True)
+    q = _queue(tmp_path, [ok_job("a", needs="b"), ok_job("b", needs="a")])
+    monkeypatch.setattr(sys, "argv", ["runner", q])
+    assert runner.main() == 3
+    events = [json.loads(l) for l in open(runner.JOURNAL)]
+    done = [e for e in events if e.get("event") == "runner_done"][-1]
+    assert done["reason"] == "queue blocked"
+    assert set(done["blocked_jobs"]) == {"a", "b"}
+
+
+def test_probe_ids_unique_across_restarts(runner, tmp_path, monkeypatch):
+    """A restarted runner must continue the journal's probe sequence, or
+    bench records' provenance field would be ambiguous."""
+    dialed = []
+
+    def dial(probe_id=0):
+        dialed.append(probe_id)
+        # the real dial() journals its probe id; seeding reads it back
+        runner.log({"event": "dial_start", "probe": probe_id})
+        return True
+
+    monkeypatch.setattr(runner, "dial", dial)
+    q = _queue(tmp_path, [ok_job("a")])
+    monkeypatch.setattr(sys, "argv", ["runner", q])
+    assert runner.main() == 0
+    # second invocation with a fresh queue against the SAME journal
+    q2 = _queue(tmp_path, [ok_job("b")])
+    monkeypatch.setattr(sys, "argv", ["runner", q2])
+    assert runner.main() == 0
+    assert dialed == sorted(set(dialed)), dialed  # strictly increasing
+
+
+def test_queue_reload_picks_up_appended_job(runner, tmp_path, monkeypatch):
+    """Appending a job to the queue file mid-round is honored without a
+    runner restart (the spec is re-read before every dial AND between
+    jobs inside a window)."""
+    q = _queue(tmp_path, [ok_job("first")])
+    # the first job itself appends a second job to the queue file, the
+    # way an agent appends a perf A/B while the runner babysits the relay
+    append = (
+        "import json; spec = json.load(open({q!r}));"
+        "spec['jobs'].append({{'name': 'appended',"
+        " 'argv': [{py!r}, '-c', 'print(1)'], 'deadline_s': 30}});"
+        "json.dump(spec, open({q!r}, 'w'))"
+    ).format(q=q, py=sys.executable)
+    spec = json.loads(open(q).read())
+    spec["jobs"][0]["argv"] = [sys.executable, "-c", append]
+    open(q, "w").write(json.dumps(spec))
+
+    monkeypatch.setattr(runner, "dial", lambda probe_id=0: True)
+    monkeypatch.setattr(sys, "argv", ["runner", q])
+    assert runner.main() == 0
+    state = runner.load_done()
+    assert state == {"first": -1, "appended": -1}

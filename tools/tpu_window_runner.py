@@ -22,10 +22,11 @@ latency, so this runner automates the round's protocol:
    reconstructed after the fact.
 
 Usage:
-    python tools/tpu_window_runner.py tools/tpu_queue_r3.json &
+    python tools/tpu_window_runner.py tools/tpu_queue_r4.json &
 
 Queue file format (JSON):
     {"max_hours": 10,
+     "evidence_dir": "docs/evidence_r4",   # journal + job logs live here
      "jobs": [{"name": "trace", "argv": ["python", "-m", ...],
                "env": {"K": "V"}, "deadline_s": 1200,
                "needs": "other_job_name"  # optional: skip unless that
@@ -35,6 +36,12 @@ Queue file format (JSON):
 Jobs are idempotent from the queue's point of view: a job is DONE once
 a journal entry records rc==0 for it; the runner re-attempts failed
 jobs in later windows (max_attempts per job, default 3).
+
+The queue file is RE-READ before every dial, so jobs can be appended
+mid-round (e.g. a perf A/B written after the runner started) without
+restarting the runner.  Exit codes: 0 = every job green, 3 = queue
+blocked (some job exhausted max_attempts, or its dependency did),
+0 with reason max_hours = time ran out while jobs were still pending.
 """
 
 from __future__ import annotations
@@ -47,10 +54,20 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# Overridden from the queue spec's "evidence_dir" in main().  The module
+# default stays evidence_r3 for backward compatibility: the r3 queue file
+# predates the key, and changing its journal location would break resume
+# semantics (green jobs would re-run, burning healthy windows).
 EVIDENCE_DIR = os.path.join(REPO, "docs", "evidence_r3")
 JOURNAL = os.path.join(EVIDENCE_DIR, "journal.jsonl")
 
 DIAL_CODE = "import jax; print(jax.devices()[0].platform)"
+
+# A failed dial normally takes ~25 min (the axon client's own retry
+# budget) and is therefore its own backoff; but a FAST failure (plugin
+# missing, import error, jax falling straight back to cpu) would spin
+# the loop hot and flood the journal.  Enforce a floor between dials.
+MIN_DIAL_PERIOD_S = 120.0
 
 
 def log(event: dict) -> None:
@@ -62,8 +79,14 @@ def log(event: dict) -> None:
     print(json.dumps(event), flush=True)
 
 
-def load_done() -> dict[str, int]:
-    """job name -> number of attempts; negative = succeeded."""
+def load_done(count_timeouts: bool = False) -> dict[str, int]:
+    """job name -> number of FAILED attempts; negative = succeeded.
+
+    Deadline kills (rc=None) are not failures of the job — they almost
+    always mean the healthy window closed under it (module doc) — so by
+    default they do not count toward max_attempts and cannot get a job
+    marked dead.  ``count_timeouts=True`` gives the timeout-only tally,
+    used to cap pathological jobs that hang even in healthy windows."""
     state: dict[str, int] = {}
     try:
         with open(JOURNAL) as f:
@@ -74,19 +97,24 @@ def load_done() -> dict[str, int]:
                     continue
                 if ev.get("event") == "job_end":
                     n = ev["job"]
+                    timed_out = ev.get("rc") is None
+                    if count_timeouts:
+                        if timed_out:
+                            state[n] = state.get(n, 0) + 1
+                        continue
                     if ev.get("rc") == 0:
                         state[n] = -1
-                    elif state.get(n, 0) >= 0:
+                    elif state.get(n, 0) >= 0 and not timed_out:
                         state[n] = state.get(n, 0) + 1
     except OSError:
         pass
     return state
 
 
-def dial() -> bool:
+def dial(probe_id: int) -> bool:
     """One untimed dial.  True iff an accelerator answered."""
     t0 = time.time()
-    log({"event": "dial_start"})
+    log({"event": "dial_start", "probe": probe_id})
     proc = subprocess.Popen(
         [sys.executable, "-c", DIAL_CODE],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
@@ -96,19 +124,30 @@ def dial() -> bool:
     dt = round(time.time() - t0, 1)
     platform = out.strip().splitlines()[-1] if out.strip() else ""
     ok = proc.returncode == 0 and platform not in ("", "cpu")
-    tail = "" if ok else (err or out).strip().splitlines()[-1:]
-    log({"event": "dial_end", "ok": ok, "dt_s": dt,
-         "platform": platform or None,
-         "error": tail[0][:200] if tail else None})
+    tail = None
+    if not ok:
+        # prefer the last non-WARNING line (the jax plugin's experimental-
+        # platform warning used to shadow the actual error in the journal),
+        # but never drop diagnostics entirely if warnings are all there is
+        raw = [ln for ln in (err or out).strip().splitlines() if ln.strip()]
+        lines = [ln for ln in raw if "WARNING" not in ln] or raw
+        tail = lines[-1][:200] if lines else None
+    log({"event": "dial_end", "ok": ok, "dt_s": dt, "probe": probe_id,
+         "platform": platform or None, "error": tail})
     return ok
 
 
-def run_job(job: dict) -> int | None:
+def run_job(job: dict, probe_id: int = 0) -> int | None:
     """Run one job with a deadline.  Returns rc, or None on timeout."""
     name = job["name"]
     deadline = float(job.get("deadline_s", 1200))
     env = dict(os.environ)
     env.update(job.get("env", {}))
+    if probe_id:
+        # provenance: bench.py embeds this in its records so the judge can
+        # match a banked number to the journaled dial that opened the
+        # window; 0 (direct call, no dial) must not export a fake id
+        env["SPARKNET_WINDOW_PROBE"] = str(probe_id)
     # jobs may run from another cwd (e.g. to resolve a prototxt's
     # relative mean_file Caffe-style); the framework must stay importable
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -144,35 +183,124 @@ def run_job(job: dict) -> int | None:
 
 
 def main() -> int:
+    global EVIDENCE_DIR, JOURNAL
     if len(sys.argv) != 2:
         print(__doc__)
         return 2
-    with open(sys.argv[1]) as f:
-        spec = json.load(f)
-    jobs = spec["jobs"]
-    max_attempts = int(spec.get("max_attempts", 3))
-    stop_at = time.time() + float(spec.get("max_hours", 10)) * 3600
-    log({"event": "runner_start", "queue": sys.argv[1],
-         "jobs": [j["name"] for j in jobs]})
+    queue_path = sys.argv[1]
+    spec_cache: list = [None]
 
-    def next_pending(skip: set[str] = frozenset()):
+    def load_spec() -> dict:
+        """Re-read the queue; survive a torn read (a concurrent append is
+        an invited use — the writer may not be atomic) on the cached copy."""
+        try:
+            with open(queue_path) as f:
+                fresh = json.load(f)
+            spec_cache[0] = fresh
+        except (OSError, ValueError) as e:
+            if spec_cache[0] is None:
+                raise  # first read must succeed: no queue, no runner
+            log({"event": "queue_reload_failed", "error": repr(e)[:200]})
+        return spec_cache[0]
+
+    spec = load_spec()
+    if spec.get("evidence_dir"):
+        EVIDENCE_DIR = os.path.join(REPO, spec["evidence_dir"])
+        JOURNAL = os.path.join(EVIDENCE_DIR, "journal.jsonl")
+    stop_at = time.time() + float(spec.get("max_hours", 10)) * 3600
+    log({"event": "runner_start", "queue": queue_path,
+         "jobs": [j["name"] for j in spec["jobs"]]})
+
+    def next_pending(spec: dict, skip: set[str] = frozenset()):
+        """(job, blocked): the next runnable job, plus the set of non-green
+        jobs that can never run again — exhausted attempts, a 'needs'
+        naming a job not in the queue, or (transitively) a dead dependency.
+        With that fixpoint, runnable=None and blocked=[] together mean
+        every job is green."""
+        max_attempts = int(spec.get("max_attempts", 3))
+        # deadline kills don't count as failures (the window closed, not
+        # the job), but a job that hangs over and over even so gets its
+        # own, more generous cap — otherwise one pathological hang could
+        # eat every healthy window to round end
+        max_timeouts = int(spec.get("max_timeouts", 8))
         state = load_done()
-        for j in jobs:
-            attempts = state.get(j["name"], 0)
-            if j["name"] in skip or attempts < 0 or attempts >= max_attempts:
+        timeouts = load_done(count_timeouts=True)
+        names = {j["name"] for j in spec["jobs"]}
+        dead: set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for j in spec["jobs"]:
+                n = j["name"]
+                if n in dead or state.get(n, 0) < 0:
+                    continue  # already marked, or green
+                need = j.get("needs")
+                if (state.get(n, 0) >= max_attempts
+                        or timeouts.get(n, 0) >= max_timeouts
+                        or (need and (need not in names or need in dead))):
+                    dead.add(n)
+                    changed = True
+        runnable = None
+        for j in spec["jobs"]:
+            n = j["name"]
+            if state.get(n, 0) < 0 or n in dead or n in skip:
                 continue
             need = j.get("needs")
             if need and state.get(need, 0) >= 0:
-                continue  # dependency not yet green
-            return j
-        return None
+                continue  # dependency not yet green; may still become so
+            if runnable is None:
+                runnable = j
+        if runnable is None and not skip:
+            # no runnable job, nothing intentionally skipped: any job still
+            # non-green and non-dead can only be waiting on a 'needs' CYCLE
+            # (a live dependency would itself be runnable).  Promote to
+            # dead so main() reports blocked instead of a false 'drained'.
+            dead.update(
+                j["name"] for j in spec["jobs"]
+                if state.get(j["name"], 0) >= 0 and j["name"] not in dead)
+        return runnable, sorted(dead)
+
+    # Probe ids must stay unique across runner restarts against the same
+    # journal (resume semantics), or a bench record's "probe" field would
+    # match two different dials.  Seed from the journal's high-water mark.
+    probe_id = 0
+    try:
+        with open(JOURNAL) as f:
+            for line in f:
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if ev.get("event") == "dial_start":
+                    probe_id = max(probe_id, int(ev.get("probe", 0)))
+    except OSError:
+        pass
 
     while time.time() < stop_at:
-        if next_pending() is None:
+        spec = load_spec()  # pick up jobs appended mid-round
+        job, blocked = next_pending(spec)
+        if job is None:
+            # the fixpoint guarantees: no runnable job and nothing dead
+            # means everything is green; anything dead means the queue can
+            # never finish — report that as rc 3, not success
+            if blocked:
+                log({"event": "runner_done", "reason": "queue blocked",
+                     "blocked_jobs": blocked})
+                return 3
             log({"event": "runner_done", "reason": "queue drained"})
             return 0
-        if not dial():
-            continue  # the dial itself was the backoff (~25 min on dead)
+        t0 = time.time()
+        probe_id += 1
+        ok = dial(probe_id)
+        if not ok:
+            # a dead-backend dial takes ~25 min and is its own backoff; a
+            # FAST failure (broken plugin → instant cpu fallback) must not
+            # spin the loop hot
+            elapsed = time.time() - t0
+            backoff = min(MIN_DIAL_PERIOD_S - elapsed, stop_at - time.time())
+            if backoff > 0:
+                time.sleep(backoff)
+            continue
         # Window open: drain everything runnable, re-deriving the next
         # job from the journal after each run so (a) a job's dependents
         # run in the SAME window once it goes green, and (b) a job a
@@ -181,11 +309,11 @@ def main() -> int:
         # window closed, so back to dialing.
         attempted: set[str] = set()
         while True:
-            job = next_pending(skip=attempted)
+            job, _ = next_pending(load_spec(), skip=attempted)
             if job is None:
                 break
             attempted.add(job["name"])
-            rc = run_job(job)
+            rc = run_job(job, probe_id)
             if rc is None:
                 break
     log({"event": "runner_done", "reason": "max_hours reached"})
