@@ -138,8 +138,16 @@ def probe_backend(attempts: int = 3, timeout: float = 300.0) -> dict:
     return {"ok": False, "reason": last}
 
 
-def _build_step(batch: int, model: str, crop: int, dtype_name: str):
-    """Solver + jitted step + device feeds for the measured run."""
+def _build_step(batch: int, model: str, crop: int, dtype_name: str,
+                scan: int = 1):
+    """Solver + jitted step + device feeds for the measured run.
+
+    ``scan > 1``: the returned fn fuses that many solver iterations into
+    ONE device dispatch (lax.scan) and returns a [scan] loss vector.
+    This is the TPU-native loop — and over the axon relay, where every
+    dispatch is a tunnel RPC, it removes a fixed ~5 ms/step overhead the
+    r3 measurements showed (b128 +4.5 ms and b256 +5.2 ms over their
+    HBM bounds: constant, i.e. dispatch, not bandwidth)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -152,7 +160,16 @@ def _build_step(batch: int, model: str, crop: int, dtype_name: str):
     # build must reset it or it silently lowers in bf16.
     from sparknet_tpu.common import set_config
 
-    set_config(compute_dtype=jnp.bfloat16 if dtype_name == "bf16" else jnp.float32)
+    # A/B knob: store params AND optimizer slots in bf16 (pure-bf16
+    # training).  The step is bytes-bound and param+slot+grad round trips
+    # are ~1.7 GB of AlexNet b256's 12.26 GB — halving them raises the
+    # roofline itself.  Off by default: f32 master weights are the
+    # accuracy-safe mixed-precision design.
+    param_bf16 = os.environ.get("SPARKNET_BENCH_PARAM_DTYPE", "f32") == "bf16"
+    set_config(
+        compute_dtype=jnp.bfloat16 if dtype_name == "bf16" else jnp.float32,
+        param_dtype=jnp.bfloat16 if param_bf16 else jnp.float32,
+    )
 
     net_param = getattr(models, model)(batch)
     solver_cfg = getattr(models, f"{model}_solver")()
@@ -164,7 +181,10 @@ def _build_step(batch: int, model: str, crop: int, dtype_name: str):
 
         solver_cfg = dataclasses.replace(solver_cfg, remat=True)
     solver = Solver(solver_cfg, net_param)
-    step, variables, slots, key = solver.jitted_train_step(donate=True)
+    if scan > 1:
+        step, variables, slots, key = solver.jitted_scan_steps(scan, donate=True)
+    else:
+        step, variables, slots, key = solver.jitted_train_step(donate=True)
 
     rs = np.random.RandomState(0)
     feeds = {
@@ -178,27 +198,50 @@ def measured_run(batch: int, iters: int, warmup: int, model: str, crop: int,
                  dtype_name: str, watchdog_phase: list,
                  on_accel: bool = True,
                  result_holder: list | None = None,
-                 record_last: bool = True) -> dict:
+                 record_last: bool = True, scan: int = 1) -> dict:
     """``record_last=False`` for extra (non-headline) measurements: the
     last-good file holds the headline metric, and partial_record matches
-    it by metric+dtype — an extra overwriting it would orphan that."""
+    it by metric+dtype — an extra overwriting it would orphan that.
+
+    ``scan``: solver iterations fused per device dispatch (see
+    _build_step).  The protocol is unchanged — ``iters`` total solver
+    iterations are timed — only the dispatch granularity moves."""
     import numpy as np
 
-    watchdog_phase[0] = "build+compile"
-    step, variables, slots, key, feeds = _build_step(batch, model, crop, dtype_name)
+    requested_scan = scan
+    scan = max(1, min(scan, iters))
+    if iters % scan:
+        scan = 1  # keep the timed iteration count exact
+    if scan != requested_scan:
+        print(
+            f"bench: SPARKNET_BENCH_SCAN={requested_scan} does not divide "
+            f"iters={iters}; running scan={scan} instead",
+            file=sys.stderr, flush=True,
+        )
 
-    for i in range(warmup):
-        variables, slots, loss = step(variables, slots, i, feeds, key)
-    # Fetch the VALUE, not just readiness: remote-relay backends (axon) can
-    # report buffers ready before the chain has executed; pulling the scalar
-    # is the reliable fence.
-    float(loss)
+    watchdog_phase[0] = "build+compile"
+    step, variables, slots, key, feeds = _build_step(
+        batch, model, crop, dtype_name, scan=scan)
+
+    def fence(loss):
+        # Fetch the VALUE, not just readiness: remote-relay backends
+        # (axon) can report buffers ready before the chain has executed;
+        # pulling a scalar is the reliable fence.  With scan>1 the step
+        # returns a [scan] loss vector — fence on its last element.
+        return float(np.asarray(loss).ravel()[-1])
+
+    it = 0
+    for _ in range(max(1, warmup // scan)):
+        variables, slots, loss = step(variables, slots, it, feeds, key)
+        it += scan
+    fence(loss)
 
     watchdog_phase[0] = "timed run"
     t0 = time.perf_counter()
-    for i in range(warmup, warmup + iters):
-        variables, slots, loss = step(variables, slots, i, feeds, key)
-    final_loss = float(loss)
+    for _ in range(iters // scan):
+        variables, slots, loss = step(variables, slots, it, feeds, key)
+        it += scan
+    final_loss = fence(loss)
     dt = time.perf_counter() - t0
     assert np.isfinite(final_loss), final_loss
     watchdog_phase[0] = "done"
@@ -213,6 +256,10 @@ def measured_run(batch: int, iters: int, warmup: int, model: str, crop: int,
         "iters": iters,
         "dtype": dtype_name,
     }
+    if scan > 1:
+        rec["scan"] = scan  # iterations fused per dispatch
+    if os.environ.get("SPARKNET_BENCH_PARAM_DTYPE", "f32") == "bf16":
+        rec["param_dtype"] = "bf16"
     # Window-runner provenance: which journaled dial (probe) this record
     # rode, so the judge can corroborate it against the tunnel log without
     # matching timestamps by hand (docs/evidence_r*/journal.jsonl).  Typed
@@ -247,6 +294,12 @@ def measured_run(batch: int, iters: int, warmup: int, model: str, crop: int,
         try:
             cost = step.lower(variables, slots, 0, feeds, key).compile().cost_analysis()
             cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+            # HloCostAnalysis counts a while/scan BODY once, independent of
+            # trip count (verified empirically: an 8-iter scanned matmul
+            # reports ~1 iteration's flops), so the scan program's cost is
+            # already per-solver-iteration — do NOT divide by scan.  The
+            # value-vs-bound guard below catches any backend that counts
+            # differently rather than banking a contradiction.
             flops = float(cost.get("flops", 0.0))
             bytes_accessed = float(cost.get("bytes accessed", 0.0))
             if flops > 0:
@@ -255,12 +308,27 @@ def measured_run(batch: int, iters: int, warmup: int, model: str, crop: int,
                 peak = V5E_PEAK_FLOPS.get(dtype_name)
                 if peak and bytes_accessed > 0:
                     t_bound = max(flops / peak, bytes_accessed / V5E_HBM_BYTES_S)
-                    rec["roofline_img_s_upper_bound"] = round(batch / t_bound, 1)
-                    rec["roofline_frac"] = round(img_s * t_bound / batch, 3)
-                    # MFU: achieved matmul-FLOP rate over the chip's peak in
-                    # the measured dtype.  Low MFU with high roofline_frac
-                    # means the step is bytes-bound, not badly scheduled.
-                    rec["mfu"] = round(flops * img_s / batch / peak, 4)
+                    bound = round(batch / t_bound, 1)
+                    if img_s > bound:
+                        # a measurement above its own bound means the cost
+                        # analysis described a different program (e.g. a
+                        # backend that scales while-body costs by trip
+                        # count); never bank the contradiction silently
+                        # (CLAUDE.md: no value above its stated roofline)
+                        rec["roofline_img_s_upper_bound_conflicting"] = bound
+                        rec["bound_inconsistency"] = (
+                            "device cost analysis yields a bound below the "
+                            "measured value; cost evidence dropped — see "
+                            "bench.py scan/cost-analysis note"
+                        )
+                    else:
+                        rec["roofline_img_s_upper_bound"] = bound
+                        rec["roofline_frac"] = round(img_s * t_bound / batch, 3)
+                        # MFU: achieved matmul-FLOP rate over the chip's
+                        # peak in the measured dtype.  Low MFU with high
+                        # roofline_frac means the step is bytes-bound, not
+                        # badly scheduled.
+                        rec["mfu"] = round(flops * img_s / batch / peak, 4)
         except Exception:
             pass  # evidence, not a dependency of the measurement
         if record_last:
@@ -442,6 +510,11 @@ def main() -> int:
     batch = _env_int("SPARKNET_BENCH_BATCH", 256 if on_accel else 16)
     iters = 20 if on_accel else 2
     warmup = 3 if on_accel else 1
+    # Iterations fused per dispatch (lax.scan).  Default on accelerators:
+    # the whole timed run in ONE dispatch — the TPU-native loop, and over
+    # the axon relay it removes the fixed per-dispatch RPC overhead.
+    # SPARKNET_BENCH_SCAN=1 gives the legacy dispatch-per-iteration A/B.
+    scan = _env_int("SPARKNET_BENCH_SCAN", iters if on_accel else 1)
     # Mixed precision is the TPU-native design point: bf16 activations /
     # conv+matmul FLOPs (full MXU rate on v5e; f32 matmuls are emulated at
     # a fraction of peak), f32 master params and optimizer state.  Default
@@ -505,7 +578,7 @@ def main() -> int:
     record_last = os.environ.get("SPARKNET_BENCH_RECORD_LAST", "1") != "0"
     rec = measured_run(batch, iters, warmup, model, crop, dtype_name, phase,
                        on_accel=on_accel, result_holder=result_holder,
-                       record_last=record_last)
+                       record_last=record_last, scan=scan)
     done.set()
     emit(rec)
 
@@ -545,7 +618,8 @@ def main() -> int:
             try:
                 phase[0] = f"extra:{ex_model}/{ex_dtype}"
                 r = measured_run(ex_batch, iters, warmup, ex_model, ex_crop,
-                                 ex_dtype, phase, record_last=False)
+                                 ex_dtype, phase, record_last=False,
+                                 scan=scan)
                 results.append(r)
                 print(f"bench extra: {json.dumps(r)}", file=sys.stderr, flush=True)
             except Exception as e:

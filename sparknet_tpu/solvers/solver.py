@@ -346,6 +346,52 @@ class Solver:
         return fn, self.variables, self.slots, self._key
 
     # ------------------------------------------------------------------
+    def jitted_scan_steps(self, n: int, donate: bool = True,
+                          stacked_feeds: bool = False):
+        """``n`` full solver iterations fused into ONE device program via
+        ``lax.scan`` — the TPU-native training loop (SURVEY §3: everything
+        under jit is traced once; host dispatch is not free, especially
+        over a remote-relay backend where every dispatch is an RPC).
+
+        Returns ``(fn, variables, slots, key)`` with
+        ``fn(variables, slots, it0, feeds, key) -> (variables, slots,
+        losses[n])``; iteration numbers ``it0 .. it0+n-1`` drive the lr
+        schedule exactly as ``n`` separate calls would (ref: the per-iter
+        ``GetLearningRate`` in solver.cpp:27-58 — same schedule, one
+        dispatch).
+
+        ``stacked_feeds=False``: every step consumes the same feed dict
+        (the benchmark protocol's fixed in-memory batch).
+        ``stacked_feeds=True``: each feed array carries a leading [n]
+        axis and step ``i`` consumes slice ``i`` (real data: stage n
+        minibatches, dispatch once).
+        """
+        base_step = self._make_train_step(debug=False)
+
+        def multi(variables, slots, it0, feeds, key):
+            def body(carry, x):
+                variables, slots = carry
+                if stacked_feeds:
+                    i, micro = x
+                else:
+                    i, micro = x, feeds
+                variables, slots, loss = base_step(
+                    variables, slots, it0 + i, micro, key
+                )
+                return (variables, slots), loss
+
+            xs = jnp.arange(n)
+            if stacked_feeds:
+                xs = (xs, feeds)
+            (variables, slots), losses = jax.lax.scan(
+                body, (variables, slots), xs
+            )
+            return variables, slots, losses
+
+        fn = jax.jit(multi, donate_argnums=(0, 1) if donate else ())
+        return fn, self.variables, self.slots, self._key
+
+    # ------------------------------------------------------------------
     def step(self, num_iters: int, data_fn: DataFn, callback=None) -> float:
         """Run ``num_iters`` training iterations (ref: Solver::Step).
 

@@ -25,33 +25,46 @@ from collections import defaultdict
 _SCOPE = re.compile(r"\bL\.([\w.\-]+)")
 
 
-def profile_step(step_fn, args, iters: int = 5) -> dict:
-    """Run ``step_fn(*args)`` ``iters`` times under the profiler; returns
-    {"events": [(name, dur_us)], "wall_step_us": float}.
+def trace_step(step_fn, args, iters: int) -> dict:
+    """One traced segment: run ``step_fn(*args)`` ``iters`` times under
+    the profiler.  Returns {"events", "wall_step_us", "trace_dir"}.
 
-    The first call is executed before tracing starts so compile time
-    never pollutes the trace.
+    The caller is responsible for having warmed the function up (compile
+    time must not pollute the trace).  Kept small so callers can run a
+    SHORT segment first and bank its parsed result before risking a
+    longer one — profiler starts have twice coincided with relay wedges
+    (docs/TUNNEL_LOG_r3.md), so every stop_trace must leave a durable
+    artifact behind it.
     """
     import time
 
     import jax
 
-    out = step_fn(*args)
-    jax.block_until_ready(out)
-
     tmp = tempfile.mkdtemp(prefix="tpunet_time_")
     jax.profiler.start_trace(tmp)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = step_fn(*args)
-    jax.block_until_ready(out)
-    wall = (time.perf_counter() - t0) / iters
-    jax.profiler.stop_trace()
+    try:
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = step_fn(*args)
+        jax.block_until_ready(out)
+        wall = (time.perf_counter() - t0) / iters
+    finally:
+        jax.profiler.stop_trace()
     return {
         "events": _device_events(tmp),
         "wall_step_us": wall * 1e6,
         "trace_dir": tmp,
     }
+
+
+def profile_step(step_fn, args, iters: int = 5) -> dict:
+    """Warm up once (outside the trace), then one traced segment."""
+    import jax
+
+    out = step_fn(*args)
+    jax.block_until_ready(out)
+    return trace_step(step_fn, args, iters)
 
 
 def _device_events(log_dir: str) -> list[tuple[str, float]]:
@@ -111,6 +124,13 @@ def layer_time_table(step_fn, args, layer_names, iters: int = 5) -> dict:
     """The ``tpunet time --trace`` payload: per-layer device µs/step (in
     net order, then the rest), total device time, and wall step time."""
     prof = profile_step(step_fn, args, iters)
+    return table_from_trace(prof, layer_names, iters)
+
+
+def table_from_trace(prof: dict, layer_names, iters: int) -> dict:
+    """Aggregate one trace_step/profile_step result into the per-layer
+    payload (split out so staged callers can table each segment as soon
+    as it lands, before risking the next one)."""
     per_layer, device_total = aggregate_by_layer(prof["events"], iters)
     ordered: list[tuple[str, float]] = []
     for name in layer_names:

@@ -199,6 +199,58 @@ def test_snapshot_restore_reproduces_trajectory(tmp_path):
     np.testing.assert_allclose(final_direct, final_restored, rtol=1e-6)
 
 
+def test_scan_steps_match_separate_dispatches():
+    """jitted_scan_steps(n): n solver iterations fused into one device
+    program must produce the SAME trajectory as n separate dispatches —
+    including the per-iteration lr schedule (step policy flips mid-scan
+    to pin that ``it0 + i`` really drives GetLearningRate)."""
+    cfg = SolverConfig(base_lr=0.1, momentum=0.9, solver_type="SGD",
+                       lr_policy="step", gamma=0.5, stepsize=3)
+    data_fn, _ = _linreg_data_fn()
+    feeds = data_fn(0)
+
+    a = _make_solver(cfg)
+    step, v, s, key = a.jitted_train_step(donate=False)
+    for i in range(6):  # crosses the stepsize=3 lr drop
+        v, s, loss = step(v, s, i, feeds, key)
+
+    b = _make_solver(cfg)
+    scan_fn, sv, ss, skey = b.jitted_scan_steps(6, donate=False)
+    sv, ss, losses = scan_fn(sv, ss, 0, feeds, skey)
+
+    assert losses.shape == (6,)
+    np.testing.assert_allclose(
+        np.asarray(sv.params["ip"][0]), np.asarray(v.params["ip"][0]),
+        rtol=1e-5,
+    )
+
+
+def test_scan_steps_stacked_feeds():
+    """stacked_feeds=True: step i consumes feed slice i (staged
+    minibatches, one dispatch) — equivalent to feeding them one by one."""
+    cfg = SolverConfig(base_lr=0.05, solver_type="SGD")
+    data_fn, _ = _linreg_data_fn()
+
+    a = _make_solver(cfg)
+    step, v, s, key = a.jitted_train_step(donate=False)
+    for i in range(4):
+        v, s, _ = step(v, s, i, data_fn(i), key)
+
+    b = _make_solver(cfg)
+    scan_fn, sv, ss, skey = b.jitted_scan_steps(
+        4, donate=False, stacked_feeds=True)
+    stacked = {
+        k: jnp.stack([data_fn(i)[k] for i in range(4)])
+        for k in data_fn(0)
+    }
+    sv, ss, losses = scan_fn(sv, ss, 0, stacked, skey)
+    assert losses.shape == (4,)
+    np.testing.assert_allclose(
+        np.asarray(sv.params["ip"][0]), np.asarray(v.params["ip"][0]),
+        rtol=1e-5,
+    )
+
+
 def test_iter_size_accumulation():
     """iter_size=2 with two half-batches == one full batch step (SGD)."""
     cfg1 = SolverConfig(base_lr=0.1, solver_type="SGD", iter_size=1)

@@ -76,6 +76,31 @@ def test_cli_time_hlo_cost_analysis(capsys):
     assert out["batch"] == 4
 
 
+def test_cli_time_trace_stages_banked(tmp_path, capsys):
+    """`tpunet time --trace --trace-out`: the artifact is flushed after
+    every stage (compile stats, untraced wall timing, short trace, full
+    trace) so a relay wedge mid-trace still leaves evidence.  On CPU the
+    final stage lands with measured wall numbers and empty device rows."""
+    import json as _json
+
+    from sparknet_tpu.cli import main
+
+    out = tmp_path / "trace.artifact.json"
+    assert main(["time", "--trace", "--trace-out", str(out),
+                 "--solver", "zoo:lenet", "--batch", "4",
+                 "--iterations", "2"]) == 0
+    line = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["wall_ms_per_step"] > 0 and line["batch"] == 4
+    art = _json.loads(out.read_text())
+    assert art["stage"] == "final"
+    # every earlier stage's fields survive in the artifact (the banking
+    # is cumulative, so partial stages are supersets of their ancestors)
+    assert art["gflop_per_step"] > 0            # stage: compiled
+    assert art["wall_ms_per_step_untraced"] > 0  # stage: wall_timed
+    assert "rows_short" in art                   # stage: trace_short
+    assert art["img_per_sec"] > 0                # stage: final
+
+
 def test_pull_shards_and_create_labelfile(tmp_path, capsys):
     """Dataset staging tools (ref: ec2/pull.py + ec2/create_labelfile.py)."""
     import io
