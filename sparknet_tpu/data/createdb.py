@@ -23,6 +23,7 @@ write their partition into per-worker LMDB/LevelDBs through the C API
 from __future__ import annotations
 
 import functools
+import os
 import struct
 from typing import Iterable, Iterator
 
@@ -132,13 +133,38 @@ def convert_db(src: str, dst: str, backend: str = "record") -> int:
     return n
 
 
-@functools.lru_cache(maxsize=64)
+def _db_stamp(path: str) -> tuple:
+    """mtime/size fingerprint of the DB path (recursed one level for
+    directory-shaped DBs), so the shape cache invalidates when a DB is
+    REBUILT at the same path in-process (CifarDBApp re-materialize,
+    convert_db, tests) instead of serving stale geometry."""
+    try:
+        st = os.stat(path)
+        stamp = [st.st_mtime_ns, st.st_size]
+        if os.path.isdir(path):
+            for name in sorted(os.listdir(path)):
+                try:
+                    s2 = os.stat(os.path.join(path, name))
+                    stamp += [name, s2.st_mtime_ns, s2.st_size]
+                except OSError:
+                    continue
+        return tuple(stamp)
+    except OSError:
+        return ()
+
+
 def peek_db_shape(path: str) -> tuple[int, ...]:
     """(C, H, W) of the first record — Caffe parity: a DataLayer's blob
     geometry is defined by its DB, read at setup from datum 0 (ref:
     data_layer.cpp:40-48 DataLayerSetUp -> data_transformer InferBlobShape).
-    Cached per path: shape inference consults it from several sites per
-    run and a training DB's geometry never changes mid-run."""
+    Cached per (path, content fingerprint): shape inference consults it
+    from several sites per run, and the fingerprint keys out stale
+    entries when the DB is rebuilt at the same path."""
+    return _peek_db_shape_cached(path, _db_stamp(path))
+
+
+@functools.lru_cache(maxsize=64)
+def _peek_db_shape_cached(path: str, _stamp: tuple) -> tuple[int, ...]:
     db, decode = _open_reader(path)
     with db:
         for _, value in db:

@@ -566,32 +566,49 @@ class LevelDbReader:
             if not os.path.exists(fname):
                 fname = os.path.join(path, f"{fnum:06d}.sst")
             self._tables.append(fname)
-        # memtable overlay: newest-wins dict of (seq, type, value)
-        self._overlay: dict[bytes, tuple[int, int, bytes]] = {}
-        live = state.get("log_number", 0)
-        logs = sorted(
-            int(n.split(".")[0]) for n in os.listdir(path)
-            if n.endswith(".log") and int(n.split(".")[0]) >= live
-        )
-        for fnum in logs:
-            with open(os.path.join(path, f"{fnum:06d}.log"), "rb") as f:
-                for payload in _log_records(f.read()):
-                    for seq, t, key, value in _decode_batch(payload):
-                        cur = self._overlay.get(key)
-                        if cur is None or seq >= cur[0]:
-                            self._overlay[key] = (seq, t, value)
+        self._live_log = state.get("log_number", 0)
+        # memtable overlay (newest-wins dict of (seq, type, value)) —
+        # built LAZILY at first iteration: opening a DB for a one-record
+        # probe (peek_db_shape) must not replay the whole live log, and
+        # the auto-SST writer keeps bulk data out of the log anyway
+        self._overlay_cache: dict[bytes, tuple[int, int, bytes]] | None = None
         self._count: int | None = None
+
+    @property
+    def _overlay(self) -> dict[bytes, tuple[int, int, bytes]]:
+        if self._overlay_cache is None:
+            overlay: dict[bytes, tuple[int, int, bytes]] = {}
+            logs = sorted(
+                int(n.split(".")[0]) for n in os.listdir(self.path)
+                if n.endswith(".log") and int(n.split(".")[0]) >= self._live_log
+            )
+            for fnum in logs:
+                with open(os.path.join(self.path, f"{fnum:06d}.log"), "rb") as f:
+                    for payload in _log_records(f.read()):
+                        for seq, t, key, value in _decode_batch(payload):
+                            cur = overlay.get(key)
+                            if cur is None or seq >= cur[0]:
+                                overlay[key] = (seq, t, value)
+            self._overlay_cache = overlay
+        return self._overlay_cache
 
     def _merged(self):
         """Lazy (key, seq, type, value) stream, sorted by key, newest
         sequence winning across tables and the log overlay."""
         import heapq
+        import mmap
 
         def table_iter(fname):
+            # mmap instead of read(): a short iteration (the DataLayer
+            # geometry peek) touches only the first blocks; the OS pages
+            # in what the parse actually slices
             with open(fname, "rb") as f:
-                raw = f.read()
-            for seq, t, key, value in _sst_entries(raw):
-                yield key, seq, t, value
+                raw = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+                try:
+                    for seq, t, key, value in _sst_entries(raw):
+                        yield key, seq, t, value
+                finally:
+                    raw.close()
 
         streams = [table_iter(f) for f in self._tables]
         streams.append(
@@ -628,19 +645,26 @@ class LevelDbReader:
 
 
 class LevelDbWriter:
-    """Write a LevelDB env from scratch.  Default: log-only (the state a
-    real leveldb leaves after CreateDB's typical run — records in the
-    live log, recovered on open).  ``sst=True``: one Level-0 table.
+    """Write a LevelDB env from scratch.  ``sst=None`` (default) mimics a
+    real leveldb's memtable policy: small writes stay in the live log
+    (recovered on open — CreateDB's typical end state), but once the
+    buffered payload passes ``write_buffer_size`` (~4 MB, the bound a
+    real memtable flushes at) the records are written as one Level-0
+    SSTable instead, so readers heap-merge from disk rather than replay
+    a dataset-sized log into RAM.  ``sst=True``/``False`` force either.
 
     Same buffered-commit contract as ``LmdbWriter``: everything is
     written durably at ``close()``."""
 
-    def __init__(self, path: str, *, sst: bool = False,
+    WRITE_BUFFER_SIZE = 4 << 20  # leveldb options.write_buffer_size default
+
+    def __init__(self, path: str, *, sst: bool | None = None,
                  compress: bool = False):
         self.path = path
         self.sst = sst
         self.compress = compress
         self._items: dict[bytes, bytes] = {}
+        self._bytes = 0
         self._closed = False
         os.makedirs(path, exist_ok=True)
         # refuse a live destination: leftover NNNNNN.log/.ldb files would
@@ -664,7 +688,11 @@ class LevelDbWriter:
             raise ValueError("writer is closed")
         if not isinstance(key, bytes) or not key:
             raise ValueError("key must be non-empty bytes")
+        old = self._items.get(key)
+        if old is not None:
+            self._bytes -= len(key) + len(old)
         self._items[key] = value
+        self._bytes += len(key) + len(value)
 
     _commit_warned = False
 
@@ -687,7 +715,9 @@ class LevelDbWriter:
         self._closed = True
         items = sorted(self._items.items())
         seq = len(items)
-        if self.sst:
+        sst = (self._bytes > self.WRITE_BUFFER_SIZE
+               if self.sst is None else self.sst)
+        if sst:
             table = (_encode_sst(items, compress=self.compress)
                      if items else None)
             new_files = []

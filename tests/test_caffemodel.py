@@ -109,6 +109,7 @@ def test_unpacked_float_data_decodes():
 
 
 # ---------------------------------------------------------------- net level
+@pytest.mark.smoke
 def test_tpunet_caffemodel_roundtrip(tmp_path):
     net = TPUNet(models.lenet_solver(), models.lenet(4))
     path = str(tmp_path / "lenet.caffemodel")

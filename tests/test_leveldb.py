@@ -319,3 +319,32 @@ class TestDataLayerIngest:
             "--data", "proto", "--iterations", "2",
             "--output", str(tmp_path / "out"),
         ]) == 0
+
+
+class TestWriterAutoSst:
+    def test_small_write_stays_log_only(self, tmp_path):
+        p = str(tmp_path / "small")
+        with LevelDbWriter(p) as w:  # sst=None: auto by payload size
+            w.put(b"k", b"v" * 100)
+        names = set(os.listdir(p))
+        assert not any(n.endswith((".ldb", ".sst")) for n in names), names
+
+    def test_large_write_flushes_as_sstable(self, tmp_path):
+        """Past write_buffer_size (~4 MB, the bound a real memtable
+        flushes at) the auto writer emits a Level-0 table, so a reader's
+        one-record geometry peek never replays a dataset-sized log into
+        RAM (ADVICE r3: leveldb_io eager-load)."""
+        p = str(tmp_path / "big")
+        blob = bytes(range(256)) * 2048  # 512 KiB, incompressible-ish
+        with LevelDbWriter(p) as w:
+            for i in range(10):  # ~5 MB total
+                w.put(f"{i:04d}".encode(), blob)
+        assert any(n.endswith(".ldb") for n in os.listdir(p))
+        with LevelDbReader(p) as r:
+            # lazy overlay: opening + first record must not need the log
+            assert r._overlay_cache is None
+            k, v = next(iter(r))
+            assert (k, v) == (b"0000", blob)
+        with LevelDbReader(p) as r:
+            assert len(r) == 10
+            assert [k for k, _ in r] == [f"{i:04d}".encode() for i in range(10)]

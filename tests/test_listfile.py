@@ -52,6 +52,7 @@ def _image_layer(listfile, root, extra="", transform=""):
     ).get_all("layer")[0]
 
 
+@pytest.mark.smoke
 def test_image_data_source_shapes_and_loop(image_list):
     root, listfile = image_list
     src = ImageDataSource(_image_layer(listfile, root), train=True)

@@ -160,6 +160,7 @@ class TestDataLayerIngest:
             for i in range(n)
         ]
 
+    @pytest.mark.smoke
     def test_lmdb_feeds_db_minibatches(self, tmp_path):
         samples = self._images(20)
         p = str(tmp_path / "caffe_lmdb")
@@ -488,3 +489,75 @@ def test_cli_train_db_shape_mismatch(tmp_path, monkeypatch):
     with pytest.raises(SystemExit, match="do not match"):
         main(["train", "--solver", "zoo:lenet", "--batch", "4",
               "--iterations", "1", "--data", f"db:{p}"])
+
+
+def test_peek_db_shape_invalidates_on_rebuild(tmp_path):
+    """A DB rebuilt at the same path in-process (CifarDBApp
+    re-materialize, convert_db, tests) must not serve stale geometry
+    from the peek cache (ADVICE r3: createdb lru_cache by path)."""
+    import shutil
+    import time
+
+    import numpy as np
+
+    from sparknet_tpu.data.createdb import create_db, peek_db_shape
+
+    rs = np.random.RandomState(0)
+    p = str(tmp_path / "db")
+    create_db(p, [(rs.randint(0, 255, (1, 8, 8)).astype(np.uint8), 0)])
+    assert peek_db_shape(p) == (1, 8, 8)
+    shutil.rmtree(p, ignore_errors=True) or os.path.exists(p) and os.remove(p)
+    time.sleep(0.01)  # ensure a distinct mtime_ns on coarse filesystems
+    create_db(p, [(rs.randint(0, 255, (3, 12, 12)).astype(np.uint8), 0)])
+    assert peek_db_shape(p) == (3, 12, 12)
+
+
+def test_cli_test_stream_honors_test_phase_transform(tmp_path, monkeypatch):
+    """A TEST-phase Data layer declaring its OWN transform_param (here a
+    different crop) drives the test stream; before the r4 fix the TRAIN
+    layer's params were applied to both phases (ADVICE r3: cli db:
+    branch), which mis-shapes the eval feed."""
+    import numpy as np
+
+    monkeypatch.chdir(tmp_path)
+
+    from sparknet_tpu.cli import main
+    from sparknet_tpu.data.createdb import create_db
+
+    rs = np.random.RandomState(0)
+    samples = [
+        (rs.randint(0, 255, (3, 12, 12)).astype(np.uint8), i % 4)
+        for i in range(32)
+    ]
+    db = str(tmp_path / "lmdb")
+    create_db(db, samples, backend="lmdb")
+
+    (tmp_path / "net.prototxt").write_text(
+        'name: "phases"\n'
+        'layer { name: "d" type: "Data" top: "data" top: "label"\n'
+        '  include { phase: TRAIN }\n'
+        f'  data_param {{ source: "{db}" batch_size: 8 }}\n'
+        "  transform_param { crop_size: 10 }\n"
+        "}\n"
+        'layer { name: "d" type: "Data" top: "data" top: "label"\n'
+        '  include { phase: TEST }\n'
+        f'  data_param {{ source: "{db}" batch_size: 8 }}\n'
+        "  transform_param { crop_size: 8 }\n"
+        "}\n"
+        'layer { name: "conv" type: "Convolution" bottom: "data" top: "c"\n'
+        "  convolution_param { num_output: 2 kernel_size: 3 } }\n"
+        'layer { name: "pool" type: "Pooling" bottom: "c" top: "p"\n'
+        "  pooling_param { pool: AVE global_pooling: true } }\n"
+        'layer { name: "ip" type: "InnerProduct" bottom: "p" top: "ip"\n'
+        "  inner_product_param { num_output: 4 } }\n"
+        'layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" '
+        'bottom: "label" top: "loss" }\n'
+    )
+    (tmp_path / "solver.prototxt").write_text(
+        'net: "net.prototxt"\nbase_lr: 0.01\nmax_iter: 2\ndisplay: 0\n'
+    )
+    assert main([
+        "train", "--solver", str(tmp_path / "solver.prototxt"),
+        "--data", f"db:{db}", "--iterations", "2", "--test-iters", "1",
+        "--output", str(tmp_path / "out"),
+    ]) == 0
