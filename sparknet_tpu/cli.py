@@ -432,10 +432,14 @@ def _data_fns(args, net, test_net=None):
             }
 
         trainp = _tp_params(_phase_tp(net))
-        test_tp = _phase_tp(test_net) if test_net is not None else None
-        # a TEST net declaring its own transform_param wins for the test
-        # stream; otherwise both phases share the train declaration
-        testp = _tp_params(test_tp) if test_tp is not None else trainp
+        # Caffe semantics: each phase's Data layer carries its OWN
+        # transform_param — a TEST layer without one gets DEFAULTS (no
+        # crop/mean), it does NOT inherit the train declaration.  The
+        # train params cover the test stream only when the caller has no
+        # distinct test net or it declares no Data layer at all.
+        test_has_data = test_net is not None and any(
+            getattr(l, "TYPE", "") == "Data" for l in test_net.input_layers)
+        testp = _tp_params(_phase_tp(test_net)) if test_has_data else trainp
         crop = trainp["crop"]
         mirror = trainp["mirror"]
         mean_vals = trainp["mean_vals"]
@@ -749,7 +753,8 @@ def cmd_train(args) -> int:
                         raise KeyboardInterrupt
 
                 try:
-                    solver.step(iters, train_fn, callback=hook)
+                    solver.step(iters, train_fn, callback=hook,
+                                scan_chunk=getattr(args, "scan", 1))
                 except KeyboardInterrupt:
                     log("stopped by signal", i=solver.iter)
     if args.test_iters:
@@ -1609,6 +1614,12 @@ def main(argv=None) -> int:
                     help="override the solver's random_seed; also offsets "
                     "the host/device data-augmentation streams (without "
                     "it, augmentation keys derive from process id only)")
+    sp.add_argument("--scan", type=int, default=1,
+                    help="iterations fused per device dispatch (lax.scan "
+                    "over staged minibatches; auto-shrunk to divide the "
+                    "display/snapshot cadences; signal checks then land "
+                    "between chunks). Single-chip path; tau>1 already "
+                    "scans its local steps")
     sp.add_argument("--output", help="snapshot prefix for the final model")
     sp.add_argument("--profile", help="capture a jax.profiler trace into DIR")
     sp.set_defaults(fn=cmd_train)

@@ -392,12 +392,26 @@ class Solver:
         return fn, self.variables, self.slots, self._key
 
     # ------------------------------------------------------------------
-    def step(self, num_iters: int, data_fn: DataFn, callback=None) -> float:
+    def step(self, num_iters: int, data_fn: DataFn, callback=None,
+             scan_chunk: int = 1) -> float:
         """Run ``num_iters`` training iterations (ref: Solver::Step).
 
         Returns the final smoothed loss.  ``callback(iter, loss)`` runs
-        every iteration on the host (display/snapshot hooks)."""
+        every iteration on the host (display/snapshot hooks).
+
+        ``scan_chunk > 1`` fuses that many iterations per device dispatch
+        (lax.scan over staged minibatches — the TPU-native loop; over a
+        remote-relay backend each dispatch is an RPC).  The chunk size is
+        shrunk to divide the display and snapshot cadences so those fire
+        at their exact reference iterations; callbacks then run in order
+        AFTER each chunk (each still sees its per-iteration loss, but
+        solver state has already advanced to the chunk end — interactive
+        per-step control wants scan_chunk=1).  ``debug_info`` forces the
+        per-iteration path (its stats are per-step host prints)."""
         cfg = self.config
+        if scan_chunk > 1 and not cfg.debug_info:
+            return self._step_scanned(num_iters, data_fn, callback,
+                                      scan_chunk)
         for _ in range(num_iters):
             feeds = data_fn(self.iter)
             out = self._train_step(
@@ -425,6 +439,85 @@ class Solver:
                 callback(self.iter, float(loss))
             if cfg.snapshot and self.iter % cfg.snapshot == 0 and cfg.snapshot_prefix:
                 self.save(f"{cfg.snapshot_prefix}_iter_{self.iter}")
+        self.smoothed_loss = self._smoothed()
+        return self.smoothed_loss
+
+    def _step_scanned(self, num_iters: int, data_fn: DataFn, callback,
+                      scan_chunk: int) -> float:
+        """The scan-fused body of :meth:`step` (see its docstring)."""
+        import math
+
+        import numpy as np
+
+        cfg = self.config
+        chunk = max(1, min(scan_chunk, num_iters))
+        for cadence in (cfg.display,
+                        cfg.snapshot if cfg.snapshot_prefix else 0):
+            if cadence:
+                chunk = math.gcd(chunk, cadence)
+        if not hasattr(self, "_scan_fns"):
+            self._scan_fns: dict = {}
+
+        done = 0
+        while done < num_iters:
+            n = min(chunk, num_iters - done)
+            if cfg.snapshot and cfg.snapshot_prefix:
+                # a resume can start between snapshot boundaries: cap the
+                # chunk so every boundary lands exactly at a chunk end
+                # (the save must see the boundary-iteration state)
+                n = min(n, cfg.snapshot - (self.iter % cfg.snapshot))
+            if n < 2:
+                # single-step chunk (tail, or one iter shy of a snapshot
+                # boundary): the per-iteration path implements every hook
+                # exactly; larger chunks may still follow
+                self.step(1, data_fn, callback)
+                done += 1
+                continue
+            if n not in self._scan_fns:
+                self._scan_fns[n], _, _, _ = self.jitted_scan_steps(
+                    n, donate=False, stacked_feeds=True)
+            fn = self._scan_fns[n]
+            start = self.iter
+            host = [data_fn(start + i) for i in range(n)]
+            if any(isinstance(v, jax.Array) for v in host[0].values()):
+                # prefetched feeds are already device-resident: stack on
+                # device — np.asarray here would force a blocking D2H of
+                # every batch, serializing the pipeline prefetch overlaps
+                stacked = {
+                    k: jnp.stack([h[k] for h in host]) for k in host[0]
+                }
+            else:
+                stacked = jax.device_put({
+                    k: np.stack([np.asarray(h[k]) for h in host])
+                    for k in host[0]
+                })
+            self.variables, self.slots, losses = fn(
+                self.variables, self.slots, start, stacked, self._key
+            )
+            losses = np.asarray(losses)
+            # solver state is at the CHUNK END from here on: advance iter
+            # BEFORE replaying the per-iteration hooks so a callback that
+            # snapshots (the CLI's signal hook) or stops records iter and
+            # params from the same point — never iter=k with k+m params
+            self.iter = start + n
+            for i in range(n):
+                loss = float(losses[i])
+                self._loss_window.append(loss)
+                if len(self._loss_window) > cfg.average_loss:
+                    self._loss_window.pop(0)
+                it_i = start + i + 1
+                if cfg.display and it_i % cfg.display == 0:
+                    print(
+                        f"Iteration {it_i}, loss = "
+                        f"{self._smoothed():.6g}, "
+                        f"lr = {float(learning_rate(cfg, it_i)):.6g}"
+                    )
+                if callback:
+                    callback(it_i, loss)
+            if (cfg.snapshot and cfg.snapshot_prefix
+                    and self.iter % cfg.snapshot == 0):
+                self.save(f"{cfg.snapshot_prefix}_iter_{self.iter}")
+            done += n
         self.smoothed_loss = self._smoothed()
         return self.smoothed_loss
 

@@ -561,3 +561,52 @@ def test_cli_test_stream_honors_test_phase_transform(tmp_path, monkeypatch):
         "--data", f"db:{db}", "--iterations", "2", "--test-iters", "1",
         "--output", str(tmp_path / "out"),
     ]) == 0
+
+
+def test_cli_test_phase_without_transform_gets_defaults(tmp_path,
+                                                        monkeypatch):
+    """A TEST-phase Data layer with NO transform_param gets Caffe's
+    defaults (no crop) — it must not inherit the TRAIN declaration."""
+    import numpy as np
+
+    monkeypatch.chdir(tmp_path)
+
+    from sparknet_tpu.cli import main
+    from sparknet_tpu.data.createdb import create_db
+
+    rs = np.random.RandomState(0)
+    samples = [
+        (rs.randint(0, 255, (3, 12, 12)).astype(np.uint8), i % 4)
+        for i in range(32)
+    ]
+    db = str(tmp_path / "lmdb")
+    create_db(db, samples, backend="lmdb")
+
+    (tmp_path / "net.prototxt").write_text(
+        'name: "defaults"\n'
+        'layer { name: "d" type: "Data" top: "data" top: "label"\n'
+        '  include { phase: TRAIN }\n'
+        f'  data_param {{ source: "{db}" batch_size: 8 }}\n'
+        "  transform_param { crop_size: 10 }\n"
+        "}\n"
+        'layer { name: "d" type: "Data" top: "data" top: "label"\n'
+        '  include { phase: TEST }\n'
+        f'  data_param {{ source: "{db}" batch_size: 8 }}\n'
+        "}\n"
+        'layer { name: "conv" type: "Convolution" bottom: "data" top: "c"\n'
+        "  convolution_param { num_output: 2 kernel_size: 3 } }\n"
+        'layer { name: "pool" type: "Pooling" bottom: "c" top: "p"\n'
+        "  pooling_param { pool: AVE global_pooling: true } }\n"
+        'layer { name: "ip" type: "InnerProduct" bottom: "p" top: "ip"\n'
+        "  inner_product_param { num_output: 4 } }\n"
+        'layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" '
+        'bottom: "label" top: "loss" }\n'
+    )
+    (tmp_path / "solver.prototxt").write_text(
+        'net: "net.prototxt"\nbase_lr: 0.01\nmax_iter: 2\ndisplay: 0\n'
+    )
+    assert main([
+        "train", "--solver", str(tmp_path / "solver.prototxt"),
+        "--data", f"db:{db}", "--iterations", "2", "--test-iters", "1",
+        "--output", str(tmp_path / "out"),
+    ]) == 0

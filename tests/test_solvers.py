@@ -251,6 +251,48 @@ def test_scan_steps_stacked_feeds():
     )
 
 
+def test_step_scanned_matches_per_iteration(tmp_path, capsys):
+    """Solver.step(scan_chunk=N): same trajectory, same display lines at
+    the same iterations, snapshots at the exact reference boundaries."""
+    def make():
+        cfg = SolverConfig(base_lr=0.02, momentum=0.9, solver_type="SGD",
+                           display=2, snapshot=4,
+                           snapshot_prefix=str(tmp_path / "snap"))
+        return _make_solver(cfg)
+
+    data_fn, _ = _linreg_data_fn()
+
+    a = make()
+    a.step(12, data_fn)
+    out_a = capsys.readouterr().out
+    snaps_a = sorted(p.name for p in tmp_path.glob("snap_iter_*"))
+    for p in tmp_path.glob("snap_iter_*"):
+        p.unlink()
+
+    b = make()
+    b.step(12, data_fn, scan_chunk=4)  # gcd(4, display 2, snapshot 4) = 2
+    out_b = capsys.readouterr().out
+    snaps_b = sorted(p.name for p in tmp_path.glob("snap_iter_*"))
+
+    np.testing.assert_allclose(
+        np.asarray(b.variables.params["ip"][0]),
+        np.asarray(a.variables.params["ip"][0]), rtol=1e-5)
+    assert b.iter == a.iter == 12
+    assert [l for l in out_b.splitlines() if l.startswith("Iteration")] == \
+           [l for l in out_a.splitlines() if l.startswith("Iteration")]
+    assert snaps_b == snaps_a and snaps_a  # same boundary files
+
+
+def test_step_scanned_callback_sees_every_iteration():
+    cfg = SolverConfig(base_lr=0.02, solver_type="SGD")
+    solver = _make_solver(cfg)
+    data_fn, _ = _linreg_data_fn()
+    seen = []
+    solver.step(9, data_fn, callback=lambda it, loss: seen.append(it),
+                scan_chunk=4)
+    assert seen == list(range(1, 10))
+
+
 def test_iter_size_accumulation():
     """iter_size=2 with two half-batches == one full batch step (SGD)."""
     cfg1 = SolverConfig(base_lr=0.1, solver_type="SGD", iter_size=1)

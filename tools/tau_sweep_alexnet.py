@@ -39,13 +39,18 @@ def parse_args():
                    help="per-worker minibatch")
     p.add_argument("--classes", type=int, default=10)
     p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--noise", type=float, default=40.0,
+                   help="pixel-noise sigma (templates are +-80); lower = "
+                   "higher SNR so every arm escapes the softmax plateau "
+                   "inside the budget")
     p.add_argument("--out", default="docs/tau_sweep_alexnet.json")
     return p.parse_args()
 
 
-def make_task(classes: int, crop: int, seed: int = 0):
-    """10 fixed pixel-scale templates + N(0, 40) noise (the zoo fillers
-    are calibrated for raw-pixel inputs — see .claude/skills/verify)."""
+def make_task(classes: int, crop: int, seed: int = 0, noise: float = 40.0):
+    """Fixed pixel-scale templates (+-80) + N(0, noise) pixels (the zoo
+    fillers are calibrated for raw-pixel inputs — see
+    .claude/skills/verify)."""
     import numpy as np
 
     rs = np.random.RandomState(seed)
@@ -53,7 +58,8 @@ def make_task(classes: int, crop: int, seed: int = 0):
 
     def sample(rng, n):
         y = rng.randint(0, classes, n)
-        x = templates[y] + rng.randn(n, 3, crop, crop).astype(np.float32) * 40
+        x = templates[y] + (
+            rng.randn(n, 3, crop, crop).astype(np.float32) * noise)
         return x, y.astype(np.int32)
 
     return sample
@@ -77,7 +83,7 @@ def main() -> int:
     from sparknet_tpu.solvers.solver import Solver
     from sparknet_tpu.solvers.solver import SolverConfig
 
-    sample = make_task(args.classes, args.crop)
+    sample = make_task(args.classes, args.crop, noise=args.noise)
     eval_rs = np.random.RandomState(99)
     xte, yte = sample(eval_rs, 256)
     B = args.batch
@@ -143,17 +149,27 @@ def main() -> int:
         "model": "alexnet", "crop": args.crop, "workers": workers,
         "per_worker_batch": B, "budget": args.budget,
         "recipe": "bvlc_alexnet solver (fixed lr variant)",
+        "noise_sigma": args.noise,
         "rows": rows,
         "utc": time.strftime("%Y-%m-%d %H:%M:%SZ", time.gmtime()),
     }
+    out_path = args.out
+    if not os.path.isabs(out_path):
+        # bank relative outputs under the repo root regardless of cwd —
+        # a multi-hour sweep must not lose its evidence to a wrong cwd
+        out_path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            out_path)
+    rc = 0
     try:
-        with open(args.out + ".tmp", "w") as f:
+        with open(out_path + ".tmp", "w") as f:
             json.dump(out, f, indent=1)
-        os.replace(args.out + ".tmp", args.out)
-    except OSError:
-        pass
+        os.replace(out_path + ".tmp", out_path)
+    except OSError as e:
+        print(f"tau_sweep: could not write {out_path}: {e}", file=sys.stderr)
+        rc = 1
     print(json.dumps(out))
-    return 0
+    return rc
 
 
 if __name__ == "__main__":
