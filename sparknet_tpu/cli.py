@@ -1031,12 +1031,19 @@ def _time_trace(args, net_param, solver_cfg) -> int:
     mfu = flops / wall_s / peak if peak and wall_s else None
 
     if table["rows"]:
+        # the reference's `caffe time` table: per-layer Forward and
+        # Backward walls plus the total (ref: caffe/tools/caffe.cpp:
+        # 290-380); here attributed from the fused step's device trace
+        fb = {name: (f, b) for name, f, b in table.get("rows_fwd_bwd", [])}
         w = max(len(r) for r, _ in table["rows"]) + 2
-        print(f"{'layer':<{w}}{'device ms/step':>15}")
+        print(f"{'layer':<{w}}{'fwd ms':>10}{'bwd ms':>10}{'total ms':>11}")
         for name, us in table["rows"]:
-            print(f"{name:<{w}}{us / 1e3:>14.3f}")
+            f_us, b_us = fb.get(name, (0.0, 0.0))
+            print(f"{name:<{w}}{f_us / 1e3:>10.3f}{b_us / 1e3:>10.3f}"
+                  f"{us / 1e3:>11.3f}")
         print(
-            f"{'DEVICE TOTAL':<{w}}{table['device_us_per_step'] / 1e3:>14.3f}"
+            f"{'DEVICE TOTAL':<{w}}{'':>10}{'':>10}"
+            f"{table['device_us_per_step'] / 1e3:>11.3f}"
             f"  (attributed {table['attributed_frac'] * 100:.0f}%)"
         )
     else:
@@ -1058,6 +1065,8 @@ def _time_trace(args, net_param, solver_cfg) -> int:
     }
     bank("final",
          rows=[(n, round(us, 1)) for n, us in table["rows"]],
+         rows_fwd_bwd=[(n, round(f, 1), round(b, 1))
+                       for n, f, b in table.get("rows_fwd_bwd", [])],
          device_us_per_step=round(table["device_us_per_step"], 1),
          attributed_frac=round(table["attributed_frac"], 3),
          **summary)

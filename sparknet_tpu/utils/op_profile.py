@@ -120,6 +120,25 @@ def aggregate_by_layer(
     )
 
 
+def aggregate_fwd_bwd(
+    events: list[tuple[str, float]], iters: int
+) -> dict[str, tuple[float, float]]:
+    """Per-layer (forward µs, backward µs) per step — the reference's
+    ``caffe time`` table splits each layer's Forward and Backward walls
+    (ref: caffe/tools/caffe.cpp:290-380).  Under jax autodiff the
+    backward ops carry ``transpose(jvp(L.<name>))`` in their HLO scope
+    path and forward ops plain ``L.<name>``/``jvp(L.<name>)``, so the
+    trace classifies mechanically; fused ops spanning both count as
+    backward when any transpose marker is present."""
+    split: dict[str, list[float]] = defaultdict(lambda: [0.0, 0.0])
+    for name, dur in events:
+        m = _SCOPE.search(name)
+        layer = m.group(1) if m else "(other)"
+        is_bwd = "transpose(jvp(" in name
+        split[layer][1 if is_bwd else 0] += dur
+    return {k: (f / iters, b / iters) for k, (f, b) in split.items()}
+
+
 def layer_time_table(step_fn, args, layer_names, iters: int = 5) -> dict:
     """The ``tpunet time --trace`` payload: per-layer device µs/step (in
     net order, then the rest), total device time, and wall step time."""
@@ -131,6 +150,7 @@ def table_from_trace(prof: dict, layer_names, iters: int) -> dict:
     """Aggregate one trace_step/profile_step result into the per-layer
     payload (split out so staged callers can table each segment as soon
     as it lands, before risking the next one)."""
+    fwd_bwd = aggregate_fwd_bwd(prof["events"], iters)
     per_layer, device_total = aggregate_by_layer(prof["events"], iters)
     ordered: list[tuple[str, float]] = []
     for name in layer_names:
@@ -140,6 +160,13 @@ def table_from_trace(prof: dict, layer_names, iters: int) -> dict:
     ordered.extend(sorted(per_layer.items(), key=lambda kv: -kv[1]))
     return {
         "rows": ordered,
+        # (layer, fwd us, bwd us) in the same order — the caffe time
+        # Forward/Backward split (keyed to ordered rows' names)
+        "rows_fwd_bwd": [
+            (name, *fwd_bwd.get(name.replace("/", "."),
+                                fwd_bwd.get(name, (0.0, 0.0))))
+            for name, _ in ordered
+        ],
         "device_us_per_step": device_total,
         "wall_us_per_step": prof["wall_step_us"],
         "trace_dir": prof["trace_dir"],

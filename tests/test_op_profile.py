@@ -72,6 +72,39 @@ def test_aggregate_googlenet_style_names(tmp_path):
     assert per_layer == {"inception_3a.1x1": 40.0}
 
 
+def test_aggregate_fwd_bwd_split(tmp_path):
+    """Backward ops carry transpose(jvp(L.<name>)) in the HLO scope path
+    (verified against jax lowering); forward ops plain or jvp-wrapped —
+    the caffe time Forward/Backward per-layer split (caffe.cpp:290-380)."""
+    from sparknet_tpu.utils.op_profile import aggregate_fwd_bwd
+
+    root = _write_trace(tmp_path, [
+        ("fusion.1", "jit(step)/jvp(L.conv1)/conv_general", 100.0, 7),
+        ("fusion.2", "jit(step)/transpose(jvp(L.conv1))/conv_general",
+         200.0, 7),
+        ("fusion.3", "jit(step)/L.ip1/dot_general", 30.0, 7),  # eval-style
+        ("copy.4", "", 20.0, 7),
+    ])
+    split = aggregate_fwd_bwd(_device_events(root), iters=2)
+    assert split["conv1"] == (50.0, 100.0)
+    assert split["ip1"] == (15.0, 0.0)
+    assert split["(other)"] == (10.0, 0.0)
+
+
+def test_table_from_trace_fwd_bwd_rows(tmp_path):
+    from sparknet_tpu.utils.op_profile import table_from_trace
+
+    root = _write_trace(tmp_path, [
+        ("f1", "jit(s)/jvp(L.conv1)/conv", 40.0, 7),
+        ("f2", "jit(s)/transpose(jvp(L.conv1))/conv", 80.0, 7),
+    ])
+    prof = {"events": _device_events(root), "wall_step_us": 130.0,
+            "trace_dir": str(tmp_path)}
+    t = table_from_trace(prof, ["conv1"], iters=1)
+    assert t["rows"] == [("conv1", 120.0)]
+    assert t["rows_fwd_bwd"] == [("conv1", 40.0, 80.0)]
+
+
 def test_layer_time_table_cpu_fallback():
     """On CPU the trace has no device lanes: empty rows, measured wall
     time still reported, nothing raises."""
