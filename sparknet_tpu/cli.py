@@ -693,16 +693,28 @@ def cmd_train(args) -> int:
             )
             outer = -(-iters // max(args.tau, 1))  # ceil: run >= requested
             tau_fn = _stack_tau(train_fn, args.tau, trainer.num_local_workers)
+            wide_fn = _widen_batch(train_fn, trainer.num_local_workers)
+            scan_n = max(getattr(args, "scan", 1), 1)
             with SignalHandler() as sig:
-                for o in range(outer):
+                o = 0
+                while o < outer:
                     if args.tau > 1 or elastic:
                         # elastic rounds always take the [tau, B, ...]
-                        # feed contract, tau may be 1
+                        # feed contract, tau may be 1 (dispatch already
+                        # amortized over the tau local steps)
                         loss = trainer.train_round(tau_fn)
+                        o += 1
                     else:
-                        loss = trainer.train_round(
-                            _widen_batch(train_fn, trainer.num_local_workers)
-                        )
+                        # tau=1 sync-SGD: --scan fuses rounds per dispatch
+                        # (signal checks land between chunks).  A short
+                        # TAIL runs per-round: compiling a one-off n-step
+                        # program costs more than the dispatches it saves.
+                        if scan_n > 1 and outer - o >= scan_n:
+                            loss = trainer.train_rounds(scan_n, wide_fn)
+                            o += scan_n
+                        else:
+                            loss = trainer.train_round(wide_fn)
+                            o += 1
                     log(f"loss: {loss:.5f}", i=trainer.iter)
                     action = agree_action(sig.check())
                     if action is SolverAction.SNAPSHOT:
@@ -1625,10 +1637,13 @@ def main(argv=None) -> int:
                     "it, augmentation keys derive from process id only)")
     sp.add_argument("--scan", type=int, default=1,
                     help="iterations fused per device dispatch (lax.scan "
-                    "over staged minibatches; auto-shrunk to divide the "
-                    "display/snapshot cadences; signal checks then land "
-                    "between chunks). Single-chip path; tau>1 already "
-                    "scans its local steps")
+                    "over staged minibatches). Single-chip: auto-shrunk "
+                    "to divide the display/snapshot cadences. With "
+                    "--distributed at tau=1: fuses that many sync-SGD "
+                    "rounds (loss then logs once per chunk). Ignored for "
+                    "tau>1/elastic, which already amortize dispatch over "
+                    "their tau local steps. Signal checks land between "
+                    "chunks either way")
     sp.add_argument("--output", help="snapshot prefix for the final model")
     sp.add_argument("--profile", help="capture a jax.profiler trace into DIR")
     sp.set_defaults(fn=cmd_train)

@@ -369,6 +369,46 @@ class ParallelTrainer:
         return loss
 
     # ------------------------------------------------------------------
+    def train_rounds(self, n: int, data_fn: DataFn) -> float:
+        """``n`` tau=1 sync-SGD rounds fused into ONE device dispatch
+        (lax.scan over staged global batches; GSPMD still inserts the
+        per-step gradient all-reduce inside the loop body).  The scan
+        twin of :meth:`Solver.jitted_scan_steps` for the mesh path:
+        ``train_round``'s own docstring says call sites that care about
+        overlap should batch rounds — this is that batching.  tau>1 and
+        EASGD already amortize dispatch over their tau local steps, so
+        they (and n<=1) fall back to the per-round loop.  Returns the
+        LAST round's global mean loss, like a train_round loop would."""
+        if n <= 1 or self.tau != 1 or self._elastic:
+            loss = 0.0
+            for _ in range(max(n, 1)):
+                loss = self.train_round(data_fn)
+            return loss
+        if not hasattr(self, "_round_scan_fns"):
+            self._round_scan_fns: dict = {}
+        if n not in self._round_scan_fns:
+            # one scan-body implementation lives in the Solver; scan the
+            # SAME step function the per-round jit wraps
+            self._round_scan_fns[n], _, _, _ = self.solver.jitted_scan_steps(
+                n, donate=True, stacked_feeds=True, step_fn=self._step_fn
+            )
+        host = [data_fn(self.iter + i) for i in range(n)]
+        stacked = {
+            k: np.stack([np.asarray(h[k]) for h in host]) for k in host[0]
+        }
+        # [n, B, ...]: the tau-shaped feed placement shards axis 1 over
+        # 'data' and leaves the round axis unsharded — exactly the scan
+        # xs layout
+        feeds = self._put_feeds(stacked, with_tau_axis=True)
+        with self._sp_context():
+            self.variables, self.slots, losses = self._round_scan_fns[n](
+                self.variables, self.slots, self.iter, feeds,
+                self.solver._key,
+            )
+        self.iter += n
+        return float(losses[-1])
+
+    # ------------------------------------------------------------------
     def _sp_context(self):
         """Trace-time sequence-parallel routing for jitted steps (no-op
         without a 'seq' mesh axis)."""

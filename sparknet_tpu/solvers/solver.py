@@ -347,7 +347,7 @@ class Solver:
 
     # ------------------------------------------------------------------
     def jitted_scan_steps(self, n: int, donate: bool = True,
-                          stacked_feeds: bool = False):
+                          stacked_feeds: bool = False, step_fn=None):
         """``n`` full solver iterations fused into ONE device program via
         ``lax.scan`` — the TPU-native training loop (SURVEY §3: everything
         under jit is traced once; host dispatch is not free, especially
@@ -364,9 +364,11 @@ class Solver:
         (the benchmark protocol's fixed in-memory batch).
         ``stacked_feeds=True``: each feed array carries a leading [n]
         axis and step ``i`` consumes slice ``i`` (real data: stage n
-        minibatches, dispatch once).
+        minibatches, dispatch once).  ``step_fn``: an already-built
+        per-step function to scan (ParallelTrainer reuses its own) —
+        default builds a fresh one.
         """
-        base_step = self._make_train_step(debug=False)
+        base_step = step_fn or self._make_train_step(debug=False)
 
         def multi(variables, slots, it0, feeds, key):
             def body(carry, x):

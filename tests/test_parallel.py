@@ -91,6 +91,54 @@ def test_sync_dp_matches_single_device():
     np.testing.assert_allclose(np.asarray(w_single), np.asarray(w_multi), atol=2e-5)
 
 
+def test_train_rounds_scan_matches_round_loop():
+    """train_rounds(n): n fused sync-SGD rounds == n train_round calls
+    (same data sequence) — the dispatch-batched tau=1 path."""
+    cfg = SolverConfig(base_lr=0.05, momentum=0.9)
+    imgs, labels = synth(4 * BATCH, seed=5)
+
+    def data_fn(it):
+        lo = (it * BATCH) % (3 * BATCH)
+        return feeds_of(imgs[lo:lo + BATCH], labels[lo:lo + BATCH])
+
+    s1 = Solver(cfg, small_net())
+    s2 = Solver(cfg, small_net())
+    # fresh buffers, not aliases: both trainers donate their state
+    copy = lambda t: jax.tree_util.tree_map(
+        lambda x: jnp.array(np.asarray(x)), t)
+    s2.variables = copy(s1.variables)
+    s2.slots = copy(s1.slots)
+
+    a = ParallelTrainer(s1, mesh=data_parallel_mesh(), tau=1)
+    b = ParallelTrainer(s2, mesh=data_parallel_mesh(), tau=1)
+    for _ in range(4):
+        loss_loop = a.train_round(data_fn)
+    loss_scan = b.train_rounds(4, data_fn)
+
+    assert a.iter == b.iter == 4
+    np.testing.assert_allclose(loss_scan, loss_loop, rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(b._averaged_variables().params["ip2"][0]),
+        np.asarray(a._averaged_variables().params["ip2"][0]),
+        atol=2e-5,
+    )
+
+
+def test_train_rounds_falls_back_for_tau():
+    """tau>1 already amortizes dispatch over tau local steps: the API
+    falls back to the per-round loop, same results."""
+    cfg = SolverConfig(base_lr=0.05, momentum=0.9)
+    solver = Solver(cfg, small_net(batch=BATCH // 8))
+    tr = ParallelTrainer(solver, mesh=data_parallel_mesh(), tau=2)
+    imgs, labels = synth(BATCH, seed=5)
+    stacked = {
+        k: np.stack([v, v])
+        for k, v in feeds_of(imgs, labels).items()
+    }
+    loss = tr.train_rounds(2, lambda it: stacked)
+    assert np.isfinite(loss) and tr.iter == 4  # 2 rounds x tau=2
+
+
 def test_sync_dp_converges():
     cfg = SolverConfig(base_lr=0.05, momentum=0.9)
     solver = Solver(cfg, small_net())

@@ -885,3 +885,17 @@ def test_bench_require_measured_partial_exits_nonzero(tmp_path):
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
     assert out2.returncode == 0, (out2.stdout + out2.stderr)[-1500:]
+
+
+def test_cli_train_distributed_scan(tmp_path, monkeypatch):
+    """tpunet train --distributed --scan N: tau=1 sync-SGD rounds fused
+    N per dispatch (ParallelTrainer.train_rounds) through the CLI."""
+    from sparknet_tpu.cli import main
+
+    monkeypatch.chdir(tmp_path)
+    assert main([
+        "train", "--solver", "zoo:lenet", "--batch", "4",
+        "--data", "synthetic", "--iterations", "4", "--distributed",
+        "--scan", "2", "--output", str(tmp_path / "out"),
+    ]) == 0
+    assert (tmp_path / "out.solverstate.npz").exists()
