@@ -1259,8 +1259,13 @@ def cmd_classify(args) -> int:
     channels = clf.feed_shapes[clf.inputs[0]][1]
     images = [load_image(p, color=channels != 1) for p in args.images]
     # single center pass by default like cpp_classification; --oversample
-    # needs --images-dim larger than the crop to cut distinct crops
-    probs = clf.predict(images, oversample=args.oversample)
+    # needs --images-dim larger than the crop to cut distinct crops;
+    # preprocessing runs ONCE (calibration and prediction share blobs)
+    blobs = clf.preprocess_images(images, args.oversample)
+    if getattr(args, "int8", False):
+        qstate = clf.calibrate_int8(blobs=blobs)
+        print(json.dumps({"int8": sorted(qstate)}))
+    probs = clf.predict_blobs(blobs, oversample=args.oversample)
     results = []
     for path, p in zip(args.images, probs):
         top = np.argsort(p)[::-1][: args.top]
@@ -1728,6 +1733,10 @@ def main(argv=None) -> int:
                     "(pycaffe classify.py --images_dim)")
     sp.add_argument("--center-only", action="store_true",
                     help="deprecated: single center pass is now the default")
+    sp.add_argument("--int8", action="store_true",
+                    help="post-training int8 inference (MXU int8 mode): "
+                    "self-calibrates activation scales on the input "
+                    "images, per-channel int8 weights")
     sp.add_argument("images", nargs="+")
     sp.set_defaults(fn=cmd_classify)
 

@@ -37,15 +37,10 @@ class Classifier(DeployNet):
         self.crop_dims = np.array(self.feed_shapes[in_][2:])
         self.image_dims = tuple(image_dims) if image_dims else tuple(self.crop_dims)
 
-    def predict(self, inputs, oversample: bool = True) -> np.ndarray:
-        """(N) iterable of (H, W, K) images -> (N, C) class probabilities.
-
-        Behavioral parity with classifier.py:47-99, restructured: resize
-        every image to ``image_dims``, crop (ten-crop when
-        ``oversample``, else the shared `fivecrop_origins` center crop),
-        preprocess, forward, and average each image's 10 crop
-        predictions when oversampling.
-        """
+    def preprocess_images(self, inputs, oversample: bool) -> np.ndarray:
+        """resize -> crop(s) -> transformer: the (M, C, h, w) net-ready
+        blob batch shared by predict and int8 calibration (callers doing
+        both should preprocess ONCE and pass blobs to each)."""
         resized = np.stack(
             [
                 cio.resize_image(np.asarray(im, np.float32), self.image_dims)
@@ -58,13 +53,54 @@ class Classifier(DeployNet):
             h, w = (int(d) for d in self.crop_dims)
             r, c = cio.fivecrop_origins(self.image_dims, (h, w))[-1]
             crops = resized[:, r : r + h, c : c + w]
-
         in_ = self.inputs[0]
-        blobs = np.stack(
+        return np.stack(
             [self.transformer.preprocess(in_, im) for im in crops]
         ).astype(np.float32)
-        probs = self.forward_all(in_, blobs)[self.outputs[0]]
-        probs = probs.reshape(len(crops), -1)
+
+    def calibrate_int8(self, images=None, oversample: bool = False, *,
+                       blobs=None):
+        """Self-calibrate the int8 deploy path on representative images
+        (run through the SAME preprocessing predict applies), then switch
+        this classifier's forward to it.  Returns the quant state.
+        Pass ``blobs`` from :meth:`preprocess_images` to skip
+        re-preprocessing.  Every sample contributes to the activation
+        scales: the ragged tail is padded by cycling (a dropped outlier
+        would silently shrink x_scale and clip at inference)."""
+        if blobs is None:
+            blobs = self.preprocess_images(images, oversample)
+        in_ = self.inputs[0]
+        batch = self.feed_shapes[in_][0]
+        reps = -(-batch // len(blobs)) if len(blobs) < batch else 1
+        padded = np.concatenate([blobs] * reps) if reps > 1 else blobs
+        tail = len(padded) % batch
+        if tail:
+            padded = np.concatenate([padded, padded[:batch - tail]])
+        chunks = [
+            {in_: padded[lo : lo + batch]}
+            for lo in range(0, len(padded), batch)
+        ]
+        return self.quantize_int8(chunks, num_batches=len(chunks))
+
+    def predict(self, inputs, oversample: bool = True) -> np.ndarray:
+        """(N) iterable of (H, W, K) images -> (N, C) class probabilities.
+
+        Behavioral parity with classifier.py:47-99, restructured: resize
+        every image to ``image_dims``, crop (ten-crop when
+        ``oversample``, else the shared `fivecrop_origins` center crop),
+        preprocess, forward, and average each image's 10 crop
+        predictions when oversampling.
+        """
+        return self.predict_blobs(
+            self.preprocess_images(inputs, oversample), oversample
+        )
+
+    def predict_blobs(self, blobs: np.ndarray,
+                      oversample: bool = True) -> np.ndarray:
+        """Forward already-preprocessed (M, C, h, w) blobs (one row per
+        crop) -> (N, C) probabilities."""
+        probs = self.forward_all(self.inputs[0], blobs)[self.outputs[0]]
+        probs = probs.reshape(len(blobs), -1)
         if oversample:
             probs = probs.reshape(-1, 10, probs.shape[-1]).mean(axis=1)
         return probs

@@ -84,6 +84,38 @@ class DeployNet:
             self.transformer.set_channel_swap(in_, channel_swap)
 
     # ------------------------------------------------------------------
+    def quantize_int8(self, calibration_batches, num_batches: int = 4):
+        """Switch this deploy net's forward to the post-training int8
+        path (``sparknet_tpu.quant``): per-channel int8 weights +
+        calibrated per-tensor int8 activations, int32 accumulation — the
+        MXU's int8 mode, the one place a v5e doubles its matmul peak.
+
+        ``calibration_batches``: iterable of feed dicts shaped like the
+        deploy forward's own (``{input_name: (B, C, H, W)}``).  Returns
+        the quant state; subsequent ``predict``/``forward_all`` calls run
+        quantized.  Inference-only — training paths never consult it."""
+        from sparknet_tpu import quant
+
+        self.qstate = quant.calibrate(
+            self.network, self.variables, calibration_batches,
+            num_batches=num_batches,
+        )
+        jitted = jax.jit(
+            lambda variables, feeds: self.network.apply(
+                variables, feeds, rng=None, train=False
+            )[0]
+        )
+        qstate = self.qstate
+
+        def fwd(variables, feeds):
+            # the int8 routing happens at TRACE time (first call per
+            # shape): keep the context live around the jitted call
+            with quant.quantized_inference(qstate):
+                return jitted(variables, feeds)
+
+        self._forward = fwd
+        return self.qstate
+
     def forward_all(self, in_: str, data: np.ndarray) -> dict[str, np.ndarray]:
         """Forward N preprocessed samples in net-batch chunks; concat outputs.
 

@@ -55,6 +55,18 @@ class InnerProduct(Layer):
         axis = _canon_axis(axis, x.ndim)
         lead = x.shape[:axis]
         flat = x.reshape((-1, int(np.prod(x.shape[axis:]))))
+        if not train:
+            # int8 deploy path (sparknet_tpu.quant) — see Convolution
+            from sparknet_tpu.quant import int8_matmul, layer_qparams
+
+            q = layer_qparams(self.name)
+            if q is not None:
+                y = int8_matmul(flat, q)
+                if bias:
+                    y = y + params[1].astype(y.dtype)
+                return LayerOutput(
+                    [y.astype(x.dtype).reshape(lead + (n_out,))]
+                )
         y = flat @ params[0].astype(x.dtype).T
         if bias:
             y = y + params[1].astype(x.dtype)

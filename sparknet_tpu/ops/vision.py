@@ -71,8 +71,27 @@ class Convolution(Layer):
     def apply(self, params, state, inputs, *, train, rng=None):
         c = self._conf()
         x = inputs[0]
-        w = params[0].astype(x.dtype)
         d = c["dilation"]
+        if not train:
+            # int8 deploy path (sparknet_tpu.quant): active only inside a
+            # quantized_inference() trace and only for calibrated layers
+            from sparknet_tpu.quant import int8_conv, layer_qparams
+
+            q = layer_qparams(self.name)
+            if q is not None:
+                y = int8_conv(
+                    x, q,
+                    stride=c["stride"],
+                    padding=[(c["pad"][0], c["pad"][0]),
+                             (c["pad"][1], c["pad"][1])],
+                    rhs_dilation=(d, d),
+                    dimension_numbers=_DIMNUMS,
+                    feature_group_count=c["group"],
+                )
+                if c["bias"]:
+                    y = y + params[1].astype(y.dtype)[None, :, None, None]
+                return LayerOutput([y.astype(x.dtype)])
+        w = params[0].astype(x.dtype)
         y = jax.lax.conv_general_dilated(
             x,
             w,

@@ -216,3 +216,46 @@ def test_cli_classify_images_dim_validation(tmp_path, rng):
     with pytest.raises(SystemExit, match="mutually exclusive"):
         main(["classify", "--model", str(model), "--center-only",
               "--oversample", str(img)])
+
+
+def test_classifier_int8_agrees_with_float(tmp_path, rng):
+    """calibrate_int8: the quantized deploy forward's top-1 agrees with
+    the float forward (tiny net, self-calibration on the input batch)."""
+    from sparknet_tpu.models.classifier import Classifier
+
+    model = tmp_path / "deploy.prototxt"
+    model.write_text(DEPLOY)
+    imgs = [rng.rand(8, 8, 3).astype(np.float32) for _ in range(4)]
+
+    f = Classifier(str(model))
+    float_probs = f.predict(imgs, oversample=False)
+
+    q = Classifier(str(model))
+    qstate = q.calibrate_int8(imgs)
+    assert set(qstate) == {"conv1", "ip1"}
+    q_probs = q.predict(imgs, oversample=False)
+    # different random init per Classifier? both init from jax.random.key(0)
+    # => identical weights; quantization is the only difference
+    np.testing.assert_array_equal(
+        np.argmax(float_probs, -1), np.argmax(q_probs, -1))
+    np.testing.assert_allclose(q_probs, float_probs, atol=0.05)
+
+
+def test_cli_classify_int8(tmp_path, rng, capsys):
+    import json
+
+    from PIL import Image
+
+    from sparknet_tpu.cli import main
+
+    model = tmp_path / "deploy.prototxt"
+    model.write_text(DEPLOY)
+    img = tmp_path / "im.png"
+    Image.fromarray((rng.rand(8, 8, 3) * 255).astype(np.uint8)).save(img)
+    assert main(["classify", "--model", str(model), "--int8",
+                 str(img)]) == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    meta = json.loads(lines[-2])
+    assert meta["int8"] == ["conv1", "ip1"]
+    out = json.loads(lines[-1])
+    assert out[0]["predictions"]

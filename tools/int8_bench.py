@@ -1,0 +1,107 @@
+"""Forward (deploy) throughput A/B: float vs post-training int8.
+
+The int8 MXU mode is where a v5e doubles its matmul peak (394 int8 TOPS
+vs 197 bf16 TFLOP/s — `sparknet_tpu.common.TPU_PEAK_FLOPS`); this
+measures what that buys the zoo's deploy forward at batch ``--batch``
+(classification is forward-only — ref: the cpp_classification example,
+caffe/examples/cpp_classification/classification.cpp).  Prints one JSON
+line per arm and banks both to ``--out``.
+
+Run (healthy window):  python tools/int8_bench.py [--model alexnet]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="alexnet")
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform (cpu for offline checks)")
+    ap.add_argument("--out", default="docs/int8_bench_last.json")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sparknet_tpu import models, quant
+    from sparknet_tpu.common import Phase, set_config
+    from sparknet_tpu.compiler.graph import Network
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    if on_accel:
+        set_config(compute_dtype=jnp.bfloat16)
+    crop = {"alexnet": 227, "caffenet": 227, "googlenet": 224}[args.model]
+    B = args.batch if on_accel else 8
+    iters = args.iters if on_accel else 2
+
+    net = Network(getattr(models, args.model)(B), Phase.TEST)
+    variables = net.init(jax.random.PRNGKey(0))
+    rs = np.random.RandomState(0)
+    feeds = jax.device_put({
+        "data": jnp.asarray(rs.randn(B, 3, crop, crop) * 50, jnp.float32),
+        "label": jnp.asarray(rs.randint(0, 1000, B), jnp.int32),
+    })
+
+    def fwd(v, f):
+        blobs, _, _ = net.apply(v, f, rng=None, train=False)
+        return blobs[net.output_blobs()[0]]
+
+    def measure(label, ctx):
+        import contextlib
+
+        def run(fn):
+            out = fn(variables, feeds)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(variables, feeds)
+            jax.block_until_ready(out)
+            return B * iters / (time.perf_counter() - t0)
+
+        with ctx or contextlib.nullcontext():
+            img_s = run(jax.jit(lambda v, f: fwd(v, f)))
+        rec = {"metric": f"{args.model}_deploy_forward_img_s", "arm": label,
+               "value": round(img_s, 1), "batch": B, "iters": iters,
+               "platform": jax.devices()[0].platform, "measured": True}
+        print(json.dumps(rec), flush=True)
+        return rec
+
+    results = [measure("float", None)]
+    qstate = quant.calibrate(net, variables, [feeds])
+    results.append(measure("int8", quant.quantized_inference(qstate)))
+
+    out_path = args.out
+    if not os.path.isabs(out_path):
+        out_path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            out_path)
+    try:
+        with open(out_path + ".tmp", "w") as f:
+            json.dump({"arms": results,
+                       "utc": time.strftime("%Y-%m-%d %H:%M:%SZ",
+                                            time.gmtime())}, f, indent=1)
+        os.replace(out_path + ".tmp", out_path)
+    except OSError as e:
+        print(f"int8_bench: could not write {out_path}: {e}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
