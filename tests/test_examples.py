@@ -23,6 +23,7 @@ SCRIPTS = {
     "04_distributed_training.py": 1100,
     "06_listfile_sources.py": 560,
     "08_db_backends.py": 560,
+    "09_int8_deploy.py": 560,
 }
 
 
