@@ -506,7 +506,13 @@ def main() -> int:
             return 4 if _require_measured() else 0
         platform = probe["platform"]
 
-    on_accel = platform != "cpu"
+    # Rehearsal hook: exercise the ACCELERATOR code path (scan default,
+    # warmup counts, device cost analysis, extras loop) on a CPU backend
+    # so a scarce healthy window never runs it for the first time.  Pair
+    # with SPARKNET_BENCH_RECORD_LAST=0 — CPU numbers must not bank.
+    on_accel = platform != "cpu" or (
+        os.environ.get("SPARKNET_BENCH_FORCE_ACCEL_PATH", "0") == "1"
+    )
     batch = _env_int("SPARKNET_BENCH_BATCH", 256 if on_accel else 16)
     iters = 20 if on_accel else 2
     warmup = 3 if on_accel else 1
