@@ -69,14 +69,15 @@ def _time_fn(fn, args, chain, iters=20, warmup=3):
     return (time.perf_counter() - t0) * 1e3 / iters
 
 
-def bench_lrn(records):
+def bench_lrn(records, dtype="float32"):
     import jax
     import jax.numpy as jnp
 
     from sparknet_tpu.ops import pallas_kernels as pk
 
-    x = jax.random.normal(jax.random.key(0), LRN_SHAPE, jnp.float32)
-    grads = jax.random.normal(jax.random.key(1), LRN_SHAPE, jnp.float32)
+    dt = jnp.bfloat16 if dtype == "bf16" else jnp.float32
+    x = jax.random.normal(jax.random.key(0), LRN_SHAPE, dt)
+    grads = jax.random.normal(jax.random.key(1), LRN_SHAPE, dt)
     results = {}
     for impl in ("xla", "pallas"):
         fwd = jax.jit(functools.partial(
@@ -95,19 +96,20 @@ def bench_lrn(records):
         except Exception as e:
             results[impl] = {"error": repr(e)[:300]}
         records.append({"op": "lrn", "impl": impl, "shape": list(LRN_SHAPE),
-                        **results[impl]})
+                        "dtype": dtype, **results[impl]})
     return results
 
 
-def bench_flash(records):
+def bench_flash(records, dtype="float32"):
     import jax
     import jax.numpy as jnp
 
     from sparknet_tpu.ops import pallas_kernels as pk
 
-    q, k, v = (jax.random.normal(jax.random.key(i), ATTN_SHAPE, jnp.float32)
+    dt = jnp.bfloat16 if dtype == "bf16" else jnp.float32
+    q, k, v = (jax.random.normal(jax.random.key(i), ATTN_SHAPE, dt)
                for i in range(3))
-    g = jax.random.normal(jax.random.key(3), ATTN_SHAPE, jnp.float32)
+    g = jax.random.normal(jax.random.key(3), ATTN_SHAPE, dt)
     results = {}
     for impl in ("xla", "pallas"):
         fwd = jax.jit(functools.partial(pk.flash_attention, causal=True,
@@ -155,6 +157,9 @@ def verdict(op, results):
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--op", choices=["lrn", "flash", "all"], default="all")
+    ap.add_argument("--dtype", choices=["float32", "bf16"], default="float32",
+                    help="arm dtype (the r3 shootout was f32; the training "
+                    "step runs bf16 — the promote decision should too)")
     ap.add_argument("--allow-cpu", action="store_true",
                     help="run on CPU/interpret anyway (numbers meaningless "
                     "for the promote decision; for plumbing checks only)")
@@ -193,9 +198,10 @@ def main() -> int:
     records: list[dict] = []
     verdicts = []
     if args.op in ("lrn", "all"):
-        verdicts.append(verdict("lrn", bench_lrn(records)))
+        verdicts.append(verdict("lrn", bench_lrn(records, args.dtype)))
     if args.op in ("flash", "all"):
-        verdicts.append(verdict("flash_attention", bench_flash(records)))
+        verdicts.append(verdict("flash_attention",
+                                bench_flash(records, args.dtype)))
     if not on_accel:
         # CPU numbers can't drive the promote decision (and pallas only
         # runs in interpret mode here) — mark every line
