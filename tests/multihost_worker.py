@@ -60,6 +60,13 @@ def main() -> None:
     loss = trainer.train(3, lambda it: batch(per_proc))
     assert np.isfinite(loss), loss
 
+    # Mode 1b: dispatch-batched sync DP (round-4 scan path) — two fused
+    # rounds in one program; per-process shards still assemble the
+    # global batch, and the cross-host digest below proves the replicas
+    # stayed identical through it.
+    loss_scan = trainer.train_rounds(2, lambda it: batch(per_proc))
+    assert np.isfinite(loss_scan), loss_scan
+
     # Mode 2: tau=2 local SGD + model averaging.
     tau = 2
     solver2 = Solver(models.cifar10_quick_solver(), models.cifar10_quick(2))
