@@ -259,3 +259,19 @@ def test_cli_classify_int8(tmp_path, rng, capsys):
     assert meta["int8"] == ["conv1", "ip1"]
     out = json.loads(lines[-1])
     assert out[0]["predictions"]
+
+
+def test_detector_inherits_int8(tmp_path, rng):
+    """quantize_int8 lives on DeployNet: the Detector gets the int8
+    deploy path for free (windowed R-CNN scoring, ref: pycaffe
+    detector.py)."""
+    from sparknet_tpu.models.detector import Detector
+
+    model = tmp_path / "deploy.prototxt"
+    model.write_text(DEPLOY)
+    det = Detector(str(model))
+    feeds = {"data": rng.rand(4, 3, 8, 8).astype(np.float32)}
+    qstate = det.quantize_int8([feeds])
+    assert set(qstate) == {"conv1", "ip1"}
+    out = det.forward_all("data", feeds["data"])
+    assert np.all(np.isfinite(out["prob"]))
