@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Materialize the synthetic CIFAR-shaped LMDB the on-chip drive legs eat.
+
+The end-to-end drive jobs in tools/tpu_queue_r4.json (train -> snapshot ->
+restore -> continue -> test, ref: caffe/src/caffe/solver.cpp:447-519 for the
+snapshot/restore protocol) stream ``db:/tmp/e2e_tpu/cifar_lmdb``.  /tmp does
+not survive the box, so this script recreates the fixture deterministically:
+CIFAR-10 geometry (3x32x32 uint8) Datum records in a Caffe-readable LMDB,
+labels drawn round-robin with class-dependent channel means so a short train
+leg has signal to descend on (the drive leg asserts loss goes down, not
+accuracy parity -- dataset bytes are not available in this environment, see
+docs/CONVERGENCE.md).
+
+Host-side only; forces the cpu platform so running it never dials the TPU
+relay (CLAUDE.md platform gotcha).
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="/tmp/e2e_tpu/cifar_lmdb")
+    ap.add_argument("--n", type=int, default=2000)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from sparknet_tpu.data.createdb import create_db
+
+    args.out = os.path.abspath(args.out)
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    rng = np.random.default_rng(args.seed)
+
+    def samples():
+        for i in range(args.n):
+            label = i % 10
+            # Class-dependent mean + noise: learnable but not trivial.
+            base = np.full((3, 32, 32), 64 + 12 * label, np.float32)
+            img = np.clip(base + rng.normal(0, 24, base.shape), 0, 255)
+            yield img.astype(np.uint8), label
+
+    n = create_db(args.out, samples(), backend="lmdb")
+    print(f"wrote {n} records to {args.out}")
+
+    # The cifar10_full net declares transform_param.mean_file
+    # 'examples/cifar10/mean.binaryproto' (resolved Caffe-style against the
+    # job cwd); materialize it under dirname(--out), which must therefore be
+    # the drive jobs' cwd (tpu_queue_r4.json sets both to /tmp/e2e_tpu).
+    from sparknet_tpu.data.createdb import db_mean
+    from sparknet_tpu.data.io_utils import save_mean_binaryproto
+
+    root = os.path.dirname(args.out)
+    mean_path = os.path.join(root, "examples", "cifar10", "mean.binaryproto")
+    os.makedirs(os.path.dirname(mean_path), exist_ok=True)
+    mean = db_mean(args.out, 64)
+    save_mean_binaryproto(mean_path, mean)
+    print(f"wrote mean {mean.shape} to {mean_path}")
+
+
+if __name__ == "__main__":
+    main()

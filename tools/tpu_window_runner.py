@@ -27,6 +27,11 @@ Usage:
 Queue file format (JSON):
     {"max_hours": 10,
      "evidence_dir": "docs/evidence_r4",   # journal + job logs live here
+     "setup": [{"name": "fixture", "argv": [...], "deadline_s": 300}],
+               # ^ host-side pre-steps: run once per runner START (before
+               # any dial, no TPU needed) to materialize on-disk
+               # preconditions of queued jobs (e.g. /tmp fixtures).
+               # Journaled with "setup": true; never dial-gated.
      "jobs": [{"name": "trace", "argv": ["python", "-m", ...],
                "env": {"K": "V"}, "deadline_s": 1200,
                "needs": "other_job_name"  # optional: skip unless that
@@ -137,8 +142,11 @@ def dial(probe_id: int) -> bool:
     return ok
 
 
-def run_job(job: dict, probe_id: int = 0) -> int | None:
-    """Run one job with a deadline.  Returns rc, or None on timeout."""
+def run_job(job: dict, probe_id: int = 0, setup: bool = False) -> int | None:
+    """Run one job with a deadline.  Returns rc, or None on timeout.
+
+    ``setup=True`` tags the journal events so evidence renderers can
+    separate host-side pre-steps from probe-window jobs."""
     name = job["name"]
     deadline = float(job.get("deadline_s", 1200))
     env = dict(os.environ)
@@ -154,7 +162,7 @@ def run_job(job: dict, probe_id: int = 0) -> int | None:
     os.makedirs(EVIDENCE_DIR, exist_ok=True)
     out_path = os.path.join(EVIDENCE_DIR, f"{name}.txt")
     log({"event": "job_start", "job": name, "argv": job["argv"],
-         "deadline_s": deadline})
+         "deadline_s": deadline, **({"setup": True} if setup else {})})
     t0 = time.time()
     # append mode: earlier attempts' output stays visible for forensics
     with open(out_path, "a") as out:
@@ -178,7 +186,7 @@ def run_job(job: dict, probe_id: int = 0) -> int | None:
             rc = None
     log({"event": "job_end", "job": name, "rc": rc,
          "dt_s": round(time.time() - t0, 1),
-         "timed_out": rc is None})
+         "timed_out": rc is None, **({"setup": True} if setup else {})})
     return rc
 
 
@@ -210,6 +218,20 @@ def main() -> int:
     stop_at = time.time() + float(spec.get("max_hours", 10)) * 3600
     log({"event": "runner_start", "queue": queue_path,
          "jobs": [j["name"] for j in spec["jobs"]]})
+
+    # Host-side setup jobs (top-level "setup" list): run once per runner
+    # start, BEFORE any dial — they need no TPU and exist so queued jobs'
+    # on-disk preconditions (e.g. the /tmp fixture DB the drive legs
+    # stream) survive a /tmp wipe without burning healthy-window minutes
+    # on a setup error.  One retry, then a loud journal event: queued
+    # jobs would fail fast against the missing precondition and burn
+    # max_attempts, so a persistent setup failure must be visible.
+    for j in spec.get("setup", []):
+        if run_job(j, setup=True) != 0 and run_job(j, setup=True) != 0:
+            log({"event": "setup_failed", "job": j["name"],
+                 "note": "precondition jobs may now fail fast in healthy "
+                         "windows and exhaust max_attempts; fix the setup "
+                         "script and restart the runner"})
 
     def next_pending(spec: dict, skip: set[str] = frozenset()):
         """(job, blocked): the next runnable job, plus the set of non-green

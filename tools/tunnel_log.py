@@ -60,7 +60,16 @@ def render(events: list[dict], round_no: int) -> str:
             d["dt"] = ev.get("dt_s")
             d["err"] = (ev.get("error") or "")[:90]
             n_ok += bool(ev.get("ok"))
+        elif kind == "dial_abandoned":
+            # post-hoc adjudication of a dial that never got a dial_end
+            # (e.g. the runner process died with its session); honest
+            # close-out so the probe doesn't render "in flight" forever
+            p = ev.get("probe", 0)
+            d = dials.setdefault(p, {"start": "?"})
+            d["abandoned"] = (ev.get("note") or "").replace("|", "/")[:400]
         elif kind == "job_end":
+            if ev.get("setup"):
+                continue  # host-side pre-step, not a probe-window job
             jobs.append(
                 f"probe-window job `{ev.get('job')}`: rc={ev.get('rc')} "
                 f"({ev.get('dt_s')} s{', TIMED OUT' if ev.get('timed_out') else ''})"
@@ -68,7 +77,10 @@ def render(events: list[dict], round_no: int) -> str:
     for p in sorted(k for k in dials if k):
         d = dials[p]
         if "ok" not in d:
-            outcome, note = "in flight", ""
+            if "abandoned" in d:
+                outcome, note = "abandoned", d["abandoned"]
+            else:
+                outcome, note = "in flight", ""
         elif d["ok"]:
             outcome, note = "**HEALTHY**", ""
         else:
