@@ -110,19 +110,84 @@ def _lrn_diff_bwd(size, alpha, beta, k, interpret, x, g):
 _lrn_diff.defvjp(_lrn_diff_fwd, _lrn_diff_bwd)
 
 
-def lrn_across_channels(x, size, alpha, beta, k, force: str | None = None):
-    """Cross-channel LRN; ``force`` = 'pallas' | 'interpret' | 'xla' | None.
+def _windowed_channel_sum(sq, size):
+    """Sum over a symmetric ``size`` window on axis 1 as static shifted
+    adds (size-1 adds of sliced views) — the formulation the pallas
+    kernel uses, expressed in HLO so XLA can fuse it with neighbors.
+    reduce_window puts the window on a non-minor axis of NCHW, which the
+    TPU tiler handles an order of magnitude below the bandwidth bound at
+    AlexNet's norm1 shape (measured: docs/pallas_shootout_r3.json)."""
+    pad = (size - 1) // 2
+    C = sq.shape[1]
+    acc = sq
+    for off in range(1, pad + 1):
+        zeros = jnp.zeros_like(sq[:, :off])
+        acc = acc + jnp.concatenate([sq[:, off:], zeros], axis=1)
+        acc = acc + jnp.concatenate([zeros, sq[:, : C - off]], axis=1)
+    return acc
 
-    None consults ``SPARKNET_LRN_IMPL`` (pallas|xla); the default is the
-    XLA formulation — flip the env var (or pass force='pallas') on TPU
-    after validating the kernel on the target generation.  Differentiable
-    on every path."""
+
+def _pow_neg(u, beta):
+    """u ** -beta without the exp/ln chain for the betas the zoo uses
+    (0.75 everywhere: AlexNet/CaffeNet/GoogLeNet LRN layers).  rsqrt and
+    sqrt are single fast VPU ops; jnp.power lowers to exp(-beta*log(u))."""
+    if beta == 0.75:
+        return jax.lax.rsqrt(u) * jax.lax.rsqrt(jnp.sqrt(u))
+    if beta == 0.5:
+        return jax.lax.rsqrt(u)
+    if beta == 1.0:
+        return 1.0 / u
+    return jnp.power(u, -beta)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def lrn_across_channels_fused(x, size, alpha, beta, k):
+    """LRN with shifted-add window sums, rsqrt-formulated power, and a
+    hand-derived VJP (ref: caffe/src/caffe/layers/lrn_layer.cpp:108
+    CrossChannelForward_cpu, :180 CrossChannelBackward_cpu — same math,
+    reformulated for the VPU instead of the per-pixel CUDA loops).
+
+    forward:  scale = k + alpha/size * wsum(x^2);  y = x * scale^-beta
+    backward: dx = g*scale^-beta - (2*alpha*beta/size) * x * wsum(g*y/scale)
+    (the window is symmetric, so the adjoint of wsum is wsum itself).
+    The VJP recomputes scale from the saved x instead of storing it: the
+    step is HBM-bound, so size-1 adds + a rsqrt chain are cheaper than a
+    297 MB residual round-trip at AlexNet's norm1 shape."""
+    scale = k + (alpha / size) * _windowed_channel_sum(x * x, size)
+    return x * _pow_neg(scale, beta)
+
+
+def _lrn_fused_fwd(x, size, alpha, beta, k):
+    return lrn_across_channels_fused(x, size, alpha, beta, k), x
+
+
+def _lrn_fused_bwd(size, alpha, beta, k, x, g):
+    scale = k + (alpha / size) * _windowed_channel_sum(x * x, size)
+    p = _pow_neg(scale, beta)  # scale^-beta
+    # y/scale = x * scale^(-beta-1); windowed sum is its own adjoint
+    w = _windowed_channel_sum(g * x * p / scale, size)
+    return (g * p - (2.0 * alpha * beta / size) * x * w,)
+
+
+lrn_across_channels_fused.defvjp(_lrn_fused_fwd, _lrn_fused_bwd)
+
+
+def lrn_across_channels(x, size, alpha, beta, k, force: str | None = None):
+    """Cross-channel LRN; ``force`` = 'fused' | 'pallas' | 'interpret' |
+    'xla' | None.
+
+    None consults ``SPARKNET_LRN_IMPL`` (fused|pallas|xla); the default
+    is the XLA formulation — flip the env var (or pass force=...) on TPU
+    after a shootout validates the challenger on the target generation
+    (tools/pallas_bench.py).  Differentiable on every path."""
     import os
 
     if size % 2 == 0:
         raise ValueError(f"LRN local_size must be odd, got {size}")
     if force is None:
         force = os.environ.get("SPARKNET_LRN_IMPL", "xla")
+    if force == "fused":
+        return lrn_across_channels_fused(x, size, alpha, beta, k)
     if force == "xla" or not _HAS_PALLAS:
         return lrn_across_channels_xla(x, size, alpha, beta, k)
     if force == "interpret":

@@ -139,3 +139,68 @@ class TestFlashAttention:
         out = ulysses_self_attention(mesh, q, k, v, causal=True)
         ref = reference_attention(q, k, v, causal=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
+
+class TestFusedLrn:
+    """The shifted-add + rsqrt + hand-VJP formulation must be numerically
+    interchangeable with the reduce_window/power one (value AND gradient —
+    the VJP is hand-derived, so the gradient check is the load-bearing
+    pin; ref discipline: caffe/src/caffe/test/test_lrn_layer.cpp)."""
+
+    @pytest.mark.parametrize("shape,size,alpha,beta,k", CASES)
+    def test_value_matches_xla(self, shape, size, alpha, beta, k):
+        from sparknet_tpu.ops.pallas_kernels import lrn_across_channels_fused
+
+        x = jnp.asarray(np.random.RandomState(7).randn(*shape) * 10, jnp.float32)
+        ref = lrn_across_channels_xla(x, size, alpha, beta, k)
+        out = lrn_across_channels_fused(x, size, alpha, beta, k)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("beta", [0.75, 0.5, 1.0, 0.6])
+    def test_grad_matches_autodiff_of_xla(self, beta):
+        from sparknet_tpu.ops.pallas_kernels import lrn_across_channels_fused
+
+        x = jnp.asarray(np.random.RandomState(8).randn(2, 7, 4, 5) * 5,
+                        jnp.float32)
+        # non-uniform cotangent so the windowed-sum adjoint is actually
+        # exercised (sum() would feed g=1 everywhere)
+        g_fused = jax.grad(lambda t: jnp.sum(
+            lrn_across_channels_fused(t, 5, 1e-4, beta, 2.0) ** 2))(x)
+        g_ref = jax.grad(lambda t: jnp.sum(
+            lrn_across_channels_xla(t, 5, 1e-4, beta, 2.0) ** 2))(x)
+        np.testing.assert_allclose(np.asarray(g_fused), np.asarray(g_ref),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_selector_routes_fused(self, monkeypatch):
+        monkeypatch.setenv("SPARKNET_LRN_IMPL", "fused")
+        x = jnp.asarray(np.random.RandomState(9).randn(1, 6, 3, 3) * 4,
+                        jnp.float32)
+        out = lrn_across_channels(x, 5, 1e-4, 0.75, 1.0)
+        ref = lrn_across_channels_xla(x, 5, 1e-4, 0.75, 1.0)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_numeric_gradient(self):
+        """Central-difference check of the hand VJP itself, independent of
+        the XLA formulation (both could share a bug through _pow_neg)."""
+        from sparknet_tpu.ops.pallas_kernels import lrn_across_channels_fused
+
+        rs = np.random.RandomState(10)
+        x = rs.randn(1, 5, 2, 3).astype(np.float32) * 3
+        co = rs.randn(1, 5, 2, 3).astype(np.float32)
+
+        def f(t):
+            return float(jnp.vdot(
+                lrn_across_channels_fused(jnp.asarray(t), 5, 1e-2, 0.75, 1.0),
+                jnp.asarray(co)))
+
+        g = jax.grad(lambda t: jnp.vdot(
+            lrn_across_channels_fused(t, 5, 1e-2, 0.75, 1.0),
+            jnp.asarray(co)))(jnp.asarray(x))
+        eps = 1e-2
+        for idx in [(0, 0, 0, 0), (0, 2, 1, 1), (0, 4, 0, 2)]:
+            xp = x.copy(); xp[idx] += eps
+            xm = x.copy(); xm[idx] -= eps
+            num = (f(xp) - f(xm)) / (2 * eps)
+            assert abs(num - float(g[idx])) < 5e-3 * max(1.0, abs(num)), (
+                idx, num, float(g[idx]))
