@@ -120,7 +120,7 @@ def _windowed_channel_sum(sq, size):
     pad = (size - 1) // 2
     C = sq.shape[1]
     acc = sq
-    for off in range(1, pad + 1):
+    for off in range(1, min(pad, C - 1) + 1):
         zeros = jnp.zeros_like(sq[:, :off])
         acc = acc + jnp.concatenate([sq[:, off:], zeros], axis=1)
         acc = acc + jnp.concatenate([zeros, sq[:, : C - off]], axis=1)

@@ -117,3 +117,50 @@ def test_layer_time_table_cpu_fallback():
     assert table["rows"] == [] or all(
         isinstance(n, str) for n, _ in table["rows"]
     )
+
+
+def test_trace_report_renders_rows(tmp_path):
+    """tools/trace_report.py renders full and partial artifacts (partial =
+    the wedge-mid-trace case the staged banking exists for)."""
+    import json
+    import subprocess
+    import sys
+
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "trace_report.py")
+    art = {
+        "stage": "final", "argv_solver": "zoo:alexnet", "batch": 256,
+        "dtype": "bf16", "utc": "t", "device_kind": "v5e",
+        "wall_ms_per_step": 20.0, "img_per_sec": 12800.0,
+        "gflop_per_step": 986.0, "hbm_gb_per_step": 12.3,
+        "mfu": 0.25, "mfu_vs_peak": "v5e_bf16",
+        "rows": [["conv1", 2000.0], ["norm1", 5000.0], ["(other)", 1000.0]],
+        "rows_fwd_bwd": {"conv1": [800.0, 1200.0], "norm1": [2000.0, 3000.0]},
+        "device_us_per_step": 8000.0, "attributed_frac": 0.875,
+    }
+    # the live table_from_trace payload serializes triples
+    # [name, fwd, bwd] (see test_table_from_trace_fwd_bwd_rows); the
+    # report also accepts the dict / (name, (f, b)) shapes
+    triples = [[k, f, b] for k, (f, b) in art["rows_fwd_bwd"].items()]
+    for fb in (art["rows_fwd_bwd"], triples,
+               [[k, [f, b]] for k, f, b in triples]):
+        art["rows_fwd_bwd"] = fb
+        p = tmp_path / "a.json"
+        p.write_text(json.dumps(art))
+        out = subprocess.run(
+            [sys.executable, tool, str(p)],
+            capture_output=True, text=True, check=True).stdout
+        assert "| norm1 | 2.000 | 3.000 | 5.000 | 62.5% |" in out
+        assert "TOTAL (device)" in out and "87.5%" in out
+
+    partial = {"stage": "wall_untraced", "argv_solver": "zoo:alexnet",
+               "batch": 256, "dtype": "bf16",
+               "wall_ms_per_step_untraced": 20.5,
+               "img_per_sec_untraced": 12500.0,
+               "gflop_per_step": 986.0, "hbm_gb_per_step": 12.3}
+    p = tmp_path / "b.json"
+    p.write_text(json.dumps(partial))
+    out = subprocess.run(
+        [sys.executable, tool, str(p)],
+        capture_output=True, text=True, check=True).stdout
+    assert "No per-layer rows banked" in out and "20.500 ms" in out

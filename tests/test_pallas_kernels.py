@@ -204,3 +204,22 @@ class TestFusedLrn:
             num = (f(xp) - f(xm)) / (2 * eps)
             assert abs(num - float(g[idx])) < 5e-3 * max(1.0, abs(num)), (
                 idx, num, float(g[idx]))
+
+    def test_window_wider_than_channels(self):
+        """size=7 window on a 2-channel blob: shifts past the channel
+        count contribute nothing; must match the reduce_window path
+        instead of crashing (review finding, round 4)."""
+        from sparknet_tpu.ops.pallas_kernels import lrn_across_channels_fused
+
+        x = jnp.asarray(np.random.RandomState(11).randn(1, 2, 3, 3) * 5,
+                        jnp.float32)
+        ref = lrn_across_channels_xla(x, 7, 1e-2, 0.75, 1.0)
+        out = lrn_across_channels_fused(x, 7, 1e-2, 0.75, 1.0)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+        g_f = jax.grad(lambda t: jnp.sum(
+            lrn_across_channels_fused(t, 7, 1e-2, 0.75, 1.0) ** 2))(x)
+        g_r = jax.grad(lambda t: jnp.sum(
+            lrn_across_channels_xla(t, 7, 1e-2, 0.75, 1.0) ** 2))(x)
+        np.testing.assert_allclose(np.asarray(g_f), np.asarray(g_r),
+                                   rtol=1e-4, atol=1e-4)

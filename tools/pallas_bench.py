@@ -151,14 +151,18 @@ def verdict(op, results):
         else:
             errors[impl] = r.get("error")
     best = min(totals, key=totals.get)
-    challenger = min(
-        (t for i, t in totals.items() if i != "xla"), default=float("nan"))
-    if best != "xla" and totals[best] < 0.95 * totals["xla"]:
+    challengers = {i: t for i, t in totals.items() if i != "xla"}
+    if not challengers:
+        # every alternative errored: that is NOT a measured tie — keep the
+        # round-2 fix-or-delete signal loud in the headline line
+        v = (f"every challenger failed on chip ({', '.join(errors)}) — "
+             "keep XLA default, fix or delete the kernels")
+    elif best != "xla" and totals[best] < 0.95 * totals["xla"]:
         v = (f"PROMOTE {best} ({totals[best]:.2f} ms vs "
              f"{totals['xla']:.2f} ms XLA fwd+bwd)")
     else:
         v = (f"keep XLA default ({totals['xla']:.2f} ms; best challenger "
-             f"{challenger:.2f} ms)")
+             f"{min(challengers.values()):.2f} ms)")
     out = {"op": op, "verdict": v, "totals_ms": totals}
     if errors:
         out["errors"] = errors
