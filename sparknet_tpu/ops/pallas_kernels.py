@@ -43,8 +43,10 @@ def _lrn_kernel(size: int, alpha: float, beta: float, k: float, x_ref, o_ref):
     C = x.shape[0]
     pad = (size - 1) // 2
     acc = sq
-    # static shifted adds over the channel axis (size is tiny: 3/5)
-    for off in range(1, pad + 1):
+    # static shifted adds over the channel axis (size is tiny: 3/5);
+    # shifts past the channel count have zero window overlap — skip them
+    # (same clamp as _windowed_channel_sum)
+    for off in range(1, min(pad, C - 1) + 1):
         zeros = jnp.zeros((off, x.shape[1]), x.dtype)
         acc = acc + jnp.concatenate([sq[off:], zeros], axis=0)  # c+off
         acc = acc + jnp.concatenate([zeros, sq[: C - off]], axis=0)  # c-off

@@ -223,3 +223,14 @@ class TestFusedLrn:
             lrn_across_channels_xla(t, 7, 1e-2, 0.75, 1.0) ** 2))(x)
         np.testing.assert_allclose(np.asarray(g_f), np.asarray(g_r),
                                    rtol=1e-4, atol=1e-4)
+
+
+def test_pallas_interpret_window_wider_than_channels():
+    """The pallas kernel shares the shift clamp: size=7 on 2 channels in
+    interpret mode must match reduce_window, not crash."""
+    x = jnp.asarray(np.random.RandomState(12).randn(1, 2, 3, 3) * 5,
+                    jnp.float32)
+    ref = lrn_across_channels_xla(x, 7, 1e-2, 0.75, 1.0)
+    out = lrn_across_channels(x, 7, 1e-2, 0.75, 1.0, force="interpret")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)

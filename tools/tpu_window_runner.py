@@ -159,6 +159,14 @@ def run_job(job: dict, probe_id: int = 0, setup: bool = False) -> int | None:
     # jobs may run from another cwd (e.g. to resolve a prototxt's
     # relative mean_file Caffe-style); the framework must stay importable
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # Persistent XLA compilation cache, shared across jobs and windows:
+    # compiles over the tunnel are minutes-scale, and most queue jobs
+    # re-lower the same programs (bench A/Bs, drive-leg retries).  jax
+    # treats cache failures as warnings, so an axon-incompatible cache
+    # degrades to the status quo instead of failing the job.
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(REPO, ".jax_cache"))
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
     os.makedirs(EVIDENCE_DIR, exist_ok=True)
     out_path = os.path.join(EVIDENCE_DIR, f"{name}.txt")
     log({"event": "job_start", "job": name, "argv": job["argv"],
