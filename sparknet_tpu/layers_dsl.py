@@ -65,8 +65,10 @@ def ConvolutionLayer(
     group: int = 1,
     weight_filler: Message | None = None,
     bias_filler: Message | None = None,
+    bias_term: bool = True,
 ) -> Message:
-    """ref: Layers.scala:42-63."""
+    """ref: Layers.scala:42-63.  ``bias_term=False`` for convs whose bias
+    a following BatchNorm/Scale pair absorbs (ResNet-style)."""
     m = _layer(name, "Convolution", bottoms)
     p = Message()
     p.set("num_output", num_output)
@@ -76,7 +78,10 @@ def ConvolutionLayer(
     if group != 1:
         p.set("group", group)
     p.set("weight_filler", weight_filler or _filler("xavier"))
-    p.set("bias_filler", bias_filler or _filler("constant", value=0.0))
+    if bias_term:
+        p.set("bias_filler", bias_filler or _filler("constant", value=0.0))
+    else:
+        p.set("bias_term", False)
     m.set("convolution_param", p)
     return m
 
@@ -93,15 +98,20 @@ def PoolingLayer(
     kernel: tuple[int, int] = (2, 2),
     stride: tuple[int, int] = (2, 2),
     pad: tuple[int, int] = (0, 0),
+    global_pooling: bool = False,
 ) -> Message:
-    """ref: Layers.scala:65-86."""
+    """ref: Layers.scala:65-86.  ``global_pooling`` collapses the spatial
+    dims regardless of kernel (pooling_layer.cpp's global_pooling)."""
     m = _layer(name, "Pooling", bottoms)
     p = Message()
     p.set("pool", pooling)
-    p.set("kernel_h", kernel[0]).set("kernel_w", kernel[1])
-    p.set("stride_h", stride[0]).set("stride_w", stride[1])
-    if pad != (0, 0):
-        p.set("pad_h", pad[0]).set("pad_w", pad[1])
+    if global_pooling:
+        p.set("global_pooling", True)
+    else:
+        p.set("kernel_h", kernel[0]).set("kernel_w", kernel[1])
+        p.set("stride_h", stride[0]).set("stride_w", stride[1])
+        if pad != (0, 0):
+            p.set("pad_h", pad[0]).set("pad_w", pad[1])
     m.set("pooling_param", p)
     return m
 
@@ -195,6 +205,41 @@ def SigmoidCrossEntropyLossLayer(
     top: str | None = None,
 ) -> Message:
     return _loss_layer(name, "SigmoidCrossEntropyLoss", bottoms, loss_weight, top)
+
+
+def BatchNormLayer(
+    name: str,
+    bottoms: Sequence[str],
+    in_place: bool = True,
+    eps: float = 1e-5,
+    moving_average_fraction: float = 0.999,
+) -> Message:
+    """ref: batch_norm_layer.cpp — normalization only; pair with a Scale
+    layer for the learnable affine (the 2015-Caffe convention the ResNet
+    prototxts use)."""
+    m = _layer(name, "BatchNorm", bottoms,
+               [bottoms[0]] if in_place else None)
+    p = Message()
+    if eps != 1e-5:
+        p.set("eps", eps)
+    if moving_average_fraction != 0.999:
+        p.set("moving_average_fraction", moving_average_fraction)
+    m.set("batch_norm_param", p)
+    return m
+
+
+def ScaleLayer(
+    name: str,
+    bottoms: Sequence[str],
+    in_place: bool = True,
+    bias_term: bool = True,
+) -> Message:
+    """ref: scale_layer.cpp — channel-wise gamma (+ beta with bias_term),
+    the learnable half of the BatchNorm/Scale pair."""
+    m = _layer(name, "Scale", bottoms, [bottoms[0]] if in_place else None)
+    if bias_term:
+        m.set("scale_param", Message().set("bias_term", True))
+    return m
 
 
 def EltwiseLayer(

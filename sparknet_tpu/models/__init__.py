@@ -25,6 +25,8 @@ from sparknet_tpu.models.zoo import (  # noqa: F401
     mnist_autoencoder_solver,
     mnist_siamese,
     mnist_siamese_solver,
+    resnet50,
+    resnet50_solver,
     transformer,
     transformer_solver,
 )

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 from sparknet_tpu.layers_dsl import (
     AccuracyLayer,
+    BatchNormLayer,
     ConcatLayer,
     ConvolutionLayer,
     DropoutLayer,
@@ -34,6 +35,7 @@ from sparknet_tpu.layers_dsl import (
     PoolingLayer,
     RDDLayer,
     ReLULayer,
+    ScaleLayer,
     SigmoidCrossEntropyLossLayer,
     SigmoidLayer,
     SoftmaxWithLoss,
@@ -49,6 +51,10 @@ def _gauss(std: float) -> Message:
 
 def _const(v: float) -> Message:
     return _filler("constant", value=v)
+
+
+def _msra() -> Message:
+    return _filler("msra")
 
 
 # ---------------------------------------------------------------------------
@@ -409,6 +415,108 @@ def googlenet_solver() -> SolverConfig:
 # share weights via `param { name: ... }`; a ContrastiveLoss pulls same-
 # class embeddings together and pushes different-class pairs apart.
 # ---------------------------------------------------------------------------
+# ---------------------------------------------------------------------------
+# ResNet-50 — the first post-reference zoo family.  The reference predates
+# residual nets; this follows the published Caffe ResNet-50 deploy wiring
+# (He et al. 2016: conv bias_term false + BatchNorm/Scale pairs, bottleneck
+# branches named res{stage}{blk}_branch{1,2a,2b,2c}, v1 downsampling via
+# stride-2 on branch1 and branch2a).  TPU-first rationale: all-MXU
+# (no LRN, 3x3/1x1 convs), so unlike the bytes-bound AlexNet family its
+# roofline is the compute term — the MFU-exercising zoo member.
+# ---------------------------------------------------------------------------
+def _bn_scale(prefix: str, bottom: str) -> list[Message]:
+    """BatchNorm (stats only) + Scale (gamma/beta), Caffe-ResNet naming."""
+    return [
+        BatchNormLayer(f"bn{prefix}", [bottom]),
+        ScaleLayer(f"scale{prefix}", [bottom]),
+    ]
+
+
+def _bottleneck(stage: int, blk: str, bottom: str, width: int,
+                stride: int, project: bool) -> tuple[list[Message], str]:
+    """res{stage}{blk}: 1x1(width,s) -> 3x3(width) -> 1x1(4*width) with
+    identity or stride-s projection shortcut; sum then ReLU."""
+    w = _msra
+    n = f"{stage}{blk}"
+    layers: list[Message] = []
+    shortcut = bottom
+    if project:
+        layers += [
+            ConvolutionLayer(f"res{n}_branch1", [bottom], kernel=(1, 1),
+                             num_output=4 * width, stride=(stride, stride),
+                             weight_filler=w(), bias_term=False),
+            *_bn_scale(f"{n}_branch1", f"res{n}_branch1"),
+        ]
+        shortcut = f"res{n}_branch1"
+    layers += [
+        ConvolutionLayer(f"res{n}_branch2a", [bottom], kernel=(1, 1),
+                         num_output=width, stride=(stride, stride),
+                         weight_filler=w(), bias_term=False),
+        *_bn_scale(f"{n}_branch2a", f"res{n}_branch2a"),
+        ReLULayer(f"res{n}_branch2a_relu", [f"res{n}_branch2a"],
+                  in_place=True),
+        ConvolutionLayer(f"res{n}_branch2b", [f"res{n}_branch2a"],
+                         kernel=(3, 3), num_output=width, pad=(1, 1),
+                         weight_filler=w(), bias_term=False),
+        *_bn_scale(f"{n}_branch2b", f"res{n}_branch2b"),
+        ReLULayer(f"res{n}_branch2b_relu", [f"res{n}_branch2b"],
+                  in_place=True),
+        ConvolutionLayer(f"res{n}_branch2c", [f"res{n}_branch2b"],
+                         kernel=(1, 1), num_output=4 * width,
+                         weight_filler=w(), bias_term=False),
+        *_bn_scale(f"{n}_branch2c", f"res{n}_branch2c"),
+        EltwiseLayer(f"res{n}", [shortcut, f"res{n}_branch2c"]),
+        ReLULayer(f"res{n}_relu", [f"res{n}"], in_place=True),
+    ]
+    return layers, f"res{n}"
+
+
+def resnet50(batch: int = 32, num_classes: int = 1000,
+             crop: int = 224) -> Message:
+    w = _msra
+    layers: list[Message] = [
+        RDDLayer("data", shape=[batch, 3, crop, crop]),
+        RDDLayer("label", shape=[batch]),
+        ConvolutionLayer("conv1", ["data"], kernel=(7, 7), num_output=64,
+                         stride=(2, 2), pad=(3, 3), weight_filler=w(),
+                         bias_term=False),
+        *_bn_scale("_conv1", "conv1"),
+        ReLULayer("conv1_relu", ["conv1"], in_place=True),
+        PoolingLayer("pool1", ["conv1"], Pooling.Max, kernel=(3, 3),
+                     stride=(2, 2)),
+    ]
+    bottom = "pool1"
+    stages = [(2, 64, 3), (3, 128, 4), (4, 256, 6), (5, 512, 3)]
+    for stage, width, blocks in stages:
+        for i in range(blocks):
+            blk = "abcdef"[i]
+            stride = 2 if (i == 0 and stage > 2) else 1
+            ls, bottom = _bottleneck(stage, blk, bottom, width,
+                                     stride, project=(i == 0))
+            layers += ls
+    layers += [
+        PoolingLayer("pool5", [bottom], Pooling.Ave, global_pooling=True),
+        InnerProductLayer("fc1000", ["pool5"], num_output=num_classes,
+                          weight_filler=w(), bias_filler=_const(0.0)),
+        SoftmaxWithLoss("loss", ["fc1000", "label"]),
+        AccuracyLayer("accuracy", ["fc1000", "label"], phase="TEST"),
+        AccuracyLayer("accuracy_top5", ["fc1000", "label"], top_k=5,
+                      phase="TEST"),
+    ]
+    return NetParam("ResNet-50", *layers)
+
+
+def resnet50_solver() -> SolverConfig:
+    """The published recipe: SGD 0.9, base_lr 0.1, weight decay 1e-4,
+    /10 steps (He et al.; epoch boundaries depend on dataset scale)."""
+    return SolverConfig(
+        base_lr=0.1, lr_policy="multistep", momentum=0.9,
+        weight_decay=1e-4, gamma=0.1, stepvalue=(150000, 300000),
+        max_iter=450000, solver_type="SGD", display=20,
+        snapshot_prefix="resnet50",
+    )
+
+
 def _shared(m: Message, *names: str) -> Message:
     """Attach named param{} messages for cross-layer weight sharing.
     lr_mults follow the reference siamese file: weights 1, biases 2."""

@@ -106,14 +106,22 @@ class BatchNorm(Layer):
             new_state = state
         else:
             mean = jnp.mean(x, axis=axes)
-            var = jnp.mean(jnp.square(x), axis=axes) - jnp.square(mean)  # biased, E[x^2]-E[x]^2 as Caffe
+            # biased, E[x^2]-E[x]^2 as Caffe — clamped: the cancellation
+            # can dip (beyond eps) below zero in f32 on large unnormalized
+            # activations, and sqrt(var+eps) then NaNs the whole net
+            var = jnp.maximum(
+                jnp.mean(jnp.square(x), axis=axes) - jnp.square(mean), 0.0)
             new_state = {
                 "mean": state["mean"] * frac + mean.astype(state["mean"].dtype),
                 "variance": state["variance"] * frac + var.astype(state["variance"].dtype),
                 "scale_factor": state["scale_factor"] * frac + 1.0,
             }
         shape = (1, -1) + (1,) * (x.ndim - 2)
-        y = (x - mean.astype(x.dtype).reshape(shape)) / jnp.sqrt(var.astype(x.dtype).reshape(shape) + eps)
+        # same clamp on the use site: global stats restored from a
+        # checkpoint may carry the unclamped accumulation
+        denom = jnp.sqrt(
+            jnp.maximum(var.astype(x.dtype).reshape(shape), 0.0) + eps)
+        y = (x - mean.astype(x.dtype).reshape(shape)) / denom
         return LayerOutput([y], new_state)
 
 
