@@ -214,9 +214,9 @@ def BatchNormLayer(
     eps: float = 1e-5,
     moving_average_fraction: float = 0.999,
 ) -> Message:
-    """ref: batch_norm_layer.cpp — normalization only; pair with a Scale
-    layer for the learnable affine (the 2015-Caffe convention the ResNet
-    prototxts use)."""
+    """ref: batch_norm_layer.cpp:10 LayerSetUp, :75 Forward_cpu —
+    normalization only; pair with a Scale layer for the learnable affine
+    (the convention the published ResNet prototxts use)."""
     m = _layer(name, "BatchNorm", bottoms,
                [bottoms[0]] if in_place else None)
     p = Message()
@@ -234,8 +234,10 @@ def ScaleLayer(
     in_place: bool = True,
     bias_term: bool = True,
 ) -> Message:
-    """ref: scale_layer.cpp — channel-wise gamma (+ beta with bias_term),
-    the learnable half of the BatchNorm/Scale pair."""
+    """Channel-wise gamma (+ beta with bias_term), the learnable half of
+    the BatchNorm/Scale pair.  No reference counterpart: the SparkNet-era
+    Caffe predates ScaleLayer (post-reference BVLC addition); semantics
+    follow ops/blocks.py:Scale, which the zoo ResNet wiring requires."""
     m = _layer(name, "Scale", bottoms, [bottoms[0]] if in_place else None)
     if bias_term:
         m.set("scale_param", Message().set("bias_term", True))
