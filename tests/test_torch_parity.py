@@ -377,6 +377,47 @@ class TestBatchNorm:
             np.asarray(out.outputs[0]), theirs, atol=1e-4, rtol=1e-4
         )
 
+    def test_train_mode_input_gradient_matches_torch(self, rng):
+        """Backward through batch statistics (the ResNet training path);
+        large unnormalized activations exercise the variance clamp
+        without changing the gradient where var > 0."""
+        x = rng.randn(2, 4, 3, 3).astype(np.float32) * 40
+        co = rng.randn(2, 4, 3, 3).astype(np.float32)
+        layer = make_layer(
+            """layer { name: "bn" type: "BatchNorm" bottom: "x" top: "y"
+              batch_norm_param { eps: 1e-5 } }"""
+        )
+        _, state = layer.init(jax.random.key(0), [x.shape])
+
+        def f(xx):
+            out = layer.apply([], state, [xx], train=True,
+                              rng=jax.random.key(0))
+            return jnp.vdot(out.outputs[0], jnp.asarray(co))
+
+        ours = jax.grad(f)(jnp.asarray(x))
+        xt = t(x).requires_grad_()
+        yt = F.batch_norm(xt, None, None, training=True, eps=1e-5)
+        yt.backward(t(co))
+        np.testing.assert_allclose(np.asarray(ours), xt.grad.numpy(),
+                                   atol=1e-5, rtol=1e-4)
+
+    def test_bf16_inputs_use_f32_statistics(self, rng):
+        """Mixed-precision contract: E[x^2]-E[x]^2 in bf16 is catastrophic
+        on mean-shifted activations (std came out 293x); stats must run
+        in f32 with only the output cast back."""
+        x = (rng.randn(4, 8, 6, 6) + 100).astype(np.float32)
+        layer = make_layer(
+            """layer { name: "bn" type: "BatchNorm" bottom: "x" top: "y"
+              batch_norm_param { eps: 1e-5 } }"""
+        )
+        _, state = layer.init(jax.random.key(0), [x.shape])
+        out = layer.apply([], state, [jnp.asarray(x, jnp.bfloat16)],
+                          train=True, rng=jax.random.key(0))
+        y = np.asarray(out.outputs[0], np.float32)
+        assert out.outputs[0].dtype == jnp.bfloat16
+        assert abs(float(y.std()) - 1.0) < 0.05, y.std()
+        assert abs(float(y.mean())) < 0.05, y.mean()
+
 
 class TestPReLU:
     @pytest.mark.parametrize("shared", [False, True])
