@@ -424,16 +424,19 @@ def googlenet_solver() -> SolverConfig:
 # (no LRN, 3x3/1x1 convs), so unlike the bytes-bound AlexNet family its
 # roofline is the compute term — the MFU-exercising zoo member.
 # ---------------------------------------------------------------------------
-def _bn_scale(prefix: str, bottom: str) -> list[Message]:
+def _bn_scale(prefix: str, bottom: str,
+              frac: float = 0.999) -> list[Message]:
     """BatchNorm (stats only) + Scale (gamma/beta), Caffe-ResNet naming."""
     return [
-        BatchNormLayer(f"bn{prefix}", [bottom]),
+        BatchNormLayer(f"bn{prefix}", [bottom],
+                       moving_average_fraction=frac),
         ScaleLayer(f"scale{prefix}", [bottom]),
     ]
 
 
 def _bottleneck(stage: int, blk: str, bottom: str, width: int,
-                stride: int, project: bool) -> tuple[list[Message], str]:
+                stride: int, project: bool,
+                bn_fraction: float = 0.999) -> tuple[list[Message], str]:
     """res{stage}{blk}: 1x1(width,s) -> 3x3(width) -> 1x1(4*width) with
     identity or stride-s projection shortcut; sum then ReLU."""
     w = _msra
@@ -445,26 +448,26 @@ def _bottleneck(stage: int, blk: str, bottom: str, width: int,
             ConvolutionLayer(f"res{n}_branch1", [bottom], kernel=(1, 1),
                              num_output=4 * width, stride=(stride, stride),
                              weight_filler=w(), bias_term=False),
-            *_bn_scale(f"{n}_branch1", f"res{n}_branch1"),
+            *_bn_scale(f"{n}_branch1", f"res{n}_branch1", bn_fraction),
         ]
         shortcut = f"res{n}_branch1"
     layers += [
         ConvolutionLayer(f"res{n}_branch2a", [bottom], kernel=(1, 1),
                          num_output=width, stride=(stride, stride),
                          weight_filler=w(), bias_term=False),
-        *_bn_scale(f"{n}_branch2a", f"res{n}_branch2a"),
+        *_bn_scale(f"{n}_branch2a", f"res{n}_branch2a", bn_fraction),
         ReLULayer(f"res{n}_branch2a_relu", [f"res{n}_branch2a"],
                   in_place=True),
         ConvolutionLayer(f"res{n}_branch2b", [f"res{n}_branch2a"],
                          kernel=(3, 3), num_output=width, pad=(1, 1),
                          weight_filler=w(), bias_term=False),
-        *_bn_scale(f"{n}_branch2b", f"res{n}_branch2b"),
+        *_bn_scale(f"{n}_branch2b", f"res{n}_branch2b", bn_fraction),
         ReLULayer(f"res{n}_branch2b_relu", [f"res{n}_branch2b"],
                   in_place=True),
         ConvolutionLayer(f"res{n}_branch2c", [f"res{n}_branch2b"],
                          kernel=(1, 1), num_output=4 * width,
                          weight_filler=w(), bias_term=False),
-        *_bn_scale(f"{n}_branch2c", f"res{n}_branch2c"),
+        *_bn_scale(f"{n}_branch2c", f"res{n}_branch2c", bn_fraction),
         EltwiseLayer(f"res{n}", [shortcut, f"res{n}_branch2c"]),
         ReLULayer(f"res{n}_relu", [f"res{n}"], in_place=True),
     ]
@@ -472,7 +475,10 @@ def _bottleneck(stage: int, blk: str, bottom: str, width: int,
 
 
 def resnet50(batch: int = 32, num_classes: int = 1000,
-             crop: int = 224) -> Message:
+             crop: int = 224, bn_fraction: float = 0.999) -> Message:
+    """``bn_fraction``: BatchNorm moving-average fraction — the recipe
+    0.999 assumes thousands of iterations; short schedules (fine-tunes,
+    convergence demos) want 0.9-0.95 so eval stats track training."""
     w = _msra
     layers: list[Message] = [
         RDDLayer("data", shape=[batch, 3, crop, crop]),
@@ -480,7 +486,7 @@ def resnet50(batch: int = 32, num_classes: int = 1000,
         ConvolutionLayer("conv1", ["data"], kernel=(7, 7), num_output=64,
                          stride=(2, 2), pad=(3, 3), weight_filler=w(),
                          bias_term=False),
-        *_bn_scale("_conv1", "conv1"),
+        *_bn_scale("_conv1", "conv1", bn_fraction),
         ReLULayer("conv1_relu", ["conv1"], in_place=True),
         PoolingLayer("pool1", ["conv1"], Pooling.Max, kernel=(3, 3),
                      stride=(2, 2)),
@@ -492,7 +498,8 @@ def resnet50(batch: int = 32, num_classes: int = 1000,
             blk = "abcdef"[i]
             stride = 2 if (i == 0 and stage > 2) else 1
             ls, bottom = _bottleneck(stage, blk, bottom, width,
-                                     stride, project=(i == 0))
+                                     stride, project=(i == 0),
+                                     bn_fraction=bn_fraction)
             layers += ls
     layers += [
         PoolingLayer("pool5", [bottom], Pooling.Ave, global_pooling=True),

@@ -24,6 +24,9 @@ SCRIPTS = {
     "06_listfile_sources.py": 560,
     "08_db_backends.py": 560,
     "09_int8_deploy.py": 560,
+    # full run is the convergence evidence (~20 min); CI smoke-checks
+    # the plumbing only
+    "10_resnet50_digits.py": (560, ["--smoke"]),
 }
 
 
@@ -31,9 +34,11 @@ SCRIPTS = {
 def test_example_runs(script):
     env = dict(os.environ)
     env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    spec = SCRIPTS[script]
+    timeout, extra = spec if isinstance(spec, tuple) else (spec, [])
     out = subprocess.run(
         [sys.executable, os.path.join(ROOT, "examples", script),
-         "--platform", "cpu"],
-        capture_output=True, text=True, timeout=SCRIPTS[script], env=env,
+         "--platform", "cpu", *extra],
+        capture_output=True, text=True, timeout=timeout, env=env,
     )
     assert out.returncode == 0, (out.stdout + out.stderr)[-2000:]

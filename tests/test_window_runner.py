@@ -269,3 +269,39 @@ def test_queue_reload_picks_up_appended_job(runner, tmp_path, monkeypatch):
     assert runner.main() == 0
     state = runner.load_done()
     assert state == {"first": -1, "appended": -1}
+
+
+def test_setup_jobs_run_before_any_dial(runner, tmp_path, monkeypatch):
+    """Top-level "setup" jobs are host-side pre-steps: they run at
+    runner start (journaled with setup:true) even when every dial is
+    dead, and a failing setup retries once then journals setup_failed."""
+    dials = []
+
+    def dead_dial(probe_id):
+        dials.append(len(open(runner.JOURNAL).readlines()))
+        return False
+
+    monkeypatch.setattr(runner, "dial", dead_dial)
+    marker = tmp_path / "fixture.txt"
+    q = _queue(
+        tmp_path, [ok_job("j1")],
+        setup=[{"name": "fix", "deadline_s": 30,
+                "argv": [sys.executable, "-c",
+                         f"open(r'{marker}', 'w').write('x'); print('ok')"]},
+               {"name": "bad", "deadline_s": 30,
+                "argv": [sys.executable, "-c", "raise SystemExit(2)"]}],
+    )
+    monkeypatch.setattr(sys, "argv", ["runner", q])
+    runner.main()
+    assert marker.exists()  # setup ran despite zero healthy windows
+    events = [json.loads(l) for l in open(runner.JOURNAL)]
+    setup_ends = [e for e in events if e.get("event") == "job_end"
+                  and e.get("setup")]
+    assert [e["job"] for e in setup_ends] == ["fix", "bad", "bad"]  # 1 retry
+    assert any(e.get("event") == "setup_failed" and e["job"] == "bad"
+               for e in events)
+    # every setup event was already journaled when the first dial fired
+    # (the stub snapshots the journal length at call time)
+    assert dials, "dial never attempted"
+    last_setup = max(i for i, e in enumerate(events) if e.get("setup"))
+    assert last_setup < dials[0]

@@ -251,3 +251,16 @@ class TestResNet50:
         scores = solver.test(2, feed)
         assert np.isfinite(scores["loss"]), scores
         assert 0.0 <= scores["accuracy"] <= 1.0
+
+    def test_bn_fraction_knob(self):
+        """bn_fraction reaches every BatchNorm layer's proto param (the
+        short-schedule eval-stats knob examples/10 uses)."""
+        from sparknet_tpu.models import zoo
+
+        net = zoo.resnet50(batch=2, bn_fraction=0.9)
+        fracs = [
+            lp.get_msg("batch_norm_param").get_float(
+                "moving_average_fraction", 0.999)
+            for lp in net.get_all("layer") if lp.get_str("type") == "BatchNorm"
+        ]
+        assert len(fracs) == 53 and all(f == 0.9 for f in fracs), fracs
