@@ -849,6 +849,15 @@ def cmd_time(args) -> int:
     import jax
 
     net_param, solver_cfg = _build_net_and_solver(args)
+    if getattr(args, "dtype", ""):
+        # trace the program the bench claims are made in (probe-40 traced
+        # f32 while every headline row is bf16 — dtype must be steerable)
+        import jax.numpy as jnp
+
+        from sparknet_tpu.common import set_config
+
+        set_config(compute_dtype=jnp.bfloat16
+                   if args.dtype in ("bf16", "bfloat16") else jnp.float32)
     if getattr(args, "trace", False):
         return _time_trace(args, net_param, solver_cfg)
     if args.fused:
@@ -1000,18 +1009,21 @@ def _time_trace(args, net_param, solver_cfg) -> int:
             peak, peak_label = peak_table["v5e"][dtype_name], f"v5e_{dtype_name}(assumed)"
 
     bank("compiled", batch=int(batch), dtype=dtype_name,
-         platform=platform, device_kind=kind,
+         platform=platform, device_kind=kind, iters=int(iters),
          gflop_per_step=round(flops / 1e9, 2),
          hbm_gb_per_step=round(hbm_bytes / 1e9, 3))
 
     # Stage 2 — wall timing WITHOUT the profiler: throughput + MFU
     # evidence lands even if the profiler start below wedges the relay.
+    from sparknet_tpu.common import value_fence
+
     run = lambda *a: compiled(*a)  # noqa: E731
-    jax.block_until_ready(run(v, s, 0, feeds, key))  # warm (executable cached)
+
+    value_fence(run(v, s, 0, feeds, key))  # warm (executable cached)
     t0 = _time.perf_counter()
     for _ in range(3):
         out = run(v, s, 0, feeds, key)
-    jax.block_until_ready(out)
+    value_fence(out)
     wall_untraced_s = (_time.perf_counter() - t0) / 3
     mfu_untraced = (flops / wall_untraced_s / peak
                     if peak and wall_untraced_s else None)
@@ -1673,6 +1685,10 @@ def main(argv=None) -> int:
                     help="JSON artifact for --trace, flushed incrementally "
                     "after every stage so a wedge mid-trace still leaves "
                     "evidence (default: ./tpunet_trace.json)")
+    sp.add_argument("--dtype", default="",
+                    choices=["", "bf16", "bfloat16", "f32"],
+                    help="compute dtype for the timed/traced step "
+                    "(default: the config default, f32)")
     sp.set_defaults(fn=cmd_time)
 
     sp = sub.add_parser("convert_imageset", help="image list -> record DB")

@@ -108,3 +108,20 @@ def step_key(key: jax.Array, step: jax.Array | int) -> jax.Array:
 def layer_key(key: jax.Array, layer_index: int) -> jax.Array:
     """Derive a per-layer key from a step key (static layer index)."""
     return jax.random.fold_in(key, layer_index)
+
+
+def value_fence(out) -> float:
+    """Execution fence for timing loops: pull ONE SCALAR VALUE from the
+    last leaf of ``out``.
+
+    ``jax.block_until_ready`` is NOT a fence on remote-relay backends
+    (axon reports buffers ready before the chain has executed — probe-40
+    banked a physically impossible 8.2M img/s off readiness alone), and
+    fetching a whole array would add a multi-MB device-to-host copy over
+    the tunnel INTO the timed region.  Indexing device-side first keeps
+    the transfer to one scalar.
+    """
+    import numpy as np
+
+    leaf = jax.tree_util.tree_leaves(out)[-1]
+    return float(np.asarray(jnp.ravel(leaf)[-1]))

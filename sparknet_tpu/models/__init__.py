@@ -29,4 +29,6 @@ from sparknet_tpu.models.zoo import (  # noqa: F401
     resnet50_solver,
     transformer,
     transformer_solver,
+    vgg16,
+    vgg16_solver,
 )

@@ -524,6 +524,69 @@ def resnet50_solver() -> SolverConfig:
     )
 
 
+# ---------------------------------------------------------------------------
+# VGG-16 — the second post-reference zoo family (Simonyan & Zisserman
+# 2015, configuration D), wired as the published Caffe model-zoo
+# VGG_ILSVRC_16_layers train_val: 13 conv3x3/pad1 layers in five
+# max-pooled blocks, then the AlexNet-style 4096/4096/1000 FC tail with
+# dropout.  TPU-first rationale: it is the zoo's pure compute-roofline
+# member — uniform 3x3 convs at full stride keep the MXU saturated
+# (~15.5 GFLOP/image forward, an order of magnitude over AlexNet with a
+# third of AlexNet's bytes-per-FLOP), so its bench record is bounded by
+# the corrected `TPU_PEAK_FLOPS` compute term, not HBM, making it the
+# model that keeps the MFU column honest.
+# ---------------------------------------------------------------------------
+def _vgg_block(idx: int, bottom: str, convs: int, width: int,
+               filler) -> list[Message]:
+    """conv{idx}_1..convs (3x3 pad 1, ReLU) then 2x2/2 max pool."""
+    layers: list[Message] = []
+    for j in range(1, convs + 1):
+        name = f"conv{idx}_{j}"
+        layers += [
+            ConvolutionLayer(name, [bottom], kernel=(3, 3), num_output=width,
+                             pad=(1, 1), weight_filler=filler(),
+                             bias_filler=_const(0.0)),
+            ReLULayer(f"relu{idx}_{j}", [name], in_place=True),
+        ]
+        bottom = name
+    layers.append(PoolingLayer(f"pool{idx}", [bottom], Pooling.Max,
+                               kernel=(2, 2), stride=(2, 2)))
+    return layers
+
+
+def vgg16(batch: int = 64, num_classes: int = 1000, crop: int = 224,
+          msra_init: bool = False) -> Message:
+    """``msra_init``: the published zoo file keeps gaussian std 0.01 —
+    faithful, but activations vanish ~1e-5 by conv5_3 so config D does
+    not train from scratch (the paper bootstrapped it from config A;
+    He et al. 2015 §2.2 derives msra filling from exactly this failure).
+    Flip on for from-scratch training without a warm start."""
+    filler = _msra if msra_init else lambda: _gauss(0.01)
+    blocks = [(1, 2, 64), (2, 2, 128), (3, 3, 256), (4, 3, 512), (5, 3, 512)]
+    layers: list[Message] = [
+        RDDLayer("data", shape=[batch, 3, crop, crop]),
+        RDDLayer("label", shape=[batch]),
+    ]
+    bottom = "data"
+    for idx, convs, width in blocks:
+        layers += _vgg_block(idx, bottom, convs, width, filler)
+        bottom = f"pool{idx}"
+    layers += _alex_tail(bottom, num_classes)
+    return NetParam("VGG-16", *layers)
+
+
+def vgg16_solver() -> SolverConfig:
+    """The published recipe (Simonyan & Zisserman §3.1): SGD momentum
+    0.9, base_lr 0.01 decreased 10x on plateau (step schedule here),
+    weight decay 5e-4, batch 256 aggregated (the Caffe zoo train_val
+    runs batch 64 with iter_size; on TPU the full batch fits one step)."""
+    return SolverConfig(
+        base_lr=0.01, lr_policy="step", gamma=0.1, stepsize=100000,
+        momentum=0.9, weight_decay=5e-4, max_iter=370000,
+        solver_type="SGD", display=20, snapshot_prefix="vgg16",
+    )
+
+
 def _shared(m: Message, *names: str) -> Message:
     """Attach named param{} messages for cross-layer weight sharing.
     lr_mults follow the reference siamese file: weights 1, biases 2."""

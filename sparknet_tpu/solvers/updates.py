@@ -161,7 +161,11 @@ def apply_update(
             )
             dw, s = rule(ctx, w, g, slots[lname][i])
             out_p.append(w - dw.astype(w.dtype))
-            out_s.append(s)
+            # ctx.rate is an f32 scalar, so rule math promotes a low-
+            # precision history slot to f32; cast back so slot dtype is
+            # a fixpoint (pure-bf16 training stores slots in bf16, and a
+            # drifting dtype breaks the lax.scan carry contract).
+            out_s.append([x.astype(w.dtype) for x in s])
         new_params[lname] = out_p
         new_slots[lname] = out_s
     return new_params, new_slots

@@ -40,6 +40,8 @@ def trace_step(step_fn, args, iters: int) -> dict:
 
     import jax
 
+    from sparknet_tpu.common import value_fence
+
     tmp = tempfile.mkdtemp(prefix="tpunet_time_")
     jax.profiler.start_trace(tmp)
     try:
@@ -47,7 +49,7 @@ def trace_step(step_fn, args, iters: int) -> dict:
         out = None
         for _ in range(iters):
             out = step_fn(*args)
-        jax.block_until_ready(out)
+        value_fence(out)
         wall = (time.perf_counter() - t0) / iters
     finally:
         jax.profiler.stop_trace()
@@ -60,10 +62,9 @@ def trace_step(step_fn, args, iters: int) -> dict:
 
 def profile_step(step_fn, args, iters: int = 5) -> dict:
     """Warm up once (outside the trace), then one traced segment."""
-    import jax
+    from sparknet_tpu.common import value_fence
 
-    out = step_fn(*args)
-    jax.block_until_ready(out)
+    value_fence(step_fn(*args))
     return trace_step(step_fn, args, iters)
 
 
@@ -89,16 +90,55 @@ def _device_events(log_dir: str) -> list[tuple[str, float]]:
             if any(tag in name for tag in ("/device:", "TPU", "GPU", "XLA"))
             and "CUPTI" not in name
         }
+        # A device pid exports several STACKED lanes for the same wall
+        # interval — on TPU: Steps / XLA Modules / XLA Ops (probe-40
+        # artifact triple-counted the step: 80.5 ms "device total" for a
+        # 26.8 ms step).  Only the op-level lane carries per-op rows, so
+        # when thread names are present keep just lanes that look
+        # op-level; an unnamed-lane trace (CPU chrome export) passes
+        # through unfiltered.
+        named_lanes: dict = {}
+        for e in raw:
+            if (e.get("ph") == "M" and e.get("name") == "thread_name"
+                    and e.get("pid") in device_pids):
+                named_lanes.setdefault(e["pid"], {})[e.get("tid")] = (
+                    e.get("args", {}).get("name", "").lower())
+        lane_events: dict = {}
+        for e in raw:
+            if e.get("ph") == "X" and e.get("pid") in named_lanes:
+                key = (e["pid"], e.get("tid"))
+                lane_events[key] = lane_events.get(key, 0) + 1
+        # ONE lane per named pid: prefer an "XLA Ops"-style lane, then
+        # any non-async ops lane, else the lane with the MOST events
+        # (op lanes carry orders of magnitude more rows than the
+        # stacked Steps/Modules aggregates — falling through to "sum
+        # everything" would reinstate the triple-counting this fixes).
+        op_tids = set()
+        for pid, lanes in named_lanes.items():
+            def rank(tid):
+                lname = lanes[tid]
+                is_ops = "ops" in lname and "async" not in lname
+                return (0 if is_ops and "xla" in lname
+                        else 1 if is_ops else 2,
+                        -lane_events.get((pid, tid), 0))
+            best = min(lanes, key=rank)
+            op_tids.add((pid, best))
+        named_device_pids = set(named_lanes)
         for e in raw:
             if e.get("ph") != "X" or e.get("pid") not in device_pids:
+                continue
+            if (e["pid"] in named_device_pids
+                    and (e["pid"], e.get("tid")) not in op_tids):
                 continue
             dur = e.get("dur")
             if not dur:
                 continue
             name = e.get("name", "")
-            scope = e.get("args", {}).get("long_name", "") or e.get(
-                "args", {}
-            ).get("tf_op", "")
+            args = e.get("args", {})
+            # search BOTH metadata fields: on TPU ``long_name`` is raw
+            # HLO text (no scope) while ``tf_op`` carries the op_name
+            # path with the L.<layer> scopes; CPU exports vary
+            scope = f"{args.get('tf_op', '')}|{args.get('long_name', '')}"
             events.append((f"{name}|{scope}", float(dur)))
     return events
 

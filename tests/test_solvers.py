@@ -735,3 +735,30 @@ def test_orbax_background_snapshot(tmp_path):
     # npz + background is a loud error, not a silent sync save
     with pytest.raises(ValueError, match="background"):
         s1.save(str(tmp_path / "x"), background=True)
+
+
+@pytest.mark.parametrize("stype", ["SGD", "Adam"])
+def test_pure_bf16_scan_slot_dtype_fixpoint(stype):
+    """Pure-bf16 training (params AND slots stored bf16, the
+    SPARKNET_BENCH_PARAM_DTYPE=bf16 arm): the update must return slots
+    in the stored dtype.  ctx.rate is an f32 scalar, so unchecked rule
+    math promotes a bf16 history to f32 — under jitted_scan_steps that
+    breaks the lax.scan carry contract (probe-40 on-chip failure,
+    docs/evidence_r4/alexnet_bf16params_ab.txt)."""
+    from sparknet_tpu.common import set_config
+
+    set_config(compute_dtype=jnp.bfloat16, param_dtype=jnp.bfloat16)
+    try:
+        cfg = SolverConfig(base_lr=0.02, momentum=0.9, solver_type=stype)
+        solver = _make_solver(cfg)
+        data_fn, _ = _linreg_data_fn()
+        scan_fn, sv, ss, skey = solver.jitted_scan_steps(3, donate=False)
+        sv, ss, losses = scan_fn(sv, ss, 0, data_fn(0), skey)
+        assert losses.shape == (3,)
+        assert np.all(np.isfinite(np.asarray(losses, np.float32)))
+        for lname, plist in ss.items():
+            for blob_slots in plist:
+                for h in blob_slots:
+                    assert h.dtype == jnp.bfloat16, (lname, h.dtype)
+    finally:
+        set_config(compute_dtype=jnp.float32, param_dtype=jnp.float32)

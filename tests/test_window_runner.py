@@ -305,3 +305,38 @@ def test_setup_jobs_run_before_any_dial(runner, tmp_path, monkeypatch):
     assert dials, "dial never attempted"
     last_setup = max(i for i, e in enumerate(events) if e.get("setup"))
     assert last_setup < dials[0]
+
+
+def test_rc4_backend_unreachable_is_window_death_not_failure(
+        runner, tmp_path, monkeypatch):
+    """bench.py exits 4 when its own probe says the backend is gone
+    (SPARKNET_BENCH_REQUIRE_MEASURED): that is the WINDOW dying, not the
+    job failing — it must not count toward max_attempts (a wedged relay
+    would otherwise kill every pending bench job 300 s at a time), and
+    the drain loop must go back to dialing instead of burning the next
+    job against a dead backend."""
+    dials = []
+
+    def dial(probe_id=0):
+        dials.append(1)
+        return len(dials) <= 2  # two "healthy" windows, then give up
+
+    monkeypatch.setattr(runner, "dial", dial)
+    rc4 = {"name": "bench_rc4",
+           "argv": [sys.executable, "-c", "raise SystemExit(4)"],
+           # rc-4-as-window-death is OPT-IN via the bench contract env;
+           # a job without it exiting 4 is a plain failure (argparse
+           # errors etc. must still burn attempts)
+           "env": {"SPARKNET_BENCH_REQUIRE_MEASURED": "1"},
+           "deadline_s": 30}
+    q = _queue(tmp_path, [rc4, ok_job("after")], max_hours=0.005)
+    monkeypatch.setattr(sys, "argv", ["runner", q])
+    runner.main()
+    state = runner.load_done()
+    # rc4 never became a counted failure...
+    assert state.get("bench_rc4", 0) == 0
+    # ...and the job AFTER it never ran in the dead window (drain broke)
+    assert state.get("after", 0) == 0
+    # ...but it DOES count on the hang ledger so a chronically rc-4 job
+    # still blocks eventually instead of spinning forever
+    assert runner.load_done(count_timeouts=True).get("bench_rc4") == 2

@@ -264,3 +264,65 @@ class TestResNet50:
             for lp in net.get_all("layer") if lp.get_str("type") == "BatchNorm"
         ]
         assert len(fracs) == 53 and all(f == 0.9 for f in fracs), fracs
+
+
+class TestVGG16:
+    """zoo:vgg16 — the second post-reference family (Simonyan &
+    Zisserman 2015 configuration D, Caffe model-zoo
+    VGG_ILSVRC_16_layers wiring).  Load-bearing pin: the published
+    138,357,544 parameter count; the family exists as the zoo's
+    compute-roofline (MXU-saturating) member."""
+
+    def test_param_pin_and_shape(self):
+        from sparknet_tpu.models import zoo
+
+        net = Network(zoo.vgg16(batch=2), Phase.TRAIN)
+        v = net.init(jax.random.PRNGKey(0))
+        assert _param_count(v) == 138_357_544  # torchvision vgg16
+        # 13 convs + 3 FCs carry weights; nothing else does
+        assert sum(1 for k in v.params if "conv" in k) == 13
+        assert sum(1 for k in v.params if k.startswith("fc")) == 3
+
+    def test_trains_at_small_scale(self):
+        import dataclasses
+
+        import numpy as np
+
+        from sparknet_tpu.models import zoo
+        from sparknet_tpu.solvers.solver import Solver
+
+        # crop 64 keeps pool5 at 2x2 (five 2x2/2 pools); gauss-0.01 FC
+        # init at lr 0.01 is the published recipe but too hot for a
+        # 4-image fixture, so scale down as the resnet50 smoke does
+        cfg = dataclasses.replace(zoo.vgg16_solver(), base_lr=1e-3)
+        net_param = zoo.vgg16(batch=4, num_classes=5, crop=64)
+        solver = Solver(cfg, net_param)
+        rs = np.random.RandomState(0)
+
+        def feed(it):
+            return {
+                "data": rs.randn(4, 3, 64, 64).astype(np.float32) * 40,
+                "label": rs.randint(0, 5, size=(4,)).astype(np.int32),
+            }
+
+        losses = [float(solver.step(1, feed)) for _ in range(3)]
+        assert np.all(np.isfinite(losses)), losses
+        scores = solver.test(2, feed)
+        assert 0.0 <= scores["accuracy"] <= 1.0
+
+    def test_msra_init_knob(self):
+        """msra_init=True swaps every conv filler (the published gauss
+        0.01 vanishes ~1e-5 by conv5_3 — config D never trained from
+        scratch; verified in the round-4 CPU drive where the default sat
+        at chance and msra reached 1.0 on the overfit fixture)."""
+        from sparknet_tpu.models import zoo
+
+        for flag, want in ((False, "gaussian"), (True, "msra")):
+            net = zoo.vgg16(batch=2, msra_init=flag)
+            fillers = {
+                lp.get_msg("convolution_param").get_msg(
+                    "weight_filler").get_str("type")
+                for lp in net.get_all("layer")
+                if lp.get_str("type") == "Convolution"
+            }
+            assert fillers == {want}, (flag, fillers)

@@ -64,13 +64,15 @@ def main() -> int:
     def measure(label, ctx):
         import contextlib
 
+        from sparknet_tpu.common import value_fence as fence
+
         def run(fn):
             out = fn(variables, feeds)
-            jax.block_until_ready(out)
+            fence(out)
             t0 = time.perf_counter()
             for _ in range(iters):
                 out = fn(variables, feeds)
-            jax.block_until_ready(out)
+            fence(out)
             return B * iters / (time.perf_counter() - t0)
 
         with ctx or contextlib.nullcontext():

@@ -85,12 +85,14 @@ def main():
         avg = jax.jit(
             lambda t: jax.tree_util.tree_map(lambda x: x.mean(0), t)
         )
+        from sparknet_tpu.common import value_fence as fence
+
         out = avg(stacked)
-        jax.block_until_ready(out)
+        fence(out)
         t0 = time.perf_counter()
         for _ in range(args.iters):
             out = avg(stacked)
-        jax.block_until_ready(out)
+        fence(out)
         dt = (time.perf_counter() - t0) / args.iters
 
         analytic_ici_ms = 2 * nbytes * (p - 1) / p / ICI_BW * 1e3

@@ -102,7 +102,15 @@ def load_done(count_timeouts: bool = False) -> dict[str, int]:
                     continue
                 if ev.get("event") == "job_end":
                     n = ev["job"]
-                    timed_out = ev.get("rc") is None
+                    # window_death covers both a deadline kill (rc None)
+                    # and an OPTED-IN job's rc-4 "backend unreachable"
+                    # exit (bench.py under SPARKNET_BENCH_REQUIRE_
+                    # MEASURED; run_job stamps the event).  Either means
+                    # the WINDOW died, not the job — it must not count
+                    # toward max_attempts, or a wedged relay kills every
+                    # pending bench job 300 s at a time.
+                    timed_out = (ev.get("rc") is None
+                                 or bool(ev.get("window_death")))
                     if count_timeouts:
                         if timed_out:
                             state[n] = state.get(n, 0) + 1
@@ -192,9 +200,19 @@ def run_job(job: dict, probe_id: int = 0, setup: bool = False) -> int | None:
                 proc.kill()
                 proc.wait()
             rc = None
+    # rc 4 from a job that runs bench.py's REQUIRE_MEASURED contract is
+    # that job's own probe saying "backend unreachable" — a window
+    # death, not a job failure.  Only jobs carrying the env var opt in;
+    # any other job exiting 4 (argparse, a library) stays a real
+    # failure.  The flag is stamped HERE so the journal (the judge-
+    # facing evidence) and load_done's retry ledger can never disagree.
+    window_death = rc is None or (
+        rc == 4 and "SPARKNET_BENCH_REQUIRE_MEASURED" in job.get("env", {}))
     log({"event": "job_end", "job": name, "rc": rc,
          "dt_s": round(time.time() - t0, 1),
-         "timed_out": rc is None, **({"setup": True} if setup else {})})
+         "timed_out": rc is None,
+         **({"window_death": True} if window_death and rc is not None else {}),
+         **({"setup": True} if setup else {})})
     return rc
 
 
@@ -344,7 +362,13 @@ def main() -> int:
                 break
             attempted.add(job["name"])
             rc = run_job(job, probe_id)
-            if rc is None:
+            if rc is None or (
+                rc == 4
+                and "SPARKNET_BENCH_REQUIRE_MEASURED" in job.get("env", {})
+            ):
+                # deadline kill, or an opted-in job's own backend probe
+                # said unreachable: the window is gone — dial, don't
+                # drain the next job against a dead backend
                 break
     log({"event": "runner_done", "reason": "max_hours reached"})
     return 0
