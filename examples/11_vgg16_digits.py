@@ -1,0 +1,105 @@
+"""VGG-16 on real pixels: the compute-roofline family learns.
+
+The zoo's second post-reference model family (``zoo:vgg16`` — Simonyan &
+Zisserman config D, the Caffe model-zoo VGG_ILSVRC_16_layers wiring)
+trained on sklearn's bundled handwritten digits, the same real-pixel
+corpus examples/05 and /10 use, upscaled 8->64 so the five 2x2/2 pools
+leave a 2x2x512 pool5 map (crop 32 collapses it to 1x1 before the fc
+tail and the corpus tops out ~78%; 64 matches examples/10 and crosses
+the bar).
+
+Two things this demonstrates that the other families don't:
+
+- **The init footgun is real and the knob fixes it.** The published
+  train_val init (gaussian std 0.01) shrinks activations ~1e-5 by
+  conv5_3 — config D famously never trained from scratch; the paper
+  bootstrapped it from config A, and He et al. 2015 derived msra filling
+  from exactly this failure.  ``zoo.vgg16(msra_init=True)`` is the
+  from-scratch recipe; the default stays faithful to the zoo file for
+  finetune-from-caffemodel parity.
+- **Unit-scale data for msra nets.** The raw-pixel scale the gauss-0.01
+  zoo recipes need (mean-subtracted 0..255) is exactly wrong for a
+  variance-preserving init — it propagates a ~90-std signal into the
+  lr-sensitive fc tail (the round-4 CPU drive diverged on it).  The
+  msra path wants unit-ish inputs, so this example feeds digits/8-0.5.
+
+Run:
+
+    python examples/11_vgg16_digits.py [--steps 120]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=250)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--platform", default="cpu")
+    ap.add_argument("--smoke", action="store_true",
+                    help="plumbing check: few steps, finiteness instead "
+                    "of the accuracy bar (CI; the full run is the "
+                    "convergence evidence)")
+    args = ap.parse_args()
+    if args.smoke:
+        args.steps, args.batch = min(args.steps, 3), min(args.batch, 4)
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    import numpy as np
+
+    from sparknet_tpu.data.digits import load_digits_dataset, minibatch_fn
+    from sparknet_tpu.models import zoo
+    from sparknet_tpu.solvers.solver import Solver
+
+    crop = 64  # five 2x2/2 pools: 64 -> 2x2 pool5
+    xtr, ytr, xte, yte = load_digits_dataset(upscale=crop)
+    # grayscale -> 3-channel, UNIT scale (digits are 0..16): msra wants
+    # variance ~1, not the raw-pixel scale the gauss-0.01 recipes need
+    prep = lambda x: np.repeat(x, 3, axis=1) / 8.0 - 0.5
+    xtr, xte = prep(xtr), prep(xte)
+
+    # Adam for the short schedule: the published SGD recipe's lr ladder
+    # assumes ImageNet-scale epochs; on 1.4k digits Adam 2e-4 crosses
+    # 90% test accuracy inside the default 250 steps (1e-4/120 reached
+    # only 74% — the 16.8M-param fc tail wants the longer schedule)
+    cfg = dataclasses.replace(
+        zoo.vgg16_solver(),
+        base_lr=2e-4, solver_type="Adam", momentum=0.9, momentum2=0.999,
+        lr_policy="fixed", weight_decay=0.0,
+        max_iter=args.steps, display=10,
+    )
+    solver = Solver(cfg, zoo.vgg16(
+        batch=args.batch, num_classes=10, crop=crop, msra_init=True))
+
+    train_fn = minibatch_fn(xtr, ytr, args.batch, seed=0)
+
+    def test_fn(b):
+        idx = np.arange(b * args.batch, (b + 1) * args.batch) % len(yte)
+        return {"data": xte[idx], "label": yte[idx]}
+
+    n_test = 2 if args.smoke else max(1, len(yte) // args.batch)
+
+    before = solver.test(n_test, test_fn)
+    print(f"untrained: {before}")
+    solver.step(args.steps, train_fn)
+    after = solver.test(n_test, test_fn)
+    print(f"after {args.steps} steps: {after}")
+    if args.smoke:
+        ok = bool(np.isfinite(after["loss"]))
+        print("PASS (smoke: finite)" if ok else "FAIL (loss not finite)")
+    else:
+        ok = after["accuracy"] >= 0.90
+        print("PASS" if ok else "FAIL (expected >=0.90)")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -108,21 +108,38 @@ def _device_events(log_dir: str) -> list[tuple[str, float]]:
             if e.get("ph") == "X" and e.get("pid") in named_lanes:
                 key = (e["pid"], e.get("tid"))
                 lane_events[key] = lane_events.get(key, 0) + 1
-        # ONE lane per named pid: prefer an "XLA Ops"-style lane, then
-        # any non-async ops lane, else the lane with the MOST events
-        # (op lanes carry orders of magnitude more rows than the
-        # stacked Steps/Modules aggregates — falling through to "sum
-        # everything" would reinstate the triple-counting this fixes).
+        # Lane policy per named device pid.  TPU xprof exports STACK
+        # several views of the same wall interval (Steps / XLA Modules /
+        # XLA Ops / overlays) — summing them triple-counts the step
+        # (probe-40: 80.5 ms "device total" for a 26.8 ms step), so
+        # exactly ONE lane may survive: the XLA-Ops-style lane if named,
+        # else the busiest non-aggregate lane.  GPU-style exports
+        # instead put CONCURRENT streams under one pid — distinct real
+        # work, so dropping to one lane would undercount; there the
+        # aggregate lanes are excluded by name and every stream lane
+        # survives.
+        AGG = ("step", "module", "overlay")
         op_tids = set()
         for pid, lanes in named_lanes.items():
-            def rank(tid):
-                lname = lanes[tid]
-                is_ops = "ops" in lname and "async" not in lname
-                return (0 if is_ops and "xla" in lname
-                        else 1 if is_ops else 2,
-                        -lane_events.get((pid, tid), 0))
-            best = min(lanes, key=rank)
-            op_tids.add((pid, best))
+            def is_ops(lname):
+                return "ops" in lname and "async" not in lname
+            ops_lanes = [t for t, ln in lanes.items() if is_ops(ln)]
+            if ops_lanes:  # stacked-views export: ONE op lane only
+                best = min(
+                    ops_lanes,
+                    key=lambda t: (0 if "xla" in lanes[t] else 1,
+                                   -lane_events.get((pid, t), 0)))
+                op_tids.add((pid, best))
+                continue
+            streams = [t for t, ln in lanes.items()
+                       if not any(a in ln for a in AGG)
+                       and "async" not in ln]
+            if streams:  # stream-per-lane export: keep them all
+                op_tids.update((pid, t) for t in streams)
+            else:  # only aggregates named: busiest lane, counted once
+                best = min(lanes,
+                           key=lambda t: -lane_events.get((pid, t), 0))
+                op_tids.add((pid, best))
         named_device_pids = set(named_lanes)
         for e in raw:
             if e.get("ph") != "X" or e.get("pid") not in device_pids:

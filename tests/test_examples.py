@@ -27,6 +27,7 @@ SCRIPTS = {
     # full run is the convergence evidence (~20 min); CI smoke-checks
     # the plumbing only
     "10_resnet50_digits.py": (560, ["--smoke"]),
+    "11_vgg16_digits.py": (560, ["--smoke"]),
 }
 
 

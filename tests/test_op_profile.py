@@ -164,3 +164,85 @@ def test_trace_report_renders_rows(tmp_path):
         [sys.executable, tool, str(p)],
         capture_output=True, text=True, check=True).stdout
     assert "No per-layer rows banked" in out and "20.500 ms" in out
+
+
+def _write_tpu_style_trace(tmp_path, lanes, ops):
+    """TPU xprof export shape: ONE device pid with stacked named lanes
+    (Steps / XLA Modules / XLA Ops), scopes in args.tf_op, args.long_name
+    carrying raw HLO text (no scopes)."""
+    d = tmp_path / "plugins" / "profile" / "run1"
+    os.makedirs(d, exist_ok=True)
+    raw = [{"ph": "M", "name": "process_name", "pid": 3,
+            "args": {"name": "/device:TPU:0"}}]
+    for tid, lname in lanes.items():
+        raw.append({"ph": "M", "name": "thread_name", "pid": 3,
+                    "tid": tid, "args": {"name": lname}})
+    for tid, name, tf_op, dur in ops:
+        raw.append({
+            "ph": "X", "pid": 3, "tid": tid, "ts": 0, "dur": dur,
+            "name": name,
+            "args": {"tf_op": tf_op,
+                     "long_name": "%fusion.1 = f32[8,8]{1,0:T(8,128)}"},
+        })
+    with gzip.open(d / "vm.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": raw}, f)
+    return str(tmp_path)
+
+
+def test_tpu_stacked_lanes_counted_once(tmp_path):
+    """The probe-40 regression: Steps + XLA Modules + XLA Ops lanes each
+    carry the full step interval; only the op lane may be summed (the
+    artifact shipped 80.5 ms 'device total' for a 26.8 ms step), and the
+    L.<layer> scope lives in tf_op, not long_name (raw HLO on TPU)."""
+    root = _write_tpu_style_trace(
+        tmp_path,
+        lanes={1: "Steps", 2: "XLA Modules", 3: "XLA Ops",
+               4: "Async XLA Ops"},
+        ops=[
+            (1, "0", "", 1000.0),               # step marker
+            (2, "jit_step(123)", "", 1000.0),   # module marker
+            (3, "fusion.7", "jit(step)/jvp(L.conv1)/conv_general_dilated:", 600.0),
+            (3, "fusion.9", "jit(step)/transpose(jvp(L.conv1))/mul:", 300.0),
+            (3, "copy.1", "", 100.0),
+            (4, "async-copy", "", 500.0),       # async lane: excluded
+        ])
+    per_layer, total = aggregate_by_layer(_device_events(root), iters=1)
+    assert total == 1000.0  # op lane only — no triple count
+    assert per_layer["conv1"] == 900.0
+    assert per_layer["(other)"] == 100.0
+
+
+def test_named_lanes_without_ops_name_pick_busiest(tmp_path):
+    """An export whose op lane is named unrecognizably must not fall
+    back to summing every stacked lane: the busiest lane wins."""
+    root = _write_tpu_style_trace(
+        tmp_path,
+        lanes={1: "Steps", 2: "op timeline (v2)"},
+        ops=[
+            (1, "0", "", 1000.0),
+            (2, "fusion.1", "jit(step)/L.fc/dot_general:", 700.0),
+            (2, "fusion.2", "", 200.0),
+            (2, "fusion.3", "", 100.0),
+        ])
+    per_layer, total = aggregate_by_layer(_device_events(root), iters=1)
+    assert total == 1000.0  # busiest lane (3 events), not Steps + it
+    assert per_layer["fc"] == 700.0
+
+
+def test_gpu_style_stream_lanes_all_counted(tmp_path):
+    """Concurrent named stream lanes under one device pid are DISTINCT
+    real work (the GPU export shape), not stacked views — every stream
+    must be summed, with only aggregate lanes (Steps/Modules) excluded."""
+    root = _write_tpu_style_trace(
+        tmp_path,
+        lanes={1: "Steps", 14: "Stream #14(compute)",
+               15: "Stream #15(memcpy)"},
+        ops=[
+            (1, "0", "", 1000.0),
+            (14, "kern.1", "jit(step)/L.conv1/conv:", 600.0),
+            (15, "memcpy.1", "", 250.0),
+        ])
+    per_layer, total = aggregate_by_layer(_device_events(root), iters=1)
+    assert total == 850.0  # both streams, no Steps aggregate
+    assert per_layer["conv1"] == 600.0
+    assert per_layer["(other)"] == 250.0

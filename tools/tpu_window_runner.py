@@ -150,6 +150,21 @@ def dial(probe_id: int) -> bool:
     return ok
 
 
+def window_death(rc: int | None, job: dict) -> bool:
+    """True when a job's exit means the WINDOW died, not the job: a
+    deadline kill, or rc 4 from a job that opted into bench.py's
+    REQUIRE_MEASURED contract (its own probe said the backend is gone).
+    Opt-in keys on the env VALUE, so a job setting it to "0" stays a
+    plain failure — as does any other tool that happens to exit 4.
+    The single predicate is shared by run_job's journal stamp and
+    main's drain loop so the evidence log and the retry ledger can
+    never disagree."""
+    if rc is None:
+        return True
+    return rc == 4 and job.get("env", {}).get(
+        "SPARKNET_BENCH_REQUIRE_MEASURED", "0") not in ("", "0")
+
+
 def run_job(job: dict, probe_id: int = 0, setup: bool = False) -> int | None:
     """Run one job with a deadline.  Returns rc, or None on timeout.
 
@@ -200,18 +215,11 @@ def run_job(job: dict, probe_id: int = 0, setup: bool = False) -> int | None:
                 proc.kill()
                 proc.wait()
             rc = None
-    # rc 4 from a job that runs bench.py's REQUIRE_MEASURED contract is
-    # that job's own probe saying "backend unreachable" — a window
-    # death, not a job failure.  Only jobs carrying the env var opt in;
-    # any other job exiting 4 (argparse, a library) stays a real
-    # failure.  The flag is stamped HERE so the journal (the judge-
-    # facing evidence) and load_done's retry ledger can never disagree.
-    window_death = rc is None or (
-        rc == 4 and "SPARKNET_BENCH_REQUIRE_MEASURED" in job.get("env", {}))
+    dead = window_death(rc, job)
     log({"event": "job_end", "job": name, "rc": rc,
          "dt_s": round(time.time() - t0, 1),
          "timed_out": rc is None,
-         **({"window_death": True} if window_death and rc is not None else {}),
+         **({"window_death": True} if dead and rc is not None else {}),
          **({"setup": True} if setup else {})})
     return rc
 
@@ -362,13 +370,9 @@ def main() -> int:
                 break
             attempted.add(job["name"])
             rc = run_job(job, probe_id)
-            if rc is None or (
-                rc == 4
-                and "SPARKNET_BENCH_REQUIRE_MEASURED" in job.get("env", {})
-            ):
-                # deadline kill, or an opted-in job's own backend probe
-                # said unreachable: the window is gone — dial, don't
-                # drain the next job against a dead backend
+            if window_death(rc, job):
+                # the window is gone — dial, don't drain the next job
+                # against a dead backend
                 break
     log({"event": "runner_done", "reason": "max_hours reached"})
     return 0

@@ -72,7 +72,9 @@ def render(events: list[dict], round_no: int) -> str:
                 continue  # host-side pre-step, not a probe-window job
             jobs.append(
                 f"probe-window job `{ev.get('job')}`: rc={ev.get('rc')} "
-                f"({ev.get('dt_s')} s{', TIMED OUT' if ev.get('timed_out') else ''})"
+                f"({ev.get('dt_s')} s"
+                f"{', TIMED OUT' if ev.get('timed_out') else ''}"
+                f"{', WINDOW DIED (uncounted)' if ev.get('window_death') and not ev.get('timed_out') else ''})"
             )
     for p in sorted(k for k in dials if k):
         d = dials[p]
