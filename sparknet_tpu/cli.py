@@ -849,15 +849,6 @@ def cmd_time(args) -> int:
     import jax
 
     net_param, solver_cfg = _build_net_and_solver(args)
-    if getattr(args, "dtype", ""):
-        # trace the program the bench claims are made in (probe-40 traced
-        # f32 while every headline row is bf16 — dtype must be steerable)
-        import jax.numpy as jnp
-
-        from sparknet_tpu.common import set_config
-
-        set_config(compute_dtype=jnp.bfloat16
-                   if args.dtype in ("bf16", "bfloat16") else jnp.float32)
     if getattr(args, "trace", False):
         return _time_trace(args, net_param, solver_cfg)
     if args.fused:
@@ -1622,6 +1613,11 @@ def main(argv=None) -> int:
         sp.add_argument("--batch", type=int, default=0, help="zoo batch override")
         sp.add_argument("--iterations", type=int, default=0)
         sp.add_argument("--snapshot", help=".solverstate.npz to restore")
+        sp.add_argument("--dtype", default="",
+                        choices=["", "bf16", "bfloat16", "f32"],
+                        help="compute dtype for the step (bf16 = mixed "
+                        "precision: bf16 activations/matmuls, f32 params "
+                        "and BN statistics; default f32)")
 
     sp = sub.add_parser("train", help="train a model")
     common(sp)
@@ -1685,10 +1681,6 @@ def main(argv=None) -> int:
                     help="JSON artifact for --trace, flushed incrementally "
                     "after every stage so a wedge mid-trace still leaves "
                     "evidence (default: ./tpunet_trace.json)")
-    sp.add_argument("--dtype", default="",
-                    choices=["", "bf16", "bfloat16", "f32"],
-                    help="compute dtype for the timed/traced step "
-                    "(default: the config default, f32)")
     sp.set_defaults(fn=cmd_time)
 
     sp = sub.add_parser("convert_imageset", help="image list -> record DB")
@@ -1837,6 +1829,24 @@ def main(argv=None) -> int:
         from sparknet_tpu.common import force_platform
 
         force_platform(args.platform)
+    if getattr(args, "dtype", ""):
+        # one application point for every brew that takes --dtype
+        # (train/test/time/bench): the global compute dtype must be set
+        # before any net is built or jitted — and RESTORED afterwards,
+        # because the CLI process may outlive the call (in-process
+        # cli.main() from tests or interactive use must not leak bf16
+        # into the caller's global config)
+        import jax.numpy as jnp
+
+        from sparknet_tpu.common import get_config, set_config
+
+        prev_dtype = get_config().compute_dtype
+        set_config(compute_dtype=jnp.bfloat16
+                   if args.dtype in ("bf16", "bfloat16") else jnp.float32)
+        try:
+            return args.fn(args)
+        finally:
+            set_config(compute_dtype=prev_dtype)
     return args.fn(args)
 
 

@@ -154,15 +154,16 @@ def window_death(rc: int | None, job: dict) -> bool:
     """True when a job's exit means the WINDOW died, not the job: a
     deadline kill, or rc 4 from a job that opted into bench.py's
     REQUIRE_MEASURED contract (its own probe said the backend is gone).
-    Opt-in keys on the env VALUE, so a job setting it to "0" stays a
-    plain failure — as does any other tool that happens to exit 4.
-    The single predicate is shared by run_job's journal stamp and
-    main's drain loop so the evidence log and the retry ledger can
-    never disagree."""
+    Opt-in keys on the env VALUE with bench.py's own test (== "1",
+    bench.py _require_measured) so the two sides can never disagree
+    about whether the contract is armed; any other tool that happens
+    to exit 4 stays a plain failure.  The single predicate is shared
+    by run_job's journal stamp and main's drain loop so the evidence
+    log and the retry ledger can never disagree either."""
     if rc is None:
         return True
     return rc == 4 and job.get("env", {}).get(
-        "SPARKNET_BENCH_REQUIRE_MEASURED", "0") not in ("", "0")
+        "SPARKNET_BENCH_REQUIRE_MEASURED") == "1"
 
 
 def run_job(job: dict, probe_id: int = 0, setup: bool = False) -> int | None:
