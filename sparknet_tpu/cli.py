@@ -1813,7 +1813,8 @@ def main(argv=None) -> int:
     _pods.add_parser(sub)
 
     sp = sub.add_parser("bench", help="headline training-throughput benchmark")
-    sp.add_argument("--model", default="", help="alexnet|caffenet|googlenet")
+    sp.add_argument("--model", default="",
+                    help="alexnet|caffenet|googlenet|resnet50|vgg16")
     sp.add_argument("--batch", type=int, default=0)
     sp.add_argument("--dtype", default="",
                     choices=["", "bf16", "bfloat16", "f32"])
