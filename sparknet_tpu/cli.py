@@ -1265,6 +1265,9 @@ def cmd_classify(args) -> int:
     # needs --images-dim larger than the crop to cut distinct crops;
     # preprocessing runs ONCE (calibration and prediction share blobs)
     blobs = clf.preprocess_images(images, args.oversample)
+    if getattr(args, "fold_bn", False):
+        folded = clf.fold_batchnorm()
+        print(json.dumps({"fold_bn": folded}))
     if getattr(args, "int8", False):
         qstate = clf.calibrate_int8(blobs=blobs)
         print(json.dumps({"int8": sorted(qstate)}))
@@ -1745,6 +1748,10 @@ def main(argv=None) -> int:
                     help="post-training int8 inference (MXU int8 mode): "
                     "self-calibrates activation scales on the input "
                     "images, per-channel int8 weights")
+    sp.add_argument("--fold-bn", action="store_true",
+                    help="fold in-place BatchNorm/Scale chains into their "
+                    "convolutions before inference (the merge_bn deploy "
+                    "flow; combine with --int8 to quantize BN nets)")
     sp.add_argument("images", nargs="+")
     sp.set_defaults(fn=cmd_classify)
 

@@ -58,11 +58,7 @@ class DeployNet:
                     self.variables.params, pretrained_file
                 )
             self.variables = NetVars(params=params, state=self.variables.state)
-        self._forward = jax.jit(
-            lambda variables, feeds: self.network.apply(
-                variables, feeds, rng=None, train=False
-            )[0]
-        )
+        self._forward = self._jit_forward()
 
         shapes = self.network.feed_shapes()
         # data inputs only — a deploy net has no label feed, but a net built
@@ -84,6 +80,45 @@ class DeployNet:
             self.transformer.set_channel_swap(in_, channel_swap)
 
     # ------------------------------------------------------------------
+    def _jit_forward(self):
+        """The float TEST-phase forward over the CURRENT self.network —
+        one definition for __init__ / fold_batchnorm / quantize_int8."""
+        return jax.jit(
+            lambda variables, feeds: self.network.apply(
+                variables, feeds, rng=None, train=False
+            )[0]
+        )
+
+    # ------------------------------------------------------------------
+    def fold_batchnorm(self) -> list[str]:
+        """Fold in-place BatchNorm(+Scale) chains into their producing
+        Conv/InnerProduct weights (the Caffe-ecosystem ``merge_bn``
+        deploy flow — see models/fold_bn.py).  Deletes two elementwise
+        passes per chain from the compiled program and reduces the net
+        to pure Conv/IP form, which is what ``quantize_int8`` wants
+        (fold FIRST, then quantize).  Returns the folded-chain labels;
+        inference-only — the statistics are baked in."""
+        from sparknet_tpu.models.fold_bn import fold_batchnorm
+
+        if getattr(self, "qstate", None) is not None:
+            # folding rebuilds the float forward; doing it AFTER int8
+            # calibration would silently drop the quantized path while
+            # qstate still claims otherwise
+            raise RuntimeError(
+                "fold_batchnorm() must run BEFORE quantize_int8 — the "
+                "fold rebuilds the network and the calibrated scales "
+                "would no longer match it")
+        net2, params2, state2, folded = fold_batchnorm(
+            self.network.net_param, self.variables.params,
+            self.variables.state)
+        if not folded:
+            return folded
+        self.network = Network(net2, Phase.TEST)
+        self.variables = NetVars(params=params2, state=state2)
+        self._forward = self._jit_forward()
+        return folded
+
+    # ------------------------------------------------------------------
     def quantize_int8(self, calibration_batches, num_batches: int = 4):
         """Switch this deploy net's forward to the post-training int8
         path (``sparknet_tpu.quant``): per-channel int8 weights +
@@ -100,11 +135,7 @@ class DeployNet:
             self.network, self.variables, calibration_batches,
             num_batches=num_batches,
         )
-        jitted = jax.jit(
-            lambda variables, feeds: self.network.apply(
-                variables, feeds, rng=None, train=False
-            )[0]
-        )
+        jitted = self._jit_forward()
         qstate = self.qstate
 
         def fwd(variables, feeds):

