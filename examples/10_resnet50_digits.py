@@ -97,12 +97,40 @@ def main() -> int:
     solver.step(args.steps, train_fn)
     after = solver.test(n_test, test_fn)
     print(f"after {args.steps} steps: {after}")
+
     if args.smoke:
         ok = bool(np.isfinite(after["loss"]))
         print("PASS (smoke: finite)" if ok else "FAIL (loss not finite)")
-    else:
-        ok = after["accuracy"] >= 0.90
-        print("PASS" if ok else "FAIL (expected >=0.90)")
+        return 0 if ok else 1
+
+    # Deploy-time BN folding (the merge_bn flow, models/fold_bn.py): all
+    # 53 Conv+BN+Scale chains collapse into their convolutions and the
+    # folded net must score what the TEST phase scored — on the REAL
+    # trained statistics, not a synthetic fixture.
+    from sparknet_tpu.compiler.graph import Network, NetVars
+    from sparknet_tpu.common import Phase
+    from sparknet_tpu.models.fold_bn import fold_batchnorm
+
+    net2, params2, state2, folded = fold_batchnorm(
+        solver.train_net.net_param, solver.variables.params,
+        solver.variables.state)
+    folded_net = Network(net2, Phase.TEST)
+    v2 = NetVars(params=params2, state=state2)
+    fwd = jax.jit(lambda v, f: folded_net.apply(
+        v, f, rng=None, train=False)[0])
+    hits = tot = 0
+    for b in range(n_test):
+        feed = test_fn(b)
+        outs = fwd(v2, {k: jnp.asarray(v) for k, v in feed.items()})
+        hits += int((np.asarray(outs["fc1000"]).argmax(1)
+                     == feed["label"]).sum())
+        tot += len(feed["label"])
+    folded_acc = hits / tot
+    print(f"folded ({len(folded)} BN chains merged): accuracy {folded_acc:.3f}")
+
+    ok = (after["accuracy"] >= 0.90
+          and abs(folded_acc - after["accuracy"]) < 0.01)
+    print("PASS" if ok else "FAIL (expected >=0.90 and fold parity)")
     return 0 if ok else 1
 
 

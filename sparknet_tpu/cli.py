@@ -589,8 +589,9 @@ def _load_weights_into(
         else copy_caffemodel_params
     )
     try:
-        params, loaded = copy(
-            solver.variables.params, path, strict_shapes=strict_shapes
+        params, state, loaded = copy(
+            solver.variables.params, path, strict_shapes=strict_shapes,
+            state=solver.variables.state,
         )
     except (OSError, ValueError, KeyError, struct.error) as e:
         # missing/corrupt/truncated file, wrong HDF5 layout, bad shapes
@@ -600,7 +601,7 @@ def _load_weights_into(
             f"{path}: no layers could be loaded (names or shapes do not "
             "match this net)"
         )
-    solver.variables = NetVars(params=params, state=solver.variables.state)
+    solver.variables = NetVars(params=params, state=state)
     return loaded
 
 

@@ -51,13 +51,18 @@ class DeployNet:
         self.network = Network(net_param, Phase.TEST)
         self.variables = self.network.init(jax.random.key(0))
         if pretrained_file is not None:
+            # state=... so BatchNorm statistics load too (Caffe keeps
+            # them in the same blobs_ vector as the weights; without
+            # this a zoo ResNet caffemodel scores garbage silently)
             if pretrained_file.endswith((".h5", ".hdf5", ".caffemodel.h5")):
-                params, _ = copy_hdf5_params(self.variables.params, pretrained_file)
+                params, state, _ = copy_hdf5_params(
+                    self.variables.params, pretrained_file,
+                    state=self.variables.state)
             else:
-                params, _ = copy_caffemodel_params(
-                    self.variables.params, pretrained_file
-                )
-            self.variables = NetVars(params=params, state=self.variables.state)
+                params, state, _ = copy_caffemodel_params(
+                    self.variables.params, pretrained_file,
+                    state=self.variables.state)
+            self.variables = NetVars(params=params, state=state)
         self._forward = self._jit_forward()
 
         shapes = self.network.feed_shapes()

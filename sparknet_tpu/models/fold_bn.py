@@ -64,11 +64,6 @@ def fold_batchnorm(net_param: Message, params: dict, state: dict
     continue training (the statistics are baked in).
     """
     layers = net_param.get_all("layer")
-    producer_of: dict[str, int] = {}
-    for i, lp in enumerate(layers):
-        for t in _tops(lp):
-            producer_of[t] = i
-
     new_params = {k: list(v) for k, v in params.items()}
     new_state = dict(state)
     drop: set[int] = set()
@@ -84,6 +79,13 @@ def fold_batchnorm(net_param: Message, params: dict, state: dict
         if not (len(bots) == 1 and tops == bots):
             i += 1
             continue  # not in-place: leave untouched
+        if not lp.get_msg("batch_norm_param").get_bool(
+                "use_global_stats", True):
+            # an explicit use_global_stats:false computes PER-BATCH
+            # statistics even at TEST time (ops/blocks.py apply) —
+            # baking the accumulated stats would change its scores
+            i += 1
+            continue
         blob = bots[0]
         # the producer must be the LAST writer of the blob before this
         # BN — with in-place chains that is simply the nearest earlier

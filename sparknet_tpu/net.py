@@ -58,12 +58,31 @@ class WeightCollection:
         return list(self.weights)
 
 
+_BN_BLOB_ORDER = ("mean", "variance", "scale_factor")
+
+
+def state_items(s: dict) -> list[tuple[str, Any]]:
+    """Deterministic blob order for a layer's state dict.
+
+    Serialization cannot rely on dict insertion order: jax pytrees sort
+    dict keys, so one jitted step reorders a BatchNorm state dict to
+    (mean, scale_factor, variance).  Caffe's BN blobs_ order is
+    [mean, variance, scale_factor] (ref: batch_norm_layer.cpp:30-38
+    LayerSetUp) — that exact order is the wire contract; any other
+    state dict serializes in sorted-key order.
+    """
+    if set(s) == set(_BN_BLOB_ORDER):
+        return [(k, s[k]) for k in _BN_BLOB_ORDER]
+    return sorted(s.items())
+
+
 def variables_to_collection(variables: NetVars) -> WeightCollection:
     out: dict[str, list[np.ndarray]] = {}
     for lname, plist in variables.params.items():
         out[lname] = [np.asarray(p) for p in plist]
     for lname, s in variables.state.items():
-        out.setdefault(lname, []).extend(np.asarray(v) for v in s.values())
+        out.setdefault(lname, []).extend(
+            np.asarray(v) for _, v in state_items(s))
     return WeightCollection(out)
 
 
@@ -80,27 +99,40 @@ def collection_to_variables(wc: WeightCollection, template: NetVars) -> NetVars:
         arrs = wc[lname][n_params:]
         state[lname] = {
             k: jnp.asarray(a, v.dtype).reshape(v.shape)
-            for (k, v), a in zip(s.items(), arrs)
+            for (k, v), a in zip(state_items(s), arrs)
         }
     return NetVars(params=params, state=state)
 
 
 def copy_caffemodel_params(
-    params: dict[str, list], path: str, strict_shapes: bool = True
-) -> tuple[dict[str, list], list[str]]:
+    params: dict[str, list], path: str, strict_shapes: bool = True,
+    state: dict[str, dict] | None = None,
+):
     """Copy a .caffemodel's blobs into a params pytree by layer name
     (CopyTrainedLayersFrom semantics, ref: net.cpp:737-805).  Returns
-    (new params, loaded layer names); source layers absent from the net
-    are ignored."""
+    (new params, loaded layer names) — or (new params, new state,
+    loaded) when ``state`` is given; source layers absent from the net
+    are ignored.
+
+    ``state``: the non-learnable state blobs (BatchNorm's
+    mean/variance/scale_factor).  Caffe keeps those in the SAME
+    ``blobs_`` vector the wire format serializes, appended after any
+    learnable blobs — without this, loading a zoo ResNet caffemodel
+    silently leaves zero statistics in place and every downstream score
+    (and any BN fold) is garbage."""
     from sparknet_tpu.proto.binary import load_caffemodel
 
     model = load_caffemodel(path)
     params = {k: list(v) for k, v in params.items()}
+    new_state = {k: dict(v) for k, v in (state or {}).items()}
     loaded = []
     for layer in model.layers:
-        if layer.name not in params or not layer.blobs:
+        t_params = params.get(layer.name)
+        t_state = new_state.get(layer.name) if state is not None else None
+        if (t_params is None and not t_state) or not layer.blobs:
             continue
-        target = params[layer.name]
+        s_items = state_items(t_state) if t_state else []
+        target = list(t_params or []) + [v for _, v in s_items]
         if len(layer.blobs) != len(target):
             if strict_shapes:
                 raise ValueError(
@@ -132,28 +164,42 @@ def copy_caffemodel_params(
             new.append(jnp.asarray(src, dst.dtype))
         if not ok:
             continue
-        params[layer.name] = new
+        n_p = len(t_params or [])
+        if t_params is not None:
+            params[layer.name] = new[:n_p]
+        if t_state:
+            new_state[layer.name] = dict(
+                zip((k for k, _ in s_items), new[n_p:]))
         loaded.append(layer.name)
+    if state is not None:
+        return params, new_state, loaded
     return params, loaded
 
 
 def copy_hdf5_params(
-    params: dict[str, list], path: str, strict_shapes: bool = True
-) -> tuple[dict[str, list], list[str]]:
+    params: dict[str, list], path: str, strict_shapes: bool = True,
+    state: dict[str, dict] | None = None,
+):
     """HDF5 variant of :func:`copy_caffemodel_params` (Caffe's
     ``data/<layer>/<i>`` group layout, ref: net.cpp:926+), with the same
     shape semantics: same-size blobs reshape (legacy fc layouts), a size
-    mismatch raises when ``strict_shapes`` else skips the layer."""
+    mismatch raises when ``strict_shapes`` else skips the layer.
+    ``state`` blobs follow the layer's params at the next indices, as in
+    the binary format (Caffe's blobs_ vector carries both)."""
     import h5py
 
     params = {k: list(v) for k, v in params.items()}
+    new_state = {k: dict(v) for k, v in (state or {}).items()}
     loaded = []
     with h5py.File(path, "r") as f:
         for lname in f["data"]:
-            if lname not in params:
+            t_params = params.get(lname)
+            t_state = new_state.get(lname) if state is not None else None
+            if t_params is None and not t_state:
                 continue
             g = f["data"][lname]
-            target = params[lname]
+            s_items = state_items(t_state) if t_state else []
+            target = list(t_params or []) + [v for _, v in s_items]
             arrs = [np.asarray(g[str(i)]) for i in range(len(g))]
             if len(arrs) != len(target):
                 if strict_shapes:
@@ -180,16 +226,28 @@ def copy_hdf5_params(
                 new.append(jnp.asarray(a.reshape(p.shape), p.dtype))
             if not ok:
                 continue
-            params[lname] = new
+            n_p = len(t_params or [])
+            if t_params is not None:
+                params[lname] = new[:n_p]
+            if t_state:
+                new_state[lname] = dict(
+                    zip((k for k, _ in s_items), new[n_p:]))
             loaded.append(lname)
+    if state is not None:
+        return params, new_state, loaded
     return params, loaded
 
 
-def export_caffemodel(network: Network, params: dict[str, list], path: str) -> str:
+def export_caffemodel(network: Network, params: dict[str, list], path: str,
+                      state: dict[str, dict] | None = None) -> str:
     """Write a params pytree as a wire-compatible binary NetParameter
     (ref: Net::ToProto net.cpp:911 + Solver::SnapshotToBinaryProto).
     Shared-param aliases write the owner's values, matching Caffe's
-    per-layer duplication of shared blobs."""
+    per-layer duplication of shared blobs.  ``state``: non-learnable
+    state blobs (BatchNorm mean/variance/scale_factor) appended after
+    the layer's params — Caffe keeps them in ``blobs_``, so a wire file
+    without them cannot round-trip a BN net (the zoo ships ResNet
+    caffemodels whose stats live exactly there)."""
     from sparknet_tpu.proto.binary import (
         CaffeModel,
         CaffeModelLayer,
@@ -199,33 +257,46 @@ def export_caffemodel(network: Network, params: dict[str, list], path: str) -> s
     layers = []
     type_by_name = {l.name: l.TYPE for l in network.layers}
     aliases = network.param_aliases
-    for lname, plist in params.items():
+    names = list(params)
+    names += [n for n in (state or {}) if n not in params]
+    for lname in names:
         blobs = []
-        for i, p in enumerate(plist):
+        for i, p in enumerate(params.get(lname, [])):
             owner = aliases.get((lname, i))
             if owner is not None:
                 p = params[owner[0]][owner[1]]
             blobs.append(np.asarray(p))
+        for _, v in state_items((state or {}).get(lname, {})):
+            blobs.append(np.asarray(v))
         layers.append(CaffeModelLayer(lname, type_by_name.get(lname, ""), blobs))
     save_caffemodel(path, CaffeModel(network.net_param.get_str("name", ""), layers))
     return path
 
 
-def export_hdf5(network: Network, params: dict[str, list], path: str) -> str:
+def export_hdf5(network: Network, params: dict[str, list], path: str,
+                state: dict[str, dict] | None = None) -> str:
     """HDF5 variant (ref: Net::ToHDF5 net.cpp:926+): group
-    ``data/<layer>/<i>`` per blob; shared aliases write the owner."""
+    ``data/<layer>/<i>`` per blob; shared aliases write the owner.
+    ``state`` blobs (BatchNorm statistics) follow the params at the next
+    indices, mirroring Caffe's blobs_ ordering."""
     import h5py
 
     aliases = network.param_aliases
+    names = list(params)
+    names += [n for n in (state or {}) if n not in params]
     with h5py.File(path, "w") as f:
         data = f.create_group("data")
-        for lname, plist in params.items():
+        for lname in names:
             g = data.create_group(lname)
-            for i, p in enumerate(plist):
+            i = -1
+            for i, p in enumerate(params.get(lname, [])):
                 owner = aliases.get((lname, i))
                 if owner is not None:
                     p = params[owner[0]][owner[1]]
                 g.create_dataset(str(i), data=np.asarray(p))
+            for j, (_, v) in enumerate(
+                    state_items((state or {}).get(lname, {})), start=i + 1):
+                g.create_dataset(str(j), data=np.asarray(v))
     return path
 
 
@@ -373,38 +444,41 @@ class TPUNet:
     # -- zoo interchange (ref: Net::ToProto net.cpp:911 + Snapshot; shim
     # save/load_weights_to/from_file ccaffe.cpp:261-269) -------------------
     def save_caffemodel(self, path: str) -> str:
-        """Write params as a wire-compatible binary NetParameter;
+        """Write params AND state blobs (BatchNorm statistics — Caffe
+        keeps them in blobs_) as a wire-compatible binary NetParameter;
         returns ``path`` (like ``Solver.save``)."""
         return export_caffemodel(
-            self.train_net, self.solver.variables.params, path
+            self.train_net, self.solver.variables.params, path,
+            state=self.solver.variables.state,
         )
 
     def load_caffemodel(self, path: str, strict_shapes: bool = True) -> list[str]:
         """Copy params by layer name (CopyTrainedLayersFrom semantics,
         ref: net.cpp:737-805): source layers absent from this net are
         ignored; blob-shape mismatch raises.  Returns loaded layer names."""
-        params, loaded = copy_caffemodel_params(
-            self.solver.variables.params, path, strict_shapes
+        params, state, loaded = copy_caffemodel_params(
+            self.solver.variables.params, path, strict_shapes,
+            state=self.solver.variables.state,
         )
-        self.solver.variables = NetVars(
-            params=params, state=self.solver.variables.state
-        )
+        self.solver.variables = NetVars(params=params, state=state)
         return loaded
 
     # -- HDF5 snapshots (ref: Net::ToHDF5/CopyTrainedLayersFromHDF5,
     # caffe/src/caffe/net.cpp:926 + util/hdf5.cpp) -------------------------
     def save_hdf5(self, path: str) -> None:
-        """Layout mirrors Caffe's: group ``data/<layer>/<i>`` per blob.
-        Shared-param aliases write the owner's values (Caffe duplicates
-        shared blobs per layer)."""
-        export_hdf5(self.train_net, self.solver.variables.params, path)
+        """Layout mirrors Caffe's: group ``data/<layer>/<i>`` per blob
+        (state blobs after params, as in blobs_).  Shared-param aliases
+        write the owner's values (Caffe duplicates shared blobs per
+        layer)."""
+        export_hdf5(self.train_net, self.solver.variables.params, path,
+                    state=self.solver.variables.state)
 
     def load_hdf5(self, path: str) -> list[str]:
         """Copy-by-layer-name with the same semantics as load_caffemodel."""
-        params, loaded = copy_hdf5_params(self.solver.variables.params, path)
-        self.solver.variables = NetVars(
-            params=params, state=self.solver.variables.state
-        )
+        params, state, loaded = copy_hdf5_params(
+            self.solver.variables.params, path,
+            state=self.solver.variables.state)
+        self.solver.variables = NetVars(params=params, state=state)
         return loaded
 
     # -- persistence (ref: Net.scala:234-240) ------------------------------

@@ -700,11 +700,13 @@ class Solver:
 
         if fmt == "BINARYPROTO":
             export_caffemodel(
-                self.train_net, self.variables.params, f"{prefix}.caffemodel"
+                self.train_net, self.variables.params,
+                f"{prefix}.caffemodel", state=self.variables.state,
             )
         else:  # validated to HDF5 at construction
             export_hdf5(
-                self.train_net, self.variables.params, f"{prefix}.caffemodel.h5"
+                self.train_net, self.variables.params,
+                f"{prefix}.caffemodel.h5", state=self.variables.state,
             )
 
     def restore(self, path: str) -> None:

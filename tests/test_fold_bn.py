@@ -162,3 +162,42 @@ def test_fold_after_quantize_raises(trained_resnet):
     dep.quantize_int8([feeds], num_batches=1)
     with pytest.raises(RuntimeError, match="BEFORE quantize_int8"):
         dep.fold_batchnorm()
+
+
+def test_caffemodel_roundtrip_preserves_bn_stats(trained_resnet, tmp_path):
+    """The interchange bug the fold surfaced: Caffe stores BN statistics
+    in the SAME blobs_ vector as weights, so the wire formats must carry
+    state blobs both ways — a round-tripped BN net scores identically."""
+    from sparknet_tpu.net import (
+        copy_caffemodel_params, copy_hdf5_params,
+        export_caffemodel, export_hdf5,
+    )
+
+    solver = trained_resnet
+    test_net = Network(solver.train_net.net_param, Phase.TEST)
+    rs = np.random.RandomState(4)
+    feeds = {"data": np.asarray(rs.randn(4, 3, 64, 64) * 40, np.float32),
+             "label": np.asarray(rs.randint(0, 5, 4), np.int32)}
+    ref, _, _ = test_net.apply(solver.variables, feeds, rng=None,
+                               train=False)
+
+    for ext, exp, cp in (
+        (".caffemodel", export_caffemodel, copy_caffemodel_params),
+        (".h5", export_hdf5, copy_hdf5_params),
+    ):
+        path = str(tmp_path / f"rt{ext}")
+        exp(solver.train_net, solver.variables.params, path,
+            state=solver.variables.state)
+        fresh = Network(solver.train_net.net_param, Phase.TRAIN)
+        v0 = fresh.init(jax.random.PRNGKey(9))
+        params, state, loaded = cp(v0.params, path, state=v0.state)
+        # BN stats actually landed (fresh init has scale_factor 0)
+        sf = next(s["scale_factor"] for s in state.values()
+                  if "scale_factor" in s)
+        assert float(np.asarray(sf)[0]) > 0, ext
+        out, _, _ = test_net.apply(
+            NetVars(params=params, state=state), feeds, rng=None,
+            train=False)
+        np.testing.assert_allclose(
+            np.asarray(out["fc1000"]), np.asarray(ref["fc1000"]),
+            rtol=1e-5, atol=1e-5, err_msg=ext)

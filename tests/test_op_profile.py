@@ -13,6 +13,8 @@ import os
 
 import numpy as np
 
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 from sparknet_tpu.utils.op_profile import (
     _device_events,
     aggregate_by_layer,
@@ -246,3 +248,42 @@ def test_gpu_style_stream_lanes_all_counted(tmp_path):
     assert total == 850.0  # both streams, no Steps aggregate
     assert per_layer["conv1"] == 600.0
     assert per_layer["(other)"] == 250.0
+
+
+def test_reparse_trace_rewrites_artifact(tmp_path):
+    """tools/reparse_trace.py: a banked artifact whose per-layer rows
+    came out wrong (the probe-40 parser bug) is re-derived offline from
+    its raw trace dir — iters honored, wall fallback to the untraced
+    stage, reparse provenance stamped."""
+    import json as _json
+    import subprocess
+    import sys as _sys
+
+    root = _write_tpu_style_trace(
+        tmp_path,
+        lanes={1: "Steps", 3: "XLA Ops"},
+        ops=[
+            (1, "0", "", 1000.0),
+            (3, "fusion.1", "jit(step)/jvp(L.ip)/dot_general:", 800.0),
+            (3, "fusion.2", "", 200.0),
+        ])
+    art = tmp_path / "trace.artifact.json"
+    art.write_text(_json.dumps({
+        "stage": "wall_timed",  # wedge-truncated: no final wall banked
+        "iters": 2,
+        "wall_ms_per_step_untraced": 0.6,
+        "rows": [["(other)", 3000.0]],  # the triple-counted bad parse
+        "attributed_frac": 0.0,
+        "trace_dir": root,
+    }))
+    out = subprocess.run(
+        [_sys.executable,
+         os.path.join(ROOT, "tools", "reparse_trace.py"), str(art)],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    a = _json.loads(art.read_text())
+    rows = dict((n, us) for n, us in a["rows"])
+    assert rows["ip"] == 400.0          # 800 us over iters=2
+    assert a["device_us_per_step"] == 500.0  # op lane only, per step
+    assert a["attributed_frac"] == 0.8
+    assert a["reparse_note"]

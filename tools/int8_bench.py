@@ -28,6 +28,10 @@ def main() -> int:
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (cpu for offline checks)")
+    ap.add_argument("--fold-bn", action="store_true",
+                    help="fold BatchNorm/Scale chains before measuring "
+                    "(required for BN nets like resnet50; the float arm "
+                    "then measures the folded forward)")
     ap.add_argument("--out", default="docs/int8_bench_last.json")
     args = ap.parse_args()
 
@@ -45,7 +49,8 @@ def main() -> int:
     on_accel = jax.devices()[0].platform != "cpu"
     if on_accel:
         set_config(compute_dtype=jnp.bfloat16)
-    crop = {"alexnet": 227, "caffenet": 227, "googlenet": 224}[args.model]
+    crop = {"alexnet": 227, "caffenet": 227, "googlenet": 224,
+            "resnet50": 224, "vgg16": 224}[args.model]
     B = args.batch if on_accel else 8
     iters = args.iters if on_accel else 2
 
@@ -84,6 +89,21 @@ def main() -> int:
         return rec
 
     results = [measure("float", None)]
+    if args.fold_bn:
+        # merge_bn (models/fold_bn.py): the folded-float arm measures
+        # what deleting the BN/Scale passes buys on its own, and BN
+        # nets must be in folded (pure Conv/IP) form before int8
+        # calibration anyway
+        from sparknet_tpu.compiler.graph import NetVars
+        from sparknet_tpu.models.fold_bn import fold_batchnorm
+
+        net_p2, params2, state2, folded = fold_batchnorm(
+            net.net_param, variables.params, variables.state)
+        print(json.dumps({"fold_bn": len(folded)}), flush=True)
+        if folded:
+            net = Network(net_p2, Phase.TEST)
+            variables = NetVars(params=params2, state=state2)
+            results.append(measure("float_folded", None))
     qstate = quant.calibrate(net, variables, [feeds])
     results.append(measure("int8", quant.quantized_inference(qstate)))
 
