@@ -600,3 +600,48 @@ def test_moe_layer_expert_sharded_tp():
         jax.tree_util.tree_leaves(plain.variables.params),
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_tau_round_averages_bn_state():
+    """tau>1 + BatchNorm: each worker's tau local steps accumulate
+    DIFFERENT moving statistics on its own shard; the round-end sync
+    must average state along with params (trainer.py pmean over the
+    full NetVars) or eval-time stats silently diverge per replica."""
+    from sparknet_tpu.layers_dsl import BatchNormLayer, ScaleLayer
+
+    tau, per_dev = 3, 4
+    net = NetParam(
+        "bn_tau",
+        RDDLayer("data", shape=[per_dev, 3, 8, 8]),
+        RDDLayer("label", shape=[per_dev]),
+        ConvolutionLayer("conv", ["data"], kernel=(3, 3), num_output=8,
+                         pad=(1, 1), bias_term=False),
+        BatchNormLayer("bn", ["conv"], moving_average_fraction=0.9),
+        ScaleLayer("scale", ["conv"]),
+        ReLULayer("relu", ["conv"], in_place=True),
+        InnerProductLayer("ip", ["conv"], num_output=4),
+        SoftmaxWithLoss("loss", ["ip", "label"]),
+    )
+    cfg = SolverConfig(base_lr=0.01, momentum=0.9)
+    tr = ParallelTrainer(Solver(cfg, net), tau=tau)
+    rs = np.random.RandomState(0)
+    B = per_dev * 8
+
+    def data_fn(it):
+        return {
+            "data": (rs.randn(tau, B, 3, 8, 8) * 20).astype(np.float32),
+            "label": rs.randint(0, 4, (tau, B)).astype(np.int32),
+        }
+
+    loss = tr.train(2, data_fn)
+    assert np.isfinite(loss)
+    # BN state is per-replica stacked [8, ...]: after the round-end
+    # average every replica must hold the SAME statistics, and they
+    # must be non-zero (the workers really accumulated)
+    bn = {k: np.asarray(v) for k, v in tr.variables.state["bn"].items()}
+    for name, arr in bn.items():
+        assert arr.shape[0] == 8, (name, arr.shape)
+        for r in range(1, 8):
+            np.testing.assert_allclose(arr[r], arr[0], atol=1e-6,
+                                       err_msg=name)
+    assert float(bn["scale_factor"][0][0]) > 0
