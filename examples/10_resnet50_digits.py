@@ -33,6 +33,10 @@ def main() -> int:
                     help="plumbing check: few steps, finiteness instead "
                     "of the accuracy bar (CI; the full run is the "
                     "convergence evidence)")
+    ap.add_argument("--int8", action="store_true",
+                    help="after folding, also score the int8-PTQ net "
+                    "(quant.py): the fold+quantize deploy pipeline on a "
+                    "properly trained BN net")
     args = ap.parse_args()
     if args.smoke:
         args.steps, args.batch = min(args.steps, 4), min(args.batch, 4)
@@ -128,10 +132,40 @@ def main() -> int:
     folded_acc = hits / tot
     print(f"folded ({len(folded)} BN chains merged): accuracy {folded_acc:.3f}")
 
-    ok = (after["accuracy"] >= 0.90
-          and abs(folded_acc - after["accuracy"]) < 0.01)
-    print("PASS" if ok else "FAIL (expected >=0.90 and fold parity)")
-    return 0 if ok else 1
+    int8_ok = True
+    if args.int8:
+        # fold + int8 PTQ: per-tensor scales calibrated on one training
+        # batch, per-channel int8 weights — the MXU deploy pipeline on a
+        # net with REAL margins (quantization noise flips argmax only
+        # near ties, so a well-trained net holds its accuracy)
+        from sparknet_tpu import quant
+
+        calib = {k: jnp.asarray(v) for k, v in train_fn(0).items()}
+        qstate = quant.calibrate(folded_net, v2, [calib])
+        qfwd = jax.jit(lambda v, f: folded_net.apply(
+            v, f, rng=None, train=False)[0])
+        hits = tot = 0
+        with quant.quantized_inference(qstate):
+            for b in range(n_test):
+                feed = test_fn(b)
+                outs = qfwd(v2, {k: jnp.asarray(v)
+                                 for k, v in feed.items()})
+                hits += int((np.asarray(outs["fc1000"]).argmax(1)
+                             == feed["label"]).sum())
+                tot += len(feed["label"])
+        int8_acc = hits / tot
+        print(f"folded + int8 PTQ: accuracy {int8_acc:.3f}")
+        int8_ok = int8_acc >= 0.85
+
+    bars = {
+        "accuracy >= 0.90": after["accuracy"] >= 0.90,
+        "fold parity": abs(folded_acc - after["accuracy"]) < 0.01,
+    }
+    if args.int8:
+        bars["int8 >= 0.85"] = int8_ok
+    failed = [name for name, held in bars.items() if not held]
+    print("PASS" if not failed else f"FAIL ({', '.join(failed)})")
+    return 0 if not failed else 1
 
 
 if __name__ == "__main__":
