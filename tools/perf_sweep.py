@@ -22,7 +22,8 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def run_variant(dtype: str, batch: int, timeout: int = 900) -> dict:
+def run_variant(dtype: str, batch: int, timeout: int = 900,
+                model: str = "") -> dict:
     # sweep variants are single measurements: no per-variant extra
     # protocol, and a wedged tunnel should fail the variant after one
     # probe attempt instead of eating the timeout in retries
@@ -32,6 +33,8 @@ def run_variant(dtype: str, batch: int, timeout: int = 900) -> dict:
     env = dict(os.environ, SPARKNET_BENCH_DTYPE=dtype,
                SPARKNET_BENCH_BATCH=str(batch), SPARKNET_BENCH_EXTRA="0",
                SPARKNET_BENCH_RECORD_LAST="0")
+    if model:
+        env["SPARKNET_BENCH_MODEL"] = model
     env.setdefault("SPARKNET_BENCH_PROBE_ATTEMPTS", "1")
     try:
         out = subprocess.run(
@@ -62,6 +65,9 @@ def run_variant(dtype: str, batch: int, timeout: int = 900) -> dict:
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--model", default="",
+                    help="alexnet (default) | caffenet | googlenet | "
+                    "resnet50 | vgg16")
     args = ap.parse_args()
 
     variants = (
@@ -72,7 +78,7 @@ def main() -> None:
     )
     results = []
     for dtype, batch in variants:
-        rec = run_variant(dtype, batch)
+        rec = run_variant(dtype, batch, model=args.model)
         results.append(rec)
         print(json.dumps(rec), flush=True)
 
