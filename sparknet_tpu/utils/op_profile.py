@@ -25,7 +25,7 @@ from collections import defaultdict
 _SCOPE = re.compile(r"\bL\.([\w.\-]+)")
 
 
-def trace_step(step_fn, args, iters: int) -> dict:
+def trace_step(step_fn, args, iters: int, thread_fn=None) -> dict:
     """One traced segment: run ``step_fn(*args)`` ``iters`` times under
     the profiler.  Returns {"events", "wall_step_us", "trace_dir"}.
 
@@ -35,6 +35,14 @@ def trace_step(step_fn, args, iters: int) -> dict:
     longer one — profiler starts have twice coincided with relay wedges
     (docs/TUNNEL_LOG_r3.md), so every stop_trace must leave a durable
     artifact behind it.
+
+    ``thread_fn(args, out) -> args``: feeds each call's output back into
+    the next call's arguments, so no two dispatches carry identical
+    args (one of the two relay timing traps — see
+    ``common.value_fence``).  Solver-step callers pass
+    ``lambda a, o: (o[0], o[1]) + a[2:]`` to thread (variables, slots);
+    the ``wall_step_us`` of an un-threaded run is NOT trustworthy on a
+    relay backend (the device-event table still is).
     """
     import time
 
@@ -49,6 +57,8 @@ def trace_step(step_fn, args, iters: int) -> dict:
         out = None
         for _ in range(iters):
             out = step_fn(*args)
+            if thread_fn is not None:
+                args = thread_fn(args, out)
         value_fence(out)
         wall = (time.perf_counter() - t0) / iters
     finally:
@@ -57,15 +67,24 @@ def trace_step(step_fn, args, iters: int) -> dict:
         "events": _device_events(tmp),
         "wall_step_us": wall * 1e6,
         "trace_dir": tmp,
+        # threaded end state, so a FOLLOW-UP traced segment can seed its
+        # first dispatch from here instead of repeating this one's
+        "final_args": args,
     }
 
 
-def profile_step(step_fn, args, iters: int = 5) -> dict:
-    """Warm up once (outside the trace), then one traced segment."""
+def profile_step(step_fn, args, iters: int = 5, thread_fn=None) -> dict:
+    """Warm up once (outside the trace), then one traced segment.  Pass
+    ``thread_fn`` (see ``trace_step``) whenever timing on a relay
+    backend — the warm call's output seeds the traced segment's args so
+    no traced dispatch repeats the warm one."""
     from sparknet_tpu.common import value_fence
 
-    value_fence(step_fn(*args))
-    return trace_step(step_fn, args, iters)
+    out = step_fn(*args)
+    value_fence(out)
+    if thread_fn is not None:
+        args = thread_fn(args, out)
+    return trace_step(step_fn, args, iters, thread_fn=thread_fn)
 
 
 def _device_events(log_dir: str) -> list[tuple[str, float]]:
@@ -196,10 +215,13 @@ def aggregate_fwd_bwd(
     return {k: (f / iters, b / iters) for k, (f, b) in split.items()}
 
 
-def layer_time_table(step_fn, args, layer_names, iters: int = 5) -> dict:
+def layer_time_table(step_fn, args, layer_names, iters: int = 5,
+                     thread_fn=None) -> dict:
     """The ``tpunet time --trace`` payload: per-layer device µs/step (in
-    net order, then the rest), total device time, and wall step time."""
-    prof = profile_step(step_fn, args, iters)
+    net order, then the rest), total device time, and wall step time.
+    ``thread_fn`` as in ``trace_step`` — required for trustworthy wall
+    numbers on a relay backend."""
+    prof = profile_step(step_fn, args, iters, thread_fn=thread_fn)
     return table_from_trace(prof, layer_names, iters)
 
 

@@ -159,13 +159,26 @@ def test_trace_report_renders_rows(tmp_path):
                "batch": 256, "dtype": "bf16",
                "wall_ms_per_step_untraced": 20.5,
                "img_per_sec_untraced": 12500.0,
-               "gflop_per_step": 986.0, "hbm_gb_per_step": 12.3}
+               "gflop_per_step": 986.0, "hbm_gb_per_step": 12.3,
+               "fence_protocol": "loss-value+threaded-args"}
     p = tmp_path / "b.json"
     p.write_text(json.dumps(partial))
     out = subprocess.run(
         [sys.executable, tool, str(p)],
         capture_output=True, text=True, check=True).stdout
     assert "No per-layer rows banked" in out and "20.500 ms" in out
+
+    # an UNSTAMPED untraced wall (pre-round-5 artifact) is refused with
+    # an explanatory note, not silently rendered — the unstamped fence
+    # banked physically impossible walls (VERDICT r4 §weak 1)
+    del partial["fence_protocol"]
+    p = tmp_path / "c.json"
+    p.write_text(json.dumps(partial))
+    out = subprocess.run(
+        [sys.executable, tool, str(p)],
+        capture_output=True, text=True, check=True).stdout
+    assert "20.500 ms" not in out
+    assert "no `fence_protocol` stamp" in out
 
 
 def _write_tpu_style_trace(tmp_path, lanes, ops):

@@ -69,19 +69,40 @@ def main() -> int:
     def measure(label, ctx):
         import contextlib
 
+        from jax import lax
+
         from sparknet_tpu.common import value_fence as fence
 
-        def run(fn):
-            out = fn(variables, feeds)
-            fence(out)
+        def run(apply_fn):
+            # All ``iters`` forwards fused into ONE lax.scan dispatch,
+            # chained through a numerically-negligible carry (logit[0]
+            # * 1e-24 added to the input — absorbed exactly by f32 at
+            # data magnitude ~50, but XLA cannot elide the dependence),
+            # and salted so the warm and timed dispatches never carry
+            # identical args.  Defends against both relay timing traps
+            # (see common.value_fence): the first int8 attempt banked
+            # 8.2M img/s off exactly these.
+            def chained(v, f, salt):
+                def body(carry, _):
+                    f2 = dict(f)
+                    f2["data"] = f["data"] + (carry * 1e-24).astype(
+                        f["data"].dtype)
+                    logits = apply_fn(v, f2)
+                    return logits.astype(jnp.float32).ravel()[0], None
+
+                s, _ = lax.scan(body, jnp.float32(salt), None,
+                                length=iters)
+                return s
+
+            cfn = jax.jit(chained)
+            fence(cfn(variables, feeds, 0.0))  # warm: full chain once
             t0 = time.perf_counter()
-            for _ in range(iters):
-                out = fn(variables, feeds)
+            out = cfn(variables, feeds, 1.0)
             fence(out)
             return B * iters / (time.perf_counter() - t0)
 
         with ctx or contextlib.nullcontext():
-            img_s = run(jax.jit(lambda v, f: fwd(v, f)))
+            img_s = run(lambda v, f: fwd(v, f))
         rec = {"metric": f"{args.model}_deploy_forward_img_s", "arm": label,
                "value": round(img_s, 1), "batch": B, "iters": iters,
                "platform": jax.devices()[0].platform, "measured": True}

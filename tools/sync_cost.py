@@ -18,7 +18,11 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
@@ -27,8 +31,6 @@ def main():
     ap.add_argument("--devices", type=int, default=8)
     ap.add_argument("--iters", type=int, default=20)
     args = ap.parse_args()
-
-    import os
 
     if args.platform == "cpu":
         flags = os.environ.get("XLA_FLAGS", "")
@@ -82,17 +84,34 @@ def main():
             ),
             variables.params,
         )
-        avg = jax.jit(
-            lambda t: jax.tree_util.tree_map(lambda x: x.mean(0), t)
-        )
+        # Each dispatch consumes the previous dispatch's DEVICE-side
+        # probe scalar as a perturbation of the first leaf: the calls
+        # form a serial value chain (no two carry identical args, no
+        # host round-trip inside the timed loop), and the probe sums
+        # one element of EVERY averaged leaf so none is dead code.  The
+        # 1e-6 scale is representable against ~1e-1 params (a smaller
+        # epsilon would be absorbed by f32, leaving the probe constant
+        # and the chain fake); the single end-of-loop fence fetches the
+        # probe VALUE (relay timing traps — see common.value_fence).
+        def avg_fn(t, salt):
+            leaves, treedef = jax.tree_util.tree_flatten(t)
+            outs = []
+            for i, x in enumerate(leaves):
+                if i == 0:
+                    x = x + (salt * 1e-6).astype(x.dtype)
+                outs.append(x.mean(0))
+            probe = sum(o.ravel()[0].astype(jnp.float32) for o in outs)
+            return jax.tree_util.tree_unflatten(treedef, outs), probe
+
+        avg = jax.jit(avg_fn)
         from sparknet_tpu.common import value_fence as fence
 
-        out = avg(stacked)
-        fence(out)
+        _, probe = avg(stacked, jnp.float32(0.0))  # warm
+        fence(probe)
         t0 = time.perf_counter()
         for _ in range(args.iters):
-            out = avg(stacked)
-        fence(out)
+            _, probe = avg(stacked, probe)
+        fence(probe)
         dt = (time.perf_counter() - t0) / args.iters
 
         analytic_ici_ms = 2 * nbytes * (p - 1) / p / ICI_BW * 1e3

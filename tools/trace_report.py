@@ -23,12 +23,40 @@ def render(a: dict) -> str:
     lines.append("")
     lines.append(f"Stage banked: **{a.get('stage', '?')}** "
                  f"({a.get('utc', '?')}, {a.get('device_kind') or a.get('platform', '?')}).")
-    wall = a.get("wall_ms_per_step") or a.get("wall_ms_per_step_untraced")
+    wall = a.get("wall_ms_per_step")
+    mfu = a.get("mfu")
+    img_s = a.get("img_per_sec")
+    # Untraced-wall fallback is accepted ONLY from artifacts stamped with
+    # the repaired fence protocol: the round-4 artifacts' unfenced
+    # "untraced" fields were physically impossible (7,860% MFU —
+    # VERDICT r4 §weak 1) and scrubbed artifacts carry them quarantined
+    # under `invalid_fence` instead.
+    refused_untraced = False
+    if not wall and a.get("wall_ms_per_step_untraced") is not None:
+        if a.get("fence_protocol") and not a.get("invalid_fence"):
+            wall = a.get("wall_ms_per_step_untraced")
+            mfu = a.get("mfu_untraced")
+            img_s = a.get("img_per_sec_untraced")
+        else:
+            refused_untraced = True
+    if a.get("invalid_fence"):
+        lines.append("")
+        lines.append("**Note:** this artifact's stage-2 'untraced wall' "
+                     "fields were banked with the broken pre-round-5 "
+                     "fence and are quarantined (`invalid_fence`); only "
+                     "trace-derived numbers below are evidence.")
+    elif refused_untraced:
+        lines.append("")
+        lines.append("**Note:** this artifact carries an untraced wall "
+                     "but no `fence_protocol` stamp (pre-round-5 tool) — "
+                     "the value is withheld here because the unstamped "
+                     "fence banked physically impossible walls on the "
+                     "relay backend (see docs/BENCHMARKS.md, round-5 "
+                     "fence postmortem).")
     if wall:
-        mfu = a.get("mfu") or a.get("mfu_untraced")
         lines.append(
             f"Step: **{wall:.3f} ms** "
-            f"({a.get('img_per_sec') or a.get('img_per_sec_untraced', 0):,.0f} img/s), "
+            f"({img_s or 0:,.0f} img/s), "
             f"{a.get('gflop_per_step', 0):.0f} GFLOP, "
             f"{a.get('hbm_gb_per_step', 0):.2f} GB HBM"
             + (f", MFU {mfu:.3f} vs {a.get('mfu_vs_peak')}" if mfu else "") + ".")
