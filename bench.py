@@ -55,6 +55,23 @@ def _env_float(name: str, default: float) -> float:
         raise SystemExit(f"{name} must be a number (got {raw!r})") from None
 
 
+def _parse_compiler_options(env_val: str) -> dict:
+    """Parse SPARKNET_BENCH_COMPILER_OPTIONS ("k=v,k2=v2").  Called once
+    at startup so a malformed value dies BEFORE the probe — a typo must
+    cost zero chip time — and again in _build_step for the values."""
+    opts = {}
+    for kv in env_val.split(","):
+        if not kv.strip():
+            continue
+        if "=" not in kv:
+            raise SystemExit(
+                "SPARKNET_BENCH_COMPILER_OPTIONS entries must be "
+                f"key=value (got {kv!r})")
+        k, v = kv.split("=", 1)
+        opts[k.strip()] = v.strip()
+    return opts
+
+
 def _env_int(name: str, default: int) -> int:
     raw = os.environ.get(name, "")
     if not raw:
@@ -188,11 +205,49 @@ def _build_step(batch: int, model: str, crop: int, dtype_name: str,
         step, variables, slots, key = solver.jitted_train_step(donate=True)
 
     rs = np.random.RandomState(0)
-    feeds = {
+    feeds = jax.device_put({
         "data": jnp.asarray(rs.randn(batch, 3, crop, crop) * 50, jnp.float32),
         "label": jnp.asarray(rs.randint(0, 1000, batch), jnp.int32),
-    }
-    return step, variables, slots, key, jax.device_put(feeds)
+    })
+
+    # A/B knob: per-compile XLA options ("k=v,k2=v2"), shipped through
+    # the PJRT Compile call to the SERVER-side TPU compiler.  This is
+    # the only route for TPU-compiler flags on the relay client:
+    # XLA_FLAGS is parsed by the LOCAL (CPU) XLA build, which fatals on
+    # unknown flags (docs/evidence_r4/alexnet_vmem_flag_ab.txt —
+    # --xla_tpu_scoped_vmem_limit_kib killed the process in 5.3 s
+    # before any dial).  An option the server also rejects fails the
+    # job with a clean INVALID_ARGUMENT — an A/B verdict either way.
+    # Skipped on CPU (the cost-model proxy would reject TPU-only
+    # options) unless the accel-path rehearsal knob is on.
+    copts_env = os.environ.get("SPARKNET_BENCH_COMPILER_OPTIONS", "")
+    if copts_env and (jax.devices()[0].platform != "cpu"
+                      or os.environ.get(
+                          "SPARKNET_BENCH_FORCE_ACCEL_PATH") == "1"):
+        opts = _parse_compiler_options(copts_env)
+
+        class _OptStep:
+            """Timed calls run the options-compiled executable; .lower
+            stays on the jit wrapper so measured_run's post-run cost
+            analysis (roofline/MFU + the never-above-bound guard) keeps
+            working.  That analysis then describes the DEFAULT compile —
+            the right bound regardless: compiler options cannot move the
+            hardware roofline."""
+
+            def __init__(self, jitted, compiled):
+                self._jitted, self._compiled = jitted, compiled
+
+            def __call__(self, *a):
+                return self._compiled(*a)
+
+            def lower(self, *a, **k):
+                return self._jitted.lower(*a, **k)
+
+        step = _OptStep(
+            step,
+            step.lower(variables, slots, 0, feeds, key).compile(
+                compiler_options=opts))
+    return step, variables, slots, key, feeds
 
 
 def measured_run(batch: int, iters: int, warmup: int, model: str, crop: int,
@@ -480,6 +535,9 @@ def main() -> int:
     import threading
 
     model, crop = _bench_params()
+    # fail fast on a malformed A/B options string — before any dial
+    _parse_compiler_options(
+        os.environ.get("SPARKNET_BENCH_COMPILER_OPTIONS", ""))
     # forced-CPU detection must cover BOTH routes: the env var and the
     # jax.config route (the CLI's --platform flag and site hooks pin the
     # platform through config, which outranks the env var).  Importing

@@ -23,7 +23,11 @@ Only canonical in-place chains fold — Conv/InnerProduct producing blob
 B, then BatchNorm in-place on B, optionally followed by Scale in-place
 on B with a per-channel (C,) gamma.  Anything else (bottom-supplied
 scale, axis != 1, non-in-place wiring) is left untouched: the fold is
-an optimization, not a requirement.
+an optimization, not a requirement.  Producers whose weights are
+SHARED across layers (``param { name: ... }`` declared by more than
+one layer — siamese towers, ref: net.cpp:470+ AppendParam) are also
+skipped: baking one branch's BN statistics into a shared blob would
+silently change every other reader's output.
 """
 
 from __future__ import annotations
@@ -69,6 +73,16 @@ def fold_batchnorm(net_param: Message, params: dict, state: dict
     drop: set[int] = set()
     folded: list[str] = []
 
+    # param names declared by MORE THAN ONE layer = shared blobs
+    # (net.cpp AppendParam): a producer carrying one must not be folded
+    counts: dict[str, int] = {}
+    for l in layers:
+        for pm in l.get_all("param"):
+            n = pm.get_str("name", "")
+            if n:
+                counts[n] = counts.get(n, 0) + 1
+    shared_names = {n for n, c in counts.items() if c > 1}
+
     i = 0
     while i < len(layers):
         lp = layers[i]
@@ -99,6 +113,10 @@ def fold_batchnorm(net_param: Message, params: dict, state: dict
         if prod.get_str("type") not in _FOLDABLE_PRODUCERS:
             i += 1
             continue
+
+        def _has_shared(l: Message) -> bool:
+            return any(pm.get_str("name", "") in shared_names
+                       for pm in l.get_all("param"))
         if any(blob in _bottoms(l) for l in layers[prod_idx + 1:i]):
             # an intermediate layer reads the RAW pre-BN activation
             # (execution order = layer order for in-place chains);
@@ -126,6 +144,17 @@ def fold_batchnorm(net_param: Message, params: dict, state: dict
                 if len(s_params) > 1:
                     beta = np.asarray(s_params[1], np.float64)
                 scale_idx = i + 1
+
+        if (_has_shared(prod) or _has_shared(lp)
+                or (scale_idx is not None
+                    and _has_shared(layers[scale_idx]))):
+            # shared blobs (param{} aliasing, siamese towers): rewriting
+            # the producer would bake THIS branch's BN stats into
+            # weights another layer reads, and DROPPING a BN/Scale that
+            # owns a shared blob would orphan its aliases' 0-size
+            # placeholders — skip the whole chain
+            i += 1
+            continue
 
         pname = prod.get_str("name")
         blobs = new_params[pname]

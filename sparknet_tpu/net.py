@@ -201,6 +201,13 @@ def copy_hdf5_params(
             s_items = state_items(t_state) if t_state else []
             target = list(t_params or []) + [v for _, v in s_items]
             arrs = [np.asarray(g[str(i)]) for i in range(len(g))]
+            if not arrs:
+                # legacy export: parameter-less layers (BatchNorm before
+                # state rode the wire formats) wrote an EMPTY group —
+                # degrade to the old skip-with-current-stats behavior,
+                # mirroring the binary loader's `not layer.blobs` skip,
+                # instead of a strict-shape failure on old snapshots
+                continue
             if len(arrs) != len(target):
                 if strict_shapes:
                     raise ValueError(

@@ -201,3 +201,68 @@ def test_caffemodel_roundtrip_preserves_bn_stats(trained_resnet, tmp_path):
         np.testing.assert_allclose(
             np.asarray(out["fc1000"]), np.asarray(ref["fc1000"]),
             rtol=1e-5, atol=1e-5, err_msg=ext)
+
+
+SHARED_TOWERS = """
+name: "shared_towers"
+layer { name: "data" type: "MemoryData" top: "data" top: "label"
+        memory_data_param { batch_size: 2 channels: 3 height: 8 width: 8 } }
+layer { name: "convA" type: "Convolution" bottom: "data" top: "a"
+        param { name: "wshared" }
+        convolution_param { num_output: 4 kernel_size: 3 bias_term: false
+                            weight_filler { type: "gaussian" std: 0.1 } } }
+layer { name: "bnA" type: "BatchNorm" bottom: "a" top: "a" }
+layer { name: "scA" type: "Scale" bottom: "a" top: "a"
+        scale_param { bias_term: true } }
+layer { name: "convB" type: "Convolution" bottom: "data" top: "b"
+        param { name: "wshared" }
+        convolution_param { num_output: 4 kernel_size: 3 bias_term: false
+                            weight_filler { type: "gaussian" std: 0.1 } } }
+"""
+
+
+def test_shared_param_producer_is_not_folded():
+    """A producer whose weight blob is SHARED (param{name} declared by
+    two layers, siamese-style) must be skipped: folding would bake one
+    branch's BN statistics into a blob the other branch still reads
+    (round-4 advisor finding)."""
+    from sparknet_tpu.proto import parse
+
+    net = parse(SHARED_TOWERS)
+    n = Network(net, Phase.TRAIN)
+    v = n.init(jax.random.PRNGKey(0))
+    net2, _, _, folded = fold_batchnorm(net, v.params, v.state)
+    assert folded == []
+    assert len(net2.get_all("layer")) == len(net.get_all("layer"))
+
+    # control: the identical chain WITHOUT the sharing folds
+    solo = parse(SHARED_TOWERS.replace('param { name: "wshared" }', ""))
+    n2 = Network(solo, Phase.TRAIN)
+    v2 = n2.init(jax.random.PRNGKey(0))
+    _, _, _, folded2 = fold_batchnorm(solo, v2.params, v2.state)
+    assert folded2 == ["convA <- bnA + scA"]
+
+
+def test_shared_scale_gamma_is_not_folded():
+    """The guard must also cover the DROPPED layers: a Scale whose gamma
+    is shared (owner of a param{name} another layer aliases) cannot be
+    folded away — deleting the owner's arrays would orphan the alias's
+    0-size placeholder."""
+    from sparknet_tpu.proto import parse
+
+    net_txt = SHARED_TOWERS.replace('param { name: "wshared" }', "")
+    net_txt = net_txt.replace(
+        'layer { name: "scA" type: "Scale" bottom: "a" top: "a"\n'
+        '        scale_param { bias_term: true } }',
+        'layer { name: "scA" type: "Scale" bottom: "a" top: "a"\n'
+        '        param { name: "gshared" }\n'
+        '        scale_param { bias_term: true } }')
+    net_txt += ('layer { name: "scB" type: "Scale" bottom: "b" top: "bs"\n'
+                '        param { name: "gshared" }\n'
+                '        scale_param { bias_term: true } }\n')
+    net = parse(net_txt)
+    n = Network(net, Phase.TRAIN)
+    v = n.init(jax.random.PRNGKey(0))
+    net2, params2, _, folded = fold_batchnorm(net, v.params, v.state)
+    assert folded == []
+    assert len(net2.get_all("layer")) == len(net.get_all("layer"))
