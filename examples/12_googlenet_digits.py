@@ -1,0 +1,115 @@
+"""GoogLeNet on real pixels: both auxiliary towers train end-to-end.
+
+The last zoo family without a convergence demonstration (LeNet 98.4%,
+ResNet-50 94.3%, VGG-16 95.2% — docs/CONVERGENCE.md).  GoogLeNet is the
+compiler stress test (9 inception blocks, a 3-way DAG per block) and the
+one net whose TRAINING semantics include weighted auxiliary losses: the
+published recipe sums loss3 + 0.3*loss1 + 0.3*loss2 from two mid-network
+classifier towers (ref: caffe/models/bvlc_googlenet/train_val.prototxt
+loss_weight 0.3 at the loss1/loss and loss2/loss heads).  This
+walkthrough shows all three heads learning together on sklearn's bundled
+handwritten digits — the same real-pixel corpus examples/05/10/11 use —
+upscaled 8->96 so every published kernel stays shape-valid (96 is the
+smallest multiple of 32 that keeps the aux towers' 5x5/3 average pools
+alive; pool5 is sized crop/32, the published 7x7 == 224/32 global-avg
+intent).
+
+What the run demonstrates:
+
+- top-1 >= 90% on held-out digits within the default 150 steps;
+- BOTH aux losses decrease alongside the main head — the 0.3-weighted
+  gradient paths through inception_4a/4d are live, which is exactly the
+  semantic `caffe train` exercises and a forward-only check cannot.
+
+Run:
+
+    python examples/12_googlenet_digits.py [--steps 150]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=150)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--platform", default="cpu")
+    ap.add_argument("--smoke", action="store_true",
+                    help="plumbing check: few steps, finiteness instead "
+                    "of the accuracy bar (CI; the full run is the "
+                    "convergence evidence)")
+    args = ap.parse_args()
+    if args.smoke:
+        args.steps, args.batch = min(args.steps, 2), min(args.batch, 2)
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    import numpy as np
+
+    from sparknet_tpu.data.digits import load_digits_dataset, minibatch_fn
+    from sparknet_tpu.models import zoo
+    from sparknet_tpu.solvers.solver import Solver
+
+    crop = 96  # smallest 32-multiple keeping the aux 5x5/3 pools valid
+    xtr, ytr, xte, yte = load_digits_dataset(upscale=crop)
+    # grayscale -> 3-channel at unit-ish scale: the zoo's xavier fillers
+    # are variance-preserving, same reasoning as examples/11's msra path
+    prep = lambda x: np.repeat(x, 3, axis=1) / 8.0 - 0.5  # noqa: E731
+    xtr, xte = prep(xtr), prep(xte)
+
+    # Adam + fixed lr for the short schedule (the published quick_solver
+    # polynomial decay assumes ImageNet-scale epochs — examples/11 made
+    # the same trade); dropout ratios stay the published 0.7/0.7/0.4.
+    cfg = dataclasses.replace(
+        zoo.googlenet_solver(),
+        base_lr=3e-4, solver_type="Adam", momentum=0.9, momentum2=0.999,
+        lr_policy="fixed", weight_decay=0.0,
+        max_iter=args.steps, display=10,
+    )
+    solver = Solver(cfg, zoo.googlenet(
+        batch=args.batch, num_classes=10, crop=crop))
+
+    train_fn = minibatch_fn(xtr, ytr, args.batch, seed=0)
+
+    def test_fn(b):
+        idx = np.arange(b * args.batch, (b + 1) * args.batch) % len(yte)
+        return {"data": xte[idx], "label": yte[idx]}
+
+    n_test = 1 if args.smoke else max(1, len(yte) // args.batch)
+
+    before = solver.test(n_test, test_fn)
+    print(f"untrained: {before}")
+    solver.step(args.steps, train_fn)
+    after = solver.test(n_test, test_fn)
+    print(f"after {args.steps} steps: {after}")
+
+    def head_losses(scores):
+        """The three softmax losses by their prototxt names."""
+        return {k: v for k, v in scores.items() if k.endswith("loss" )
+                or "/loss" in k}
+
+    print("aux/main losses:",
+          {k: (round(before[k], 3), round(after[k], 3))
+           for k in sorted(head_losses(after))})
+    acc_key = ("loss3/top-1" if "loss3/top-1" in after
+               else next(k for k in after if "top-1" in k))
+    if args.smoke:
+        ok = bool(np.isfinite(after["loss3/loss3"]))
+        print("PASS (smoke: finite)" if ok else "FAIL (loss not finite)")
+    else:
+        aux_down = all(after[k] < before[k] for k in head_losses(after))
+        ok = after[acc_key] >= 0.90 and aux_down
+        print("PASS" if ok else
+              f"FAIL (top-1 {after[acc_key]:.3f}, aux_down={aux_down})")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -385,9 +385,19 @@ def googlenet(batch: int = 32, num_classes: int = 1000, crop: int = 224) -> Mess
                             Pooling.Max, kernel=(3, 3), stride=(2, 2))]
     layers += _inception("5a", "pool4/3x3_s2", 256, 160, 320, 32, 128, 128)
     layers += _inception("5b", "inception_5a/output", 384, 192, 384, 48, 128, 128)
+    # pool5 is a GLOBAL average in intent (7x7 == 224/32, the whole 5b
+    # map — ref: bvlc_googlenet/train_val.prototxt pool5/7x7_s1); keep
+    # that intent at reduced crops (e.g. the digits-96 convergence
+    # walkthrough, examples/12) by sizing the kernel to the actual map.
+    # Non-multiples of 32 would leave a ceil-mode map LARGER than
+    # crop//32 and silently break the global intent — reject them.
+    if crop % 32:
+        raise ValueError(f"googlenet: crop must be a multiple of 32 "
+                         f"(got {crop})")
+    p5 = max(1, crop // 32)
     layers += [
         PoolingLayer("pool5/7x7_s1", ["inception_5b/output"], Pooling.Ave,
-                     kernel=(7, 7), stride=(1, 1)),
+                     kernel=(p5, p5), stride=(1, 1)),
         DropoutLayer("pool5/drop_7x7_s1", ["pool5/7x7_s1"], ratio=0.4, in_place=True),
         InnerProductLayer("loss3/classifier", ["pool5/7x7_s1"],
                           num_output=num_classes, weight_filler=w(),

@@ -87,10 +87,16 @@ def profile_step(step_fn, args, iters: int = 5, thread_fn=None) -> dict:
     return trace_step(step_fn, args, iters, thread_fn=thread_fn)
 
 
-def _device_events(log_dir: str) -> list[tuple[str, float]]:
+def _device_events(log_dir: str, full: bool = False) -> list:
     """(op name, duration µs) complete-events from device lanes of every
-    exported Chrome trace under ``log_dir``."""
-    events: list[tuple[str, float]] = []
+    exported Chrome trace under ``log_dir``.
+
+    ``full=True`` returns the RAW event dicts (same lane selection) so
+    cost-payload consumers (tools/traffic_report.py) share this lane
+    policy instead of re-implementing it — the stacked-lane rules here
+    carry the probe-40 triple-counting fix and must stay single-sourced.
+    """
+    events: list = []
     for path in glob.glob(
         os.path.join(log_dir, "**", "*.trace.json.gz"), recursive=True
     ):
@@ -168,6 +174,9 @@ def _device_events(log_dir: str) -> list[tuple[str, float]]:
                 continue
             dur = e.get("dur")
             if not dur:
+                continue
+            if full:
+                events.append(e)
                 continue
             name = e.get("name", "")
             args = e.get("args", {})

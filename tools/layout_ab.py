@@ -1,0 +1,182 @@
+"""NCHW vs NHWC conv orientation at the MXU — VGG-16-shaped A/B.
+
+VERDICT r4 item 6 asked for one layout experiment on the zoo's
+pure-MFU member.  The framework's blob semantics are NCHW (Caffe
+parity, `ops/vision.py _DIMNUMS`), and the banked AlexNet f32 trace
+attributes 2.0 ms/step (7.5%) to `data formatting` — XLA's internal
+layout moves.  This tool measures the question in isolation: the SAME
+VGG-16 conv stack (13 convs, 5 pools, 3 fc, SGD-less fwd+bwd) built
+with NCHW/OIHW vs NHWC/HWIO dimension numbers, identical math, raw jax
+— no framework surgery, so the verdict is about XLA:TPU's preference,
+not our graph compiler.
+
+Timing protocol: all iters fused in ONE lax.scan chained through a
+numerically-negligible carry, salted warm-vs-timed dispatches, fence on
+the scalar VALUE (both relay traps — see common.value_fence).
+
+Run (healthy window):  python tools/layout_ab.py [--batch 128]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# VGG-16 config D conv plan: (out_channels, convs_in_block)
+PLAN = [(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)]
+
+
+def build(layout: str, batch: int, crop: int, nclass: int, dtype):
+    """Returns (params, step_fn(params, x, y) -> loss) for one layout."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    nchw = layout == "NCHW"
+    dn = ("NCHW", "OIHW", "NCHW") if nchw else ("NHWC", "HWIO", "NHWC")
+    rs = np.random.RandomState(0)
+    params = []
+    cin = 3
+    for cout, reps in PLAN:
+        for _ in range(reps):
+            # msra scale: variance-preserving for the deep stack
+            w = rs.randn(cout, cin, 3, 3) * np.sqrt(2.0 / (cin * 9))
+            if not nchw:
+                w = w.transpose(2, 3, 1, 0)  # OIHW -> HWIO
+            params.append(jnp.asarray(w, dtype))
+            cin = cout
+    spatial = crop // 32
+    fc_in = 512 * spatial * spatial
+    for i, (m, n) in enumerate([(fc_in, 4096), (4096, 4096), (4096, nclass)]):
+        params.append(jnp.asarray(rs.randn(m, n) * np.sqrt(2.0 / m), dtype))
+
+    def fwd(params, x, y):
+        import jax.lax as lax
+
+        h = x
+        i = 0
+        for cout, reps in PLAN:
+            for _ in range(reps):
+                h = lax.conv_general_dilated(
+                    h, params[i], window_strides=(1, 1),
+                    padding=[(1, 1), (1, 1)], dimension_numbers=dn)
+                h = jax.nn.relu(h)
+                i += 1
+            wdims = (2, 3) if nchw else (1, 2)
+            h = lax.reduce_window(
+                h, -jnp.inf, lax.max,
+                window_dimensions=tuple(
+                    2 if d in wdims else 1 for d in range(4)),
+                window_strides=tuple(
+                    2 if d in wdims else 1 for d in range(4)),
+                padding="VALID")
+        h = h.reshape(h.shape[0], -1)
+        for w in params[i:]:
+            h = h @ w
+        logp = jax.nn.log_softmax(h.astype(jnp.float32))
+        return -jnp.take_along_axis(logp, y[:, None], axis=1).mean()
+
+    def step(params, x, y):
+        loss, grads = jax.value_and_grad(fwd)(params, x, y)
+        # SGD-less: fold the grads into the loss scalar so the backward
+        # pass is live without threading an optimizer through the A/B.
+        # 1e-30, not 0.0 — mul-by-zero is foldable and would let XLA
+        # delete the whole backward pass
+        gsum = sum(jnp.sum(g.astype(jnp.float32) ** 2) for g in grads)
+        return loss + 1e-30 * gsum
+
+    return params, step
+
+
+def measure(layout: str, batch: int, crop: int, iters: int, dtype_name: str):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    from sparknet_tpu.common import value_fence as fence
+
+    dtype = jnp.bfloat16 if dtype_name == "bf16" else jnp.float32
+    params, step = build(layout, batch, crop, 1000, dtype)
+    rs = np.random.RandomState(1)
+    shape = ((batch, 3, crop, crop) if layout == "NCHW"
+             else (batch, crop, crop, 3))
+    x = jax.device_put(jnp.asarray(rs.randn(*shape), dtype))
+    y = jax.device_put(jnp.asarray(rs.randint(0, 1000, batch), jnp.int32))
+    params = jax.device_put(params)
+
+    def chained(params, x, y, salt):
+        def body(carry, _):
+            x2 = x + (carry * 1e-24).astype(x.dtype)
+            return step(params, x2, y).astype(jnp.float32), None
+
+        s, _ = lax.scan(body, jnp.float32(salt), None, length=iters)
+        return s
+
+    cfn = jax.jit(chained)
+    fence(cfn(params, x, y, 0.0))  # warm: compiles + runs the chain once
+    t0 = time.perf_counter()
+    out = cfn(params, x, y, 1.0)
+    fence(out)
+    dt = time.perf_counter() - t0
+    platform = jax.devices()[0].platform
+    return {
+        "metric": "vgg16_shape_fwd_bwd_img_s", "arm": layout,
+        "value": round(batch * iters / dt, 1), "batch": batch,
+        "iters": iters, "dtype": dtype_name,
+        # CPU plumbing checks must never read as chip evidence
+        "platform": platform, "measured": platform != "cpu",
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--crop", type=int, default=224)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--dtype", default="bf16")
+    ap.add_argument("--platform", default=None)
+    ap.add_argument("--out", default="docs/layout_ab_last.json")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    on_accel = jax.devices()[0].platform != "cpu"
+    if not on_accel:  # offline plumbing check
+        args.batch, args.crop, args.iters = 2, 32, 2
+        args.dtype = "f32"
+
+    results = [measure(lay, args.batch, args.crop, args.iters, args.dtype)
+               for lay in ("NCHW", "NHWC")]
+    for r in results:
+        print(json.dumps(r), flush=True)
+
+    if not on_accel:
+        # plumbing check only — never overwrite banked chip evidence
+        print("layout_ab: cpu run, not banking", file=sys.stderr)
+        return 0
+
+    out_path = args.out
+    if not os.path.isabs(out_path):
+        out_path = os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), out_path)
+    try:
+        with open(out_path + ".tmp", "w") as f:
+            json.dump({"arms": results, "utc": time.strftime(
+                "%Y-%m-%d %H:%M:%SZ", time.gmtime())}, f, indent=1)
+        os.replace(out_path + ".tmp", out_path)
+    except OSError as e:
+        print(f"layout_ab: could not write {out_path}: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
