@@ -16,14 +16,17 @@ intent).
 
 What the run demonstrates:
 
-- top-1 >= 90% on held-out digits within the default 150 steps;
+- top-1 >= 90% on held-out digits within the default 400 steps at
+  batch 32 (measured 94.3%, SGD 0.01 momentum 0.9 — the published
+  optimizer family; Adam at any lr sits at chance here, see the recipe
+  comment in main());
 - BOTH aux losses decrease alongside the main head — the 0.3-weighted
   gradient paths through inception_4a/4d are live, which is exactly the
   semantic `caffe train` exercises and a forward-only check cannot.
 
 Run:
 
-    python examples/12_googlenet_digits.py [--steps 150]
+    python examples/12_googlenet_digits.py [--steps 400]
 """
 
 from __future__ import annotations
@@ -35,8 +38,8 @@ import sys
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--steps", type=int, default=150)
-    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--steps", type=int, default=400)
+    ap.add_argument("--batch", type=int, default=32)
     ap.add_argument("--platform", default="cpu")
     ap.add_argument("--smoke", action="store_true",
                     help="plumbing check: few steps, finiteness instead "
@@ -64,14 +67,19 @@ def main() -> int:
     prep = lambda x: np.repeat(x, 3, axis=1) / 8.0 - 0.5  # noqa: E731
     xtr, xte = prep(xtr), prep(xte)
 
-    # Adam + fixed lr for the short schedule (the published quick_solver
-    # polynomial decay assumes ImageNet-scale epochs — examples/11 made
-    # the same trade); dropout ratios stay the published 0.7/0.7/0.4.
+    # The PUBLISHED optimizer family (SGD momentum 0.9, ref:
+    # bvlc_googlenet/quick_solver.prototxt), fixed lr for the short
+    # schedule.  Adam variants (1e-3..1e-4) sit at chance here: its
+    # uniform absolute step is ~1%/step RELATIVE against the
+    # xavier-scale weights — the net is randomized faster than the
+    # 22-layer credit assignment can integrate, while SGD's
+    # gradient-proportional steps train cleanly (measured round 5).
+    # Dropout ratios stay the published 0.7/0.7/0.4.
     cfg = dataclasses.replace(
         zoo.googlenet_solver(),
-        base_lr=3e-4, solver_type="Adam", momentum=0.9, momentum2=0.999,
+        base_lr=0.01, solver_type="SGD", momentum=0.9,
         lr_policy="fixed", weight_decay=0.0,
-        max_iter=args.steps, display=10,
+        max_iter=args.steps, display=25,
     )
     solver = Solver(cfg, zoo.googlenet(
         batch=args.batch, num_classes=10, crop=crop))

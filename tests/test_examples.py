@@ -28,6 +28,7 @@ SCRIPTS = {
     # the plumbing only
     "10_resnet50_digits.py": (560, ["--smoke"]),
     "11_vgg16_digits.py": (560, ["--smoke"]),
+    "12_googlenet_digits.py": (560, ["--smoke"]),
 }
 
 
