@@ -1,0 +1,33 @@
+"""bench.py helper units: the pieces that must fail fast BEFORE a dial
+(a malformed A/B knob costing chip time is a round-4-class loss) and the
+zoo guard added for the crop-96 GoogLeNet walkthrough."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import _parse_compiler_options  # noqa: E402
+
+
+def test_parse_compiler_options_roundtrip():
+    assert _parse_compiler_options("") == {}
+    assert _parse_compiler_options("a=1") == {"a": "1"}
+    assert _parse_compiler_options(" a = 1 , b=x=y ") == {
+        "a": "1", "b": "x=y"}
+
+
+def test_parse_compiler_options_malformed_fails_fast():
+    with pytest.raises(SystemExit, match="key=value"):
+        _parse_compiler_options("xla_tpu_foo")
+
+
+def test_googlenet_rejects_non_multiple_of_32_crop():
+    """ceil-mode pooling would silently leave pool5 non-global for such
+    crops (round-5 review finding) — the builder rejects them loudly."""
+    from sparknet_tpu.models import zoo
+
+    with pytest.raises(ValueError, match="multiple of 32"):
+        zoo.googlenet(batch=1, num_classes=10, crop=95)

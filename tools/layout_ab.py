@@ -159,8 +159,13 @@ def main() -> int:
         print(json.dumps(r), flush=True)
 
     if not on_accel:
-        # plumbing check only — never overwrite banked chip evidence
+        # plumbing check only — never overwrite banked chip evidence.
+        # rc 4 under the runner's REQUIRE_MEASURED contract (see
+        # tpu_window_runner.window_death): a silent CPU fallback
+        # mid-window must stay in the retry ledger, not read as done.
         print("layout_ab: cpu run, not banking", file=sys.stderr)
+        if os.environ.get("SPARKNET_BENCH_REQUIRE_MEASURED") == "1":
+            return 4
         return 0
 
     out_path = args.out
