@@ -87,8 +87,8 @@ def _env_int(name: str, default: int) -> int:
 
 def _bench_params():
     """(model, crop) from env, validated."""
-    crops = {"alexnet": 227, "caffenet": 227, "googlenet": 224,
-             "resnet50": 224, "vgg16": 224}
+    from sparknet_tpu.models import BENCH_CROPS as crops
+
     model = os.environ.get("SPARKNET_BENCH_MODEL", "alexnet")
     if model not in crops:
         raise SystemExit(

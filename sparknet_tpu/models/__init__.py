@@ -5,7 +5,16 @@ Each builder returns a ``NetParameter`` Message ready for ``Network``/
 (ref: caffe/models/ + caffe/examples/).
 """
 
-from sparknet_tpu.models.classifier import Classifier  # noqa: F401
+# Published input crop per benchmarkable zoo family — the single source
+# for bench.py / tools/int8_bench.py / tools/scaling_bench.py (the three
+# copies of this literal diverged once: a family added to one raised
+# KeyError in another).
+BENCH_CROPS = {
+    "alexnet": 227, "caffenet": 227, "googlenet": 224,
+    "resnet50": 224, "vgg16": 224, "squeezenet": 227,
+}
+
+from sparknet_tpu.models.classifier import Classifier  # noqa: F401,E402
 from sparknet_tpu.models.deploy import DeployNet  # noqa: F401
 from sparknet_tpu.models.detector import Detector  # noqa: F401
 from sparknet_tpu.models.zoo import (  # noqa: F401
@@ -27,6 +36,8 @@ from sparknet_tpu.models.zoo import (  # noqa: F401
     mnist_siamese_solver,
     resnet50,
     resnet50_solver,
+    squeezenet,
+    squeezenet_solver,
     transformer,
     transformer_solver,
     vgg16,

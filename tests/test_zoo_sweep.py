@@ -9,6 +9,7 @@ import glob
 import os
 
 import jax
+import jax.numpy as jnp
 import pytest
 
 from sparknet_tpu.common import Phase
@@ -326,3 +327,66 @@ class TestVGG16:
                 if lp.get_str("type") == "Convolution"
             }
             assert fillers == {want}, (flag, fillers)
+
+
+class TestSqueezeNet:
+    """zoo:squeezenet — post-reference family #3 (Iandola et al. 2016
+    v1.1, the official Caffe release's wiring).  Load-bearing pin: the
+    published 1,235,496 parameter count (~50x smaller than AlexNet);
+    the family exists as the zoo's deploy-efficiency member — the
+    all-conv classifier + global average pool is exactly the form the
+    int8 PTQ path quantizes without BN folding."""
+
+    def test_param_pin_and_shape(self):
+        from sparknet_tpu.models import zoo
+
+        net = Network(zoo.squeezenet(batch=2), Phase.TRAIN)
+        v = net.init(jax.random.PRNGKey(0))
+        assert _param_count(v) == 1_235_496
+        # 8 fire modules x 3 convs + conv1 + conv10 carry weights; no fc
+        assert sum(1 for k in v.params if k.startswith("fire")) == 24
+        assert not any(k.startswith("fc") for k in v.params)
+
+    def test_trains_at_small_scale(self):
+        import dataclasses
+
+        import numpy as np
+
+        from sparknet_tpu.models import zoo
+        from sparknet_tpu.solvers.solver import Solver
+
+        cfg = dataclasses.replace(zoo.squeezenet_solver(), base_lr=1e-3)
+        solver = Solver(cfg, zoo.squeezenet(batch=4, num_classes=5, crop=64))
+        rs = np.random.RandomState(0)
+
+        def feed(it):
+            return {
+                "data": rs.randn(4, 3, 64, 64).astype(np.float32) * 40,
+                "label": rs.randint(0, 5, size=(4,)).astype(np.int32),
+            }
+
+        losses = [float(solver.step(1, feed)) for _ in range(3)]
+        assert np.all(np.isfinite(losses)), losses
+        scores = solver.test(2, feed)
+        assert 0.0 <= scores["accuracy"] <= 1.0
+
+    def test_int8_quantizes_without_folding(self):
+        """The deploy story: every weighted layer is a Convolution, so
+        quant.calibrate covers the whole net with no BN-fold prepass."""
+        import numpy as np
+
+        from sparknet_tpu import quant
+        from sparknet_tpu.models import zoo
+
+        net = Network(zoo.squeezenet(batch=2, num_classes=5, crop=64),
+                      Phase.TEST)
+        v = net.init(jax.random.PRNGKey(0))
+        rs = np.random.RandomState(0)
+        feeds = {"data": jnp.asarray(rs.randn(2, 3, 64, 64) * 40,
+                                     jnp.float32),
+                 "label": jnp.asarray([0, 1], jnp.int32)}
+        qstate = quant.calibrate(net, v, [feeds])
+        assert len(qstate) >= 26  # conv1 + 24 fire convs + conv10
+        with quant.quantized_inference(qstate):
+            blobs, _, _ = net.apply(v, feeds, rng=None, train=False)
+        assert np.all(np.isfinite(np.asarray(blobs["flat10"])))

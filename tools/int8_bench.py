@@ -49,8 +49,9 @@ def main() -> int:
     on_accel = jax.devices()[0].platform != "cpu"
     if on_accel:
         set_config(compute_dtype=jnp.bfloat16)
-    crop = {"alexnet": 227, "caffenet": 227, "googlenet": 224,
-            "resnet50": 224, "vgg16": 224}[args.model]
+    from sparknet_tpu.models import BENCH_CROPS
+
+    crop = BENCH_CROPS[args.model]
     B = args.batch if on_accel else 8
     iters = args.iters if on_accel else 2
 

@@ -75,8 +75,10 @@ def main() -> int:
                     help="N for the scaled leg (default: all visible)")
     ap.add_argument("--batch-per-device", type=int, default=256)
     ap.add_argument("--iters", type=int, default=20)
+    from sparknet_tpu.models import BENCH_CROPS
+
     ap.add_argument("--model", default="alexnet",
-                    choices=["alexnet", "caffenet", "googlenet"])
+                    choices=sorted(BENCH_CROPS))
     ap.add_argument("--dtype", default="bf16", choices=["bf16", "f32"])
     ap.add_argument("--allow-cpu", action="store_true",
                     help="run on a (virtual) CPU mesh — plumbing only")
@@ -118,7 +120,7 @@ def main() -> int:
     batch = args.batch_per_device if on_accel else 8
     iters = args.iters if on_accel else 2
     warmup = 3 if on_accel else 1
-    crop = {"alexnet": 227, "caffenet": 227, "googlenet": 224}[args.model]
+    crop = BENCH_CROPS[args.model]
 
     img_s_1 = measure(1, batch, iters, warmup, args.model, crop, args.dtype)
     rec = {
