@@ -29,6 +29,7 @@ SCRIPTS = {
     "10_resnet50_digits.py": (560, ["--smoke"]),
     "11_vgg16_digits.py": (560, ["--smoke"]),
     "12_googlenet_digits.py": (560, ["--smoke"]),
+    "13_squeezenet_digits.py": (560, ["--smoke"]),
 }
 
 
