@@ -10,7 +10,7 @@ Each builder returns a ``NetParameter`` Message ready for ``Network``/
 # copies of this literal diverged once: a family added to one raised
 # KeyError in another).
 BENCH_CROPS = {
-    "alexnet": 227, "caffenet": 227, "googlenet": 224,
+    "alexnet": 227, "caffenet": 227, "googlenet": 224, "mobilenet": 224,
     "resnet50": 224, "vgg16": 224, "squeezenet": 227,
 }
 
@@ -30,6 +30,8 @@ from sparknet_tpu.models.zoo import (  # noqa: F401
     googlenet_solver,
     lenet,
     lenet_solver,
+    mobilenet,
+    mobilenet_solver,
     mnist_autoencoder,
     mnist_autoencoder_solver,
     mnist_siamese,

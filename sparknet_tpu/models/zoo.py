@@ -678,6 +678,85 @@ def squeezenet(batch: int = 32, num_classes: int = 1000,
     return NetParam("SqueezeNet_v1.1", *layers)
 
 
+def _dw_sep(name: str, bottom: str, cin: int, cout: int, stride: int,
+            bn_fraction: float) -> tuple[list[Message], str]:
+    """conv{name}/dw (3x3 depthwise, group=cin) + BN/Scale/ReLU, then
+    conv{name}/sep (1x1 pointwise) + BN/Scale/ReLU — the depthwise-
+    separable block (Howard et al. 2017 §3.1, the MobileNet-Caffe
+    community wiring's layer naming)."""
+    dw, sep = f"conv{name}/dw", f"conv{name}/sep"
+    layers = [
+        ConvolutionLayer(dw, [bottom], kernel=(3, 3), num_output=cin,
+                         stride=(stride, stride), pad=(1, 1), group=cin,
+                         weight_filler=_msra(), bias_term=False),
+        *_bn_scale(f"{name}/dw", dw, bn_fraction),
+        ReLULayer(f"relu{name}/dw", [dw], in_place=True),
+        ConvolutionLayer(sep, [dw], kernel=(1, 1), num_output=cout,
+                         weight_filler=_msra(), bias_term=False),
+        *_bn_scale(f"{name}/sep", sep, bn_fraction),
+        ReLULayer(f"relu{name}/sep", [sep], in_place=True),
+    ]
+    return layers, sep
+
+
+def mobilenet(batch: int = 32, num_classes: int = 1000, crop: int = 224,
+              bn_fraction: float = 0.999) -> Message:
+    """MobileNet v1 (1.0x, Howard et al. 2017) — post-reference family
+    #4, the depthwise-separable member: 13 dw-separable blocks between
+    a 3x3/2 stem and a global-average 1x1-conv classifier.  4,231,976
+    params at 1000 classes (the standard v1 count; derived conv1 864 +
+    dw 44,640 + pointwise 3,139,584 + Scale gamma/beta 21,888 +
+    fc 1,025,000 — pinned in tests/test_zoo_sweep.py).  Zoo role: the only family whose hot op
+    is GROUPED convolution at group == channels — the MXU's worst-case
+    conv orientation (a depthwise 3x3 does 9 MACs/output vs a dense
+    conv's thousands, so the op is bandwidth-bound by construction);
+    its bench point measures how far XLA's depthwise lowering sits from
+    the HBM bound.  ``bn_fraction`` as in ``resnet50``."""
+    layers: list[Message] = [
+        RDDLayer("data", shape=[batch, 3, crop, crop]),
+        RDDLayer("label", shape=[batch]),
+        ConvolutionLayer("conv1", ["data"], kernel=(3, 3), num_output=32,
+                         stride=(2, 2), pad=(1, 1), weight_filler=_msra(),
+                         bias_term=False),
+        *_bn_scale("1", "conv1", bn_fraction),
+        ReLULayer("relu1", ["conv1"], in_place=True),
+    ]
+    bottom = "conv1"
+    plan = [("2_1", 32, 64, 1), ("2_2", 64, 128, 2),
+            ("3_1", 128, 128, 1), ("3_2", 128, 256, 2),
+            ("4_1", 256, 256, 1), ("4_2", 256, 512, 2),
+            ("5_1", 512, 512, 1), ("5_2", 512, 512, 1),
+            ("5_3", 512, 512, 1), ("5_4", 512, 512, 1),
+            ("5_5", 512, 512, 1), ("5_6", 512, 1024, 2),
+            ("6", 1024, 1024, 1)]
+    for name, cin, cout, stride in plan:
+        ls, bottom = _dw_sep(name, bottom, cin, cout, stride, bn_fraction)
+        layers += ls
+    layers += [
+        PoolingLayer("pool6", [bottom], Pooling.Ave, global_pooling=True),
+        ConvolutionLayer("fc7", ["pool6"], kernel=(1, 1),
+                         num_output=num_classes, weight_filler=_gauss(0.01),
+                         bias_filler=_const(0.0)),
+        FlattenLayer("flat7", ["fc7"]),
+        SoftmaxWithLoss("loss", ["flat7", "label"]),
+        AccuracyLayer("accuracy", ["flat7", "label"], phase="TEST"),
+        AccuracyLayer("accuracy_top5", ["flat7", "label"], top_k=5,
+                      phase="TEST"),
+    ]
+    return NetParam("MobileNet_v1", *layers)
+
+
+def mobilenet_solver() -> SolverConfig:
+    """Adapted recipe (the v1 paper trained with RMSProp on an internal
+    system and shipped no Caffe solver): SGD momentum 0.9, base_lr 0.01
+    stepped /10 — the BN-ful net is schedule-tolerant."""
+    return SolverConfig(
+        base_lr=0.01, lr_policy="step", gamma=0.1, stepsize=100000,
+        momentum=0.9, weight_decay=4e-5, max_iter=300000,
+        solver_type="SGD", display=40, snapshot_prefix="mobilenet",
+    )
+
+
 def squeezenet_solver() -> SolverConfig:
     """The official v1.1 recipe: SGD momentum 0.9, base_lr 0.04 with
     linear (poly power 1) decay, weight decay 2e-4 (forresti/SqueezeNet

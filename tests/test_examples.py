@@ -30,6 +30,7 @@ SCRIPTS = {
     "11_vgg16_digits.py": (560, ["--smoke"]),
     "12_googlenet_digits.py": (560, ["--smoke"]),
     "13_squeezenet_digits.py": (560, ["--smoke"]),
+    "14_mobilenet_digits.py": (560, ["--smoke"]),
 }
 
 

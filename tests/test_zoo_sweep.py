@@ -9,6 +9,7 @@ import glob
 import os
 
 import jax
+import numpy as np
 import jax.numpy as jnp
 import pytest
 
@@ -390,3 +391,80 @@ class TestSqueezeNet:
         with quant.quantized_inference(qstate):
             blobs, _, _ = net.apply(v, feeds, rng=None, train=False)
         assert np.all(np.isfinite(np.asarray(blobs["flat10"])))
+
+
+class TestMobileNet:
+    """zoo:mobilenet — post-reference family #4 (MobileNet v1 1.0x,
+    Howard et al. 2017).  Load-bearing pin: the standard 4,231,976
+    parameter count; the family exists as the zoo's depthwise member —
+    the only net whose hot op is grouped convolution at group ==
+    channels (the MXU's bandwidth-bound worst case)."""
+
+    def test_param_pin_and_shape(self):
+        from sparknet_tpu.models import zoo
+
+        net = Network(zoo.mobilenet(batch=2), Phase.TRAIN)
+        v = net.init(jax.random.PRNGKey(0))
+        assert _param_count(v) == 4_231_976
+        # 13 dw + 13 sep + conv1 + fc7 carry conv weights
+        assert sum(1 for k in v.params if "/dw" in k and k.startswith("conv")) == 13
+        # depthwise blobs are (C, 1, 3, 3)
+        assert np.asarray(v.params["conv5_1/dw"][0]).shape == (512, 1, 3, 3)
+
+    def test_trains_at_small_scale(self):
+        import dataclasses
+
+        from sparknet_tpu.models import zoo
+        from sparknet_tpu.solvers.solver import Solver
+
+        cfg = dataclasses.replace(zoo.mobilenet_solver(), base_lr=1e-3)
+        solver = Solver(cfg, zoo.mobilenet(batch=4, num_classes=5, crop=64,
+                                           bn_fraction=0.9))
+        rs = np.random.RandomState(0)
+
+        def feed(it):
+            return {
+                "data": rs.randn(4, 3, 64, 64).astype(np.float32),
+                "label": rs.randint(0, 5, size=(4,)).astype(np.int32),
+            }
+
+        losses = [float(solver.step(1, feed)) for _ in range(3)]
+        assert np.all(np.isfinite(losses)), losses
+        scores = solver.test(2, feed)
+        assert 0.0 <= scores["accuracy"] <= 1.0
+
+    def test_all_27_bn_chains_fold(self):
+        """Every Conv+BN+Scale chain (conv1 + 13 dw + 13 sep) folds for
+        deployment, and the folded net scores identically — the
+        merge_bn flow on the depthwise family."""
+        import dataclasses
+
+        from sparknet_tpu.compiler.graph import NetVars
+        from sparknet_tpu.models import zoo
+        from sparknet_tpu.models.fold_bn import fold_batchnorm
+        from sparknet_tpu.solvers.solver import Solver
+
+        cfg = dataclasses.replace(zoo.mobilenet_solver(), base_lr=1e-3)
+        solver = Solver(cfg, zoo.mobilenet(batch=4, num_classes=5, crop=64,
+                                           bn_fraction=0.9))
+        rs = np.random.RandomState(0)
+        solver.step(3, lambda it: {
+            "data": rs.randn(4, 3, 64, 64).astype(np.float32),
+            "label": rs.randint(0, 5, size=(4,)).astype(np.int32)})
+
+        net_param = solver.train_net.net_param
+        feeds = {"data": jnp.asarray(rs.randn(4, 3, 64, 64), jnp.float32),
+                 "label": jnp.asarray(rs.randint(0, 5, 4), jnp.int32)}
+        ref_net = Network(net_param, Phase.TEST)
+        ref, _, _ = ref_net.apply(solver.variables, feeds, rng=None,
+                                  train=False)
+
+        net2, params2, state2, folded = fold_batchnorm(
+            net_param, solver.variables.params, solver.variables.state)
+        assert len(folded) == 27, folded
+        out_net = Network(net2, Phase.TEST)
+        out, _, _ = out_net.apply(NetVars(params=params2, state=state2),
+                                  feeds, rng=None, train=False)
+        np.testing.assert_allclose(np.asarray(out["flat7"]),
+                                   np.asarray(ref["flat7"]),
+                                   rtol=2e-4, atol=2e-4)
