@@ -159,3 +159,39 @@ def test_calibrate_resolves_shared_weights():
         blobs, _, _ = net.apply(variables, feeds, rng=None, train=False)
     for v in blobs.values():
         assert np.all(np.isfinite(np.asarray(v)))
+
+
+def test_int8_depthwise_conv_close_to_float():
+    """Grouped conv at group == channels (MobileNet's hot op): the
+    per-output-channel scale (channel_axis 0 on the (C,1,kh,kw) blob)
+    must dequantize each depthwise channel independently — a per-tensor
+    scale would smear large-channel error across small channels."""
+    from sparknet_tpu.proto import parse
+
+    NET = """
+    name: "dwq"
+    layer { name: "data" type: "MemoryData" top: "data" top: "label"
+            memory_data_param { batch_size: 2 channels: 16 height: 8 width: 8 } }
+    layer { name: "dw" type: "Convolution" bottom: "data" top: "y"
+            convolution_param { num_output: 16 kernel_size: 3 pad: 1 group: 16
+                                weight_filler { type: "msra" } } }
+    """
+    net = Network(parse(NET), Phase.TEST)
+    variables = net.init(jax.random.PRNGKey(3))
+    rs = np.random.RandomState(0)
+    # wildly different per-channel magnitudes to punish per-tensor scales
+    w = np.asarray(variables.params["dw"][0]) * (
+        10.0 ** rs.uniform(-2, 2, size=(16, 1, 1, 1)))
+    variables.params["dw"][0] = jnp.asarray(w, jnp.float32)
+    feeds = {"data": rs.randn(2, 16, 8, 8).astype(np.float32),
+             "label": np.zeros(2, np.int32)}
+    qstate = calibrate(net, variables, [feeds])
+    assert "dw" in qstate and qstate["dw"]["w_q"].shape == (16, 1, 3, 3)
+    with quantized_inference(qstate):
+        q, _, _ = net.apply(variables, feeds, rng=None, train=False)
+    ref, _, _ = net.apply(variables, feeds, rng=None, train=False)
+    qy, ry = np.asarray(q["y"]), np.asarray(ref["y"])
+    # per-channel relative error stays small for EVERY channel
+    for c in range(16):
+        denom = np.abs(ry[:, c]).max() + 1e-9
+        assert np.abs(qy[:, c] - ry[:, c]).max() / denom < 0.03, c
