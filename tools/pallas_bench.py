@@ -38,6 +38,14 @@ if os.environ.get("SPARKNET_PALLAS_BENCH_SMALL"):
 else:
     LRN_SHAPE = (256, 96, 55, 55)
     ATTN_SHAPE = (8, 8, 1024, 64)  # (batch, heads, seq, head_dim)
+# Long-context override, e.g. "2,8,8192,64": at multi-k sequence the
+# O(seq^2) materialized-scores XLA path is where flash tiling earns its
+# keep (the seq-1024 point banked round 4 measured them within 5%)
+if os.environ.get("SPARKNET_PALLAS_ATTN_SHAPE"):
+    ATTN_SHAPE = tuple(
+        int(x) for x in
+        os.environ["SPARKNET_PALLAS_ATTN_SHAPE"].split(","))
+    assert len(ATTN_SHAPE) == 4, ATTN_SHAPE
 
 
 def _fence(args):
