@@ -54,7 +54,15 @@ def _fence(args):
     can return before the chain has actually executed — the same lesson
     bench.py's measured_run encodes; a value fetch is the reliable fence
     (round-3 on-chip runs showed per-call block_until_ready timing
-    understating LRN forward by >20x vs its bandwidth roofline)."""
+    understating LRN forward by >20x vs its bandwidth roofline).
+
+    Caveat vs common.value_fence: ``leaf.sum()`` is a DERIVED device
+    computation (the round-4 trace-tool trap) — but _time_fn's args are
+    large tensors (a direct value fetch would time a multi-MB tunnel
+    copy), and every iteration is CHAINED through the previous output,
+    so a premature-ready fetch can at most shave the LAST of the 20
+    chained calls: the error ceiling is ~5%, amortized, not the 100x
+    the un-chained trace tool banked."""
     import jax
 
     leaf = jax.tree_util.tree_leaves(args)[0]
@@ -108,7 +116,13 @@ def bench_lrn(records, dtype="float32"):
     return results
 
 
-def bench_flash(records, dtype="float32"):
+def bench_flash(records, dtype="float32", fwd_only=False):
+    """``fwd_only``: skip the backward arm.  REQUIRED at long sequence:
+    the pallas custom-VJP backward is currently ``jax.vjp`` of the XLA
+    path (pallas_kernels._flash_diff_bwd), so at multi-k seq BOTH arms'
+    backward re-materializes the O(seq^2) score matrix — the fwd+bwd
+    total would compare XLA against XLA-plus-overhead (and can OOM the
+    chip) instead of measuring the flash forward tiling."""
     import jax
     import jax.numpy as jnp
 
@@ -133,14 +147,17 @@ def bench_flash(records, dtype="float32"):
                 "fwd_ms": round(_time_fn(
                     fwd, (q, k, v),
                     lambda a, out: (out, a[1], a[2])), 3),
-                "bwd_ms": round(_time_fn(
-                    vjp, (q, k, v, g),
-                    lambda a, out: (out[0], out[1], out[2], a[3])), 3),
             }
+            if not fwd_only:
+                results[impl]["bwd_ms"] = round(_time_fn(
+                    vjp, (q, k, v, g),
+                    lambda a, out: (out[0], out[1], out[2], a[3])), 3)
         except Exception as e:
             results[impl] = {"error": repr(e)[:300]}
         records.append({"op": "flash_attention", "impl": impl,
-                        "shape": list(ATTN_SHAPE), **results[impl]})
+                        "shape": list(ATTN_SHAPE), "dtype": dtype,
+                        **({"fwd_only": True} if fwd_only else {}),
+                        **results[impl]})
     return results
 
 
@@ -155,7 +172,7 @@ def verdict(op, results):
     errors = {}
     for impl, r in results.items():
         if "fwd_ms" in r:
-            totals[impl] = round(r["fwd_ms"] + r["bwd_ms"], 3)
+            totals[impl] = round(r["fwd_ms"] + r.get("bwd_ms", 0.0), 3)
         else:
             errors[impl] = r.get("error")
     best = min(totals, key=totals.get)
@@ -183,6 +200,10 @@ def main() -> int:
     ap.add_argument("--dtype", choices=["float32", "bf16"], default="float32",
                     help="arm dtype (the r3 shootout was f32; the training "
                     "step runs bf16 — the promote decision should too)")
+    ap.add_argument("--fwd-only", action="store_true",
+                    help="skip the backward arms (required at long "
+                    "sequence: the pallas VJP is the XLA path, see "
+                    "bench_flash docstring)")
     ap.add_argument("--allow-cpu", action="store_true",
                     help="run on CPU/interpret anyway (numbers meaningless "
                     "for the promote decision; for plumbing checks only)")
@@ -204,6 +225,11 @@ def main() -> int:
         )
         if not probe["ok"]:
             print(json.dumps({"measured": False, "reason": probe["reason"]}))
+            # runner window-death contract (same env test as bench.py /
+            # tpu_window_runner.window_death): an unmeasured run must
+            # stay in the retry ledger, not read as success
+            if os.environ.get("SPARKNET_BENCH_REQUIRE_MEASURED") == "1":
+                return 4
             return 0
         if probe["platform"] == "cpu" and not args.allow_cpu:
             print(json.dumps({"measured": False,
@@ -224,7 +250,8 @@ def main() -> int:
         verdicts.append(verdict("lrn", bench_lrn(records, args.dtype)))
     if args.op in ("flash", "all"):
         verdicts.append(verdict("flash_attention",
-                                bench_flash(records, args.dtype)))
+                                bench_flash(records, args.dtype,
+                                            fwd_only=args.fwd_only)))
     if not on_accel:
         # CPU numbers can't drive the promote decision (and pallas only
         # runs in interpret mode here) — mark every line
