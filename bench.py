@@ -649,7 +649,11 @@ def main() -> int:
     # evidence for partial_record's metric+dtype fallback, and a variant
     # run overwriting it (e.g. f32 over the bf16 headline) would orphan
     # that fallback exactly as measured_run's docstring warns.
-    record_last = os.environ.get("SPARKNET_BENCH_RECORD_LAST", "1") != "0"
+    # CPU runs never bank, even when the operator forgets RECORD_LAST=0:
+    # bench_last_good.json holds measured on-chip evidence and a rehearsal
+    # (FORCE_ACCEL_PATH on a cpu backend) must not overwrite it.
+    record_last = (os.environ.get("SPARKNET_BENCH_RECORD_LAST", "1") != "0"
+                   and platform != "cpu")
     rec = measured_run(batch, iters, warmup, model, crop, dtype_name, phase,
                        on_accel=on_accel, result_holder=result_holder,
                        record_last=record_last, scan=scan)
@@ -674,21 +678,85 @@ def main() -> int:
             timer = threading.Timer(extra_deadline, os._exit, args=(0,))
             timer.daemon = True
             timer.start()
+        # Per-extra budget on top of the global one: a wedge striking
+        # mid-extras (probe 16: first extra hung 25 min into the global
+        # timer) must cost one compile budget, not the rest of the window.
+        # Banked extras survive (bank() runs after every extra); rc stays 0
+        # because the headline is already on stdout.
+        # Sized ABOVE worst-case healthy compile (~10 min observed, and the
+        # axon client never reuses a compile cache) — only a true hang trips
+        # it; the global extras deadline still bounds the total.
+        each_deadline = _env_float("SPARKNET_BENCH_EXTRA_EACH", 1200.0)
+
+        def _extra_bail() -> None:
+            # flush anything measured so far (results list is shared; the
+            # tmp+replace write is atomic, safe from this timer thread) —
+            # an extra finishing in the timer race must not be discarded
+            bank()
+            print(
+                f"bench extra: {phase[0]!r} exceeded per-extra deadline "
+                f"({each_deadline:.0f}s); exiting with the extras banked so "
+                "far, remaining extras forfeited. NOTE: exiting mid-RPC may "
+                "wedge the relay for this session (restore = tunnel restart)",
+                file=sys.stderr,
+                flush=True,
+            )
+            os._exit(0)
         results = []
-        path = os.path.join(os.path.dirname(__file__), "docs",
-                            "bench_extra_last.json")
+        # CPU rehearsals (FORCE_ACCEL_PATH on a cpu backend) must never
+        # bank over measured evidence — divert OUTSIDE docs/ entirely and
+        # stamp the payload (same rule as int8_bench/layout_ab: CPU runs
+        # don't bank).
+        rehearsal = platform == "cpu"
+        path = (os.path.join(os.path.dirname(__file__), "docs",
+                             "bench_extra_last.json")
+                if not rehearsal else "/tmp/bench_extra_rehearsal.json")
+        # A wedge during extra 1 must not pair the PREVIOUS window's
+        # extras with this run's fresh headline — but those extras are
+        # scarce measured evidence, so carry them under an explicitly
+        # stale-labeled key instead of destroying them.
+        previous = None
+        try:
+            with open(path) as f:
+                previous = json.load(f)
+            if isinstance(previous, dict):
+                previous.pop("previous_run", None)  # one level deep
+            else:
+                previous = None  # valid JSON but not a record — drop it
+        except (OSError, ValueError):
+            pass
+
+        # bank() is reachable from BOTH the main thread and _extra_bail's
+        # timer thread (cancel() can't stop an already-running callback);
+        # serialize so two writers can't interleave bytes in the .tmp file
+        bank_lock = threading.Lock()
 
         def bank() -> None:
             # re-written after EVERY extra: a later extra hanging into the
             # hard-exit timer must not discard the ones already measured
+            payload = {"headline": rec, "extras": list(results)}
+            if rehearsal:
+                payload["rehearsal"] = True
+                payload["note"] = "CPU rehearsal — not evidence"
+            if previous is not None:
+                payload["previous_run"] = previous
             try:
-                with open(path + ".tmp", "w") as f:
-                    json.dump({"headline": rec, "extras": results}, f, indent=1)
-                os.replace(path + ".tmp", path)
+                with bank_lock:
+                    with open(path + ".tmp", "w") as f:
+                        json.dump(payload, f, indent=1)
+                    os.replace(path + ".tmp", path)
             except OSError:
                 pass
 
+        # bank the fresh headline immediately: a wedge during extra 1 must
+        # not leave the side file pairing a stale headline with stale extras
+        bank()
         for ex_model, ex_crop, ex_dtype, ex_batch in extras:
+            each_timer = None
+            if each_deadline > 0:
+                each_timer = threading.Timer(each_deadline, _extra_bail)
+                each_timer.daemon = True
+                each_timer.start()
             try:
                 phase[0] = f"extra:{ex_model}/{ex_dtype}"
                 r = measured_run(ex_batch, iters, warmup, ex_model, ex_crop,
@@ -699,6 +767,9 @@ def main() -> int:
             except Exception as e:
                 results.append({"metric": f"{ex_model}_{ex_dtype}_error",
                                 "error": repr(e)[:300]})
+            finally:
+                if each_timer is not None:
+                    each_timer.cancel()
             bank()
         if timer is not None:
             timer.cancel()  # an embedding caller must outlive this block
