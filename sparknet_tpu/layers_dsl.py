@@ -263,12 +263,17 @@ def SoftmaxLayer(name: str, bottoms: Sequence[str]) -> Message:
 
 def SoftmaxWithLoss(
     name: str, bottoms: Sequence[str], loss_weight: float | None = None,
-    top: str | None = None,
+    top: str | None = None, axis: int | None = None,
 ) -> Message:
     """ref: Layers.scala:115-128 (bottoms = [scores, label]).  ``loss_weight``
     scales this loss term in the total objective — the GoogLeNet auxiliary
-    classifiers train at 0.3 (bvlc_googlenet/train_val.prototxt:933,1696)."""
-    return _loss_layer(name, "SoftmaxWithLoss", bottoms, loss_weight, top)
+    classifiers train at 0.3 (bvlc_googlenet/train_val.prototxt:933,1696).
+    ``axis`` picks the class axis (softmax_param.axis, ref:
+    softmax_loss_layer.cpp) — e.g. 2 for per-token [B, S, V] LM logits."""
+    m = _loss_layer(name, "SoftmaxWithLoss", bottoms, loss_weight, top)
+    if axis is not None:
+        m.set("softmax_param", Message().set("axis", axis))
+    return m
 
 
 def AccuracyLayer(
@@ -276,13 +281,19 @@ def AccuracyLayer(
     bottoms: Sequence[str],
     top_k: int = 1,
     phase: str | None = None,
+    axis: int | None = None,
 ) -> Message:
     """``phase="TEST"`` adds the include rule the reference prototxts put on
     every Accuracy layer (e.g. caffe/examples/mnist/lenet_train_test.prototxt:
     ``include { phase: TEST }``)."""
     m = _layer(name, "Accuracy", bottoms)
-    if top_k != 1:
-        m.set("accuracy_param", Message().set("top_k", top_k))
+    if top_k != 1 or axis is not None:
+        p = Message()
+        if top_k != 1:
+            p.set("top_k", top_k)
+        if axis is not None:
+            p.set("axis", axis)
+        m.set("accuracy_param", p)
     if phase is not None:
         m.add("include", Message().set("phase", phase))
     return m
@@ -310,13 +321,17 @@ def MultiHeadAttentionLayer(
     bottoms: Sequence[str],
     num_heads: int,
     causal: bool = False,
+    rope: bool = False,
     top: str | None = None,
 ) -> Message:
-    """Sequence-model extra (no reference analog; ops/attention.py)."""
+    """Sequence-model extra (no reference analog; ops/attention.py).
+    ``rope=True`` turns on parameter-free rotary position embeddings."""
     m = _layer(name, "MultiHeadAttention", bottoms, [top] if top else None)
     p = Message().set("num_heads", num_heads)
     if causal:
         p.set("causal", True)
+    if rope:
+        p.set("rope", True)
     return m.set("attention_param", p)
 
 

@@ -15,6 +15,7 @@ BENCH_CROPS = {
 }
 
 from sparknet_tpu.models.classifier import Classifier  # noqa: F401,E402
+from sparknet_tpu.models.generate import generate_chars  # noqa: F401,E402
 from sparknet_tpu.models.deploy import DeployNet  # noqa: F401
 from sparknet_tpu.models.detector import Detector  # noqa: F401
 from sparknet_tpu.models.zoo import (  # noqa: F401
@@ -40,6 +41,8 @@ from sparknet_tpu.models.zoo import (  # noqa: F401
     resnet50_solver,
     squeezenet,
     squeezenet_solver,
+    charlm,
+    charlm_solver,
     transformer,
     transformer_solver,
     vgg16,

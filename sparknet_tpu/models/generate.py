@@ -1,0 +1,59 @@
+"""Autoregressive sampling from a trained causal LM (``models.charlm``).
+
+No reference analog (the reference is CNN-only; long-context is this
+framework's first-class extra).  Decoding reuses the ordinary TEST-phase
+forward program — the same compiled graph that evaluates accuracy — with
+a fixed [1, seq_len] window so there is exactly ONE compilation: the
+prompt/continuation is RIGHT-padded and logits are read at the last real
+position, which causal masking leaves independent of the padding.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from sparknet_tpu.data.text import CharVocab
+
+
+def generate_chars(
+    net,
+    vocab: CharVocab,
+    prompt: str,
+    n: int,
+    seq_len: int,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    seed: int | None = 0,
+    logits_blob: str = "fc",
+) -> str:
+    """Sample ``n`` chars continuing ``prompt`` from a trained ``TPUNet``
+    built over ``models.charlm(batch=1, seq_len=seq_len, ...)``.
+
+    ``temperature=0`` decodes greedily; ``top_k > 0`` restricts sampling
+    to the k most likely chars.  The context is the last ``seq_len``
+    ids (sliding window — charlm has no cache; fine at demo scale).
+    """
+    if not prompt:
+        raise ValueError("prompt must be non-empty")
+    rs = np.random.RandomState(seed)
+    ids = list(vocab.encode(prompt))
+    n_prompt = len(ids)
+    dummy_label = np.zeros((1, seq_len), np.int32)
+    for _ in range(n):
+        window = ids[-seq_len:]
+        t = len(window) - 1
+        data = np.zeros((1, seq_len), np.int32)
+        data[0, : len(window)] = window  # right-pad: causal-safe
+        blobs = net.forward({"data": data, "label": dummy_label})
+        logits = np.asarray(blobs[logits_blob])[0, t].astype(np.float64)
+        if top_k > 0:
+            cut = np.sort(logits)[-top_k]
+            logits = np.where(logits < cut, -np.inf, logits)
+        if temperature <= 0:
+            nxt = int(np.argmax(logits))
+        else:
+            z = (logits - logits.max()) / temperature
+            p = np.exp(z) / np.exp(z).sum()
+            nxt = int(rs.choice(p.size, p=p))
+        ids.append(nxt)
+    return vocab.decode(ids[n_prompt:])

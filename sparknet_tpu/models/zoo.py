@@ -900,13 +900,14 @@ def mnist_autoencoder_solver() -> SolverConfig:
 # attention) are reachable from the framework's ordinary model front door.
 # ---------------------------------------------------------------------------
 def _transformer_block(i: int, bottom: str, embed_dim: int, heads: int,
-                       ffn_dim: int) -> tuple[list[Message], str]:
+                       ffn_dim: int, rope: bool = False
+                       ) -> tuple[list[Message], str]:
     """Pre-LN-free residual block: attention + residual, per-token FFN
     (InnerProduct axis=2) + residual."""
     attn, res, out = f"attn{i}", f"res{i}", f"blk{i}"
     layers = [
         MultiHeadAttentionLayer(attn, [bottom], num_heads=heads,
-                                causal=True, top=attn),
+                                causal=True, rope=rope, top=attn),
         EltwiseLayer(res, [bottom, attn], top=res),
         InnerProductLayer(f"ffn{i}a", [res], num_output=ffn_dim, axis=2,
                           weight_filler=_gauss(0.05)),
@@ -954,4 +955,59 @@ def transformer_solver() -> SolverConfig:
     return SolverConfig(
         base_lr=0.1, lr_policy="fixed", momentum=0.9, weight_decay=1e-4,
         max_iter=2000, solver_type="SGD", display=100,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Char-level causal language model — the long-context story end to end
+# (no reference analog: SURVEY §5 "long-context: absent"; RNN/sequence
+# work was the reference's declared future work, ROADMAP.md:12).  Same
+# decoder stack as `transformer` but with rotary position embeddings and
+# a PER-TOKEN head: InnerProduct(axis=2) logits [B, S, V] against
+# shifted labels [B, S] through SoftmaxWithLoss(axis=2) — the causal-LM
+# objective expressed entirely in prototxt-compatible layers, so it
+# trains/snapshots/deploys through every ordinary path and scales over a
+# (data × seq) mesh with ring/Ulysses sequence parallelism unchanged.
+# Data side: `data/text.py` (CharVocab + next-char windows).
+# ---------------------------------------------------------------------------
+def charlm(
+    batch: int = 32,
+    seq_len: int = 128,
+    vocab: int = 128,
+    embed_dim: int = 64,
+    heads: int = 4,
+    ffn_dim: int = 128,
+    blocks: int = 2,
+) -> Message:
+    """Causal char LM over [batch, seq_len] ids -> per-token next-char
+    logits.  loss is mean cross-entropy per token (nats); bits/char =
+    loss / ln 2."""
+    layers = [
+        RDDLayer("data", shape=[batch, seq_len]),
+        RDDLayer("label", shape=[batch, seq_len]),
+        EmbedLayer("embed", ["data"], input_dim=vocab,
+                   num_output=embed_dim, top="embed"),
+    ]
+    bottom = "embed"
+    for i in range(1, blocks + 1):
+        blk, bottom = _transformer_block(i, bottom, embed_dim, heads,
+                                         ffn_dim, rope=True)
+        layers += blk
+    layers += [
+        InnerProductLayer("fc", [bottom], num_output=vocab, axis=2,
+                          weight_filler=_gauss(0.05)),
+        SoftmaxWithLoss("loss", ["fc", "label"], axis=2),
+        AccuracyLayer("accuracy", ["fc", "label"], phase="TEST", axis=2),
+    ]
+    return NetParam("CharLM", *layers)
+
+
+def charlm_solver() -> SolverConfig:
+    # Adam: the standard small-transformer recipe (SGD needs warmup at
+    # this depth; cf. docs/CONVERGENCE.md's GoogLeNet optimizer note —
+    # there the published recipe was SGD, here there is no published
+    # reference recipe to honor).
+    return SolverConfig(
+        base_lr=2e-3, lr_policy="fixed", momentum=0.9, weight_decay=0.0,
+        max_iter=2000, solver_type="Adam", display=100,
     )

@@ -117,6 +117,30 @@ def _sp_attention(mesh, impl, q, k, v, causal):
     )(q, k, v)
 
 
+def rope(x: jax.Array, base: float = 10000.0) -> jax.Array:
+    """Rotary position embedding over ``x`` [B, H, S, D] (D even).
+
+    Parameter-free absolute-position encoding with the relative-position
+    dot-product property (RoFormer, Su et al. 2021 — public technique,
+    PAPERS.md): position t rotates each head-dim pair (2i, 2i+1) by
+    t·θ_i, θ_i = base^(-2i/D).  Applied to q and k only; attention
+    scores then depend on t_q − t_k.  No new weight blobs, so every
+    wire format (caffemodel/HDF5/orbax) is untouched.  Must run BEFORE
+    any sequence-parallel split: positions here are global.
+    """
+    B, H, S, D = x.shape
+    if D % 2:
+        raise ValueError(f"rope needs an even head dim, got {D}")
+    half = D // 2
+    theta = base ** (-jnp.arange(half, dtype=jnp.float32) / half)  # [half]
+    ang = jnp.arange(S, dtype=jnp.float32)[:, None] * theta[None, :]  # [S,half]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1, x2 = x[..., :half], x[..., half:]  # rotate-half convention
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    ).astype(x.dtype)
+
+
 @register
 class MultiHeadAttentionLayer(Layer):
     TYPE = "MultiHeadAttention"
@@ -126,6 +150,7 @@ class MultiHeadAttentionLayer(Layer):
         p = lp.get_msg("attention_param")
         self.num_heads = p.get_int("num_heads", 1)
         self.causal = p.get_bool("causal", False)
+        self.rope = p.get_bool("rope", False)
         self.weight_filler = (
             p.get_msg("weight_filler")
             if p.has("weight_filler")
@@ -156,6 +181,10 @@ class MultiHeadAttentionLayer(Layer):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         # [B, S, E] -> [B, H, S, D]
         split = lambda t: t.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+        q, k, v = split(q), split(k), split(v)
+        if self.rope:
+            # global positions — before any sequence-parallel split
+            q, k = rope(q), rope(k)
         sp = active_sequence_parallel()
         if sp is not None and S % sp[0].shape[get_config().seq_axis] != 0:
             # ring/Ulysses need equal sequence blocks; an indivisible S
@@ -170,11 +199,9 @@ class MultiHeadAttentionLayer(Layer):
             )
             sp = None
         if sp is not None:
-            o = _sp_attention(
-                sp[0], sp[1], split(q), split(k), split(v), self.causal
-            )
+            o = _sp_attention(sp[0], sp[1], q, k, v, self.causal)
         else:
-            o = flash_attention(split(q), split(k), split(v), causal=self.causal)
+            o = flash_attention(q, k, v, causal=self.causal)
         o = o.transpose(0, 2, 1, 3).reshape(B, S, E)
         y = jnp.einsum("bse,fe->bsf", o, w_out) + b_out
         return LayerOutput(outputs=[y])
