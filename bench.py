@@ -40,7 +40,12 @@ LAST_GOOD_PATH = os.path.join(os.path.dirname(__file__), "docs", "bench_last_goo
 # peak table (sparknet_tpu.common.TPU_PEAK_FLOPS) so bench.py and `tpunet
 # time --trace` can never drift apart again.  Importing sparknet_tpu.common
 # does NOT initialize a jax backend (safe before the probe).
-from sparknet_tpu.common import TPU_PEAK_FLOPS, V5E_HBM_BYTES_S  # noqa: E402
+from sparknet_tpu.common import (  # noqa: E402
+    TPU_PEAK_FLOPS,
+    V5E_HBM_BYTES_S,
+    bank_guard,
+    bank_path,
+)
 
 V5E_PEAK_FLOPS = TPU_PEAK_FLOPS["v5e"]
 
@@ -403,19 +408,16 @@ def measured_run(batch: int, iters: int, warmup: int, model: str, crop: int,
 
 
 def record_last_good(rec: dict) -> None:
-    # temp-file + atomic rename: the watchdog's os._exit can fire at any
-    # moment, and a half-written last-good file would silently destroy the
-    # very evidence this file exists to preserve
-    try:
-        rec = dict(rec)
-        rec["recorded_utc"] = time.strftime(
-            "%Y-%m-%d %H:%M:%SZ", time.gmtime())
-        tmp = LAST_GOOD_PATH + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(rec, f, indent=1)
-        os.replace(tmp, LAST_GOOD_PATH)
-    except OSError:
-        pass  # read-only checkout: the printed line is still the record
+    # common.bank_guard: temp-file + atomic rename (the watchdog's
+    # os._exit can fire at any moment), and — defense in depth behind the
+    # callers' own platform gate — a rec not stamped measured:true
+    # diverts to /tmp instead of overwriting the banked evidence.  A
+    # read-only checkout is non-fatal: the printed line is still the
+    # record.
+    rec = dict(rec)
+    rec["recorded_utc"] = time.strftime(
+        "%Y-%m-%d %H:%M:%SZ", time.gmtime())
+    bank_guard(LAST_GOOD_PATH, rec, measured=bool(rec.get("measured")))
 
 
 def cost_model_estimate(batch: int, model: str, crop: int, dtype_name: str) -> dict:
@@ -704,13 +706,15 @@ def main() -> int:
             os._exit(0)
         results = []
         # CPU rehearsals (FORCE_ACCEL_PATH on a cpu backend) must never
-        # bank over measured evidence — divert OUTSIDE docs/ entirely and
-        # stamp the payload (same rule as int8_bench/layout_ab: CPU runs
-        # don't bank).
+        # bank over measured evidence — common.bank_guard diverts them
+        # OUTSIDE docs/ and stamps the payload (same rule as
+        # int8_bench/layout_ab: CPU runs don't bank).
         rehearsal = platform == "cpu"
-        path = (os.path.join(os.path.dirname(__file__), "docs",
-                             "bench_extra_last.json")
-                if not rehearsal else "/tmp/bench_extra_rehearsal.json")
+        docs_path = os.path.join(os.path.dirname(__file__), "docs",
+                                 "bench_extra_last.json")
+        # where this run's payloads land (and where last window's carry
+        # is read from): bank_path mirrors bank_guard's diversion
+        path = bank_path(docs_path, measured=not rehearsal)
         # A wedge during extra 1 must not pair the PREVIOUS window's
         # extras with this run's fresh headline — but those extras are
         # scarce measured evidence, so carry them under an explicitly
@@ -733,20 +737,15 @@ def main() -> int:
 
         def bank() -> None:
             # re-written after EVERY extra: a later extra hanging into the
-            # hard-exit timer must not discard the ones already measured
+            # hard-exit timer must not discard the ones already measured.
+            # bank_guard stamps rehearsal payloads and writes atomically;
+            # the lock serializes the shared .tmp file between this
+            # thread and _extra_bail's timer thread.
             payload = {"headline": rec, "extras": list(results)}
-            if rehearsal:
-                payload["rehearsal"] = True
-                payload["note"] = "CPU rehearsal — not evidence"
             if previous is not None:
                 payload["previous_run"] = previous
-            try:
-                with bank_lock:
-                    with open(path + ".tmp", "w") as f:
-                        json.dump(payload, f, indent=1)
-                    os.replace(path + ".tmp", path)
-            except OSError:
-                pass
+            with bank_lock:
+                bank_guard(docs_path, payload, measured=not rehearsal)
 
         # bank the fresh headline immediately: a wedge during extra 1 must
         # not leave the side file pairing a stale headline with stale extras

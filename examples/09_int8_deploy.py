@@ -57,9 +57,13 @@ def main():
 
         with ctx or contextlib.nullcontext():
             jf = jax.jit(fwd)
-            out = np.asarray(jax.block_until_ready(jf(variables, feeds)))
+            # np.asarray IS the fence: it copies the VALUE of the
+            # program's own output buffer (block_until_ready only proves
+            # readiness, which relay backends report early — see
+            # common.value_fence; graftlint fence-by-value)
+            out = np.asarray(jf(variables, feeds))
             t0 = time.perf_counter()
-            out = np.asarray(jax.block_until_ready(jf(variables, feeds)))
+            out = np.asarray(jf(variables, feeds))
             ms = (time.perf_counter() - t0) * 1e3
         pred = np.argmax(out, -1)
         acc = float((pred == yte[:128]).mean())

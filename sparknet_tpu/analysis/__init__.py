@@ -1,0 +1,48 @@
+"""graftlint: AST static analysis for the repo's TPU execution contracts.
+
+Machine-checks the relay-era rules that previously lived only as prose
+in CLAUDE.md and the ``common.value_fence`` docstring — timing fences,
+platform pinning, evidence banking (SparkNet's equivalent contracts were
+enforced by Spark around the native solver; ref: PAPER.md, Moritz et
+al., arXiv:1511.06051 — here the system must check them itself).
+
+Usage:
+
+    python -m sparknet_tpu.analysis                # default repo scope
+    python -m sparknet_tpu.analysis tools bench.py --format json
+    python -m sparknet_tpu.analysis --list-rules
+
+Library API: ``lint_paths`` / ``lint_source`` return ``Finding``
+records; CI asserts ``not [f for f in findings if not f.suppressed]``
+(tests/test_graftlint.py::test_repo_self_lint_is_clean).
+
+IMPORTANT: the analysis modules themselves are stdlib-only, and nothing
+on this package's import path may INITIALIZE a jax backend (no
+``jax.devices()``, no compiles): the linter has to run on boxes where
+the first backend touch dials a wedged TPU relay and hangs ~25 min.
+Importing jax via the parent package is safe — backend init is lazy —
+but keep it that way.
+"""
+
+from sparknet_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    RULES,
+    lint_file,
+    lint_paths,
+    lint_source,
+    render_json,
+    render_text,
+    rule,
+)
+from sparknet_tpu.analysis import rules as _rules  # noqa: F401  (registers)
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "lint_file",
+    "lint_paths",
+    "lint_source",
+    "render_json",
+    "render_text",
+    "rule",
+]

@@ -1,0 +1,86 @@
+"""graftlint CLI: ``python -m sparknet_tpu.analysis [paths] [options]``.
+
+Exit codes: 0 clean (or suppressed-only), 1 unsuppressed findings,
+2 usage error.  With no paths, lints the repo's contract surface —
+``sparknet_tpu/``, ``tools/``, ``bench.py`` — the same set the tier-1
+self-lint test pins (tests/test_graftlint.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from sparknet_tpu.analysis import (
+    RULES,
+    lint_paths,
+    render_json,
+    render_text,
+)
+
+# repo root = parent of the sparknet_tpu package directory
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+DEFAULT_SCOPE = ("sparknet_tpu", "tools", "bench.py")
+
+
+def default_paths() -> list[str]:
+    """The standard lint scope, resolved against the repo root so the
+    command works from any cwd.  tests/ and examples/ are deliberately
+    out of scope: test fixtures contain intentional violations, and the
+    examples are narrated walkthroughs linted by review, not CI."""
+    out = []
+    for rel in DEFAULT_SCOPE:
+        p = os.path.join(_REPO, rel)
+        if os.path.exists(p):
+            out.append(p)
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m sparknet_tpu.analysis",
+        description="graftlint: machine-check the repo's TPU timing, "
+        "platform, and evidence-banking contracts",
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories (default: repo scope "
+                    f"{'/'.join(DEFAULT_SCOPE)})")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--rule", action="append", default=[],
+                    help="run only this rule id (repeatable)")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print suppressed findings (text format)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for info in RULES.values():
+            print(f"{info.id}: {info.summary}")
+        return 0
+
+    unknown = set(args.rule) - set(RULES)
+    if unknown:
+        print(f"unknown rule id(s): {', '.join(sorted(unknown))} "
+              f"(--list-rules for the catalog)", file=sys.stderr)
+        return 2
+
+    paths = args.paths or default_paths()
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"no such path: {', '.join(missing)}", file=sys.stderr)
+        return 2
+
+    findings = lint_paths(paths, only=set(args.rule) or None)
+    if args.format == "json":
+        print(render_json(findings))
+    else:
+        print(render_text(findings, show_suppressed=args.show_suppressed))
+    return 1 if any(not f.suppressed for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,412 @@
+"""graftlint rule set: the relay-era TPU contracts, machine-checked.
+
+Each rule codifies one hard-won operational lesson from rounds 1-5
+(CLAUDE.md "TPU tunnel protocol"; ``sparknet_tpu.common.value_fence``
+docstring).  Rules are AST heuristics, deliberately tuned to catch the
+in-tree shapes that actually burned us — a rule that cries wolf gets
+suppressed into uselessness, so each one documents its known blind
+spots instead of chasing them.
+
+Adding a rule: write ``def check_x(ctx) -> Iterator[(lineno, msg)]``,
+decorate with ``@rule("rule-id", "one-line summary")``, add fixtures to
+``tests/test_graftlint.py`` (positive, suppressed, clean) and a catalog
+entry to ``docs/LINTING.md``.
+"""
+# graftlint: disable-file=no-pkill-self -- this module DEFINES that rule; its docstrings and finding messages must spell the banned string
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from sparknet_tpu.analysis.core import (
+    ModuleContext,
+    Scope,
+    arg_names,
+    assigned_names,
+    call_name,
+    rule,
+)
+
+# ---------------------------------------------------------------------------
+# Shared: what counts as a "timing window" inside one scope.
+#
+# A scope times something when it calls ``time.perf_counter`` at least
+# twice (t0 = ...; ... ; dt = perf_counter() - t0), or drives a
+# ``Timer``-style helper (utils/timing.py wraps perf_counter behind
+# .start()/.stop()).  The window is the [first-marker, last-marker]
+# line span; nodes inside it are "timed".
+# ---------------------------------------------------------------------------
+
+
+def _timing_window(scope: Scope) -> tuple[int, int] | None:
+    marks: list[int] = []
+    uses_timer = any(
+        isinstance(n, ast.Name) and n.id == "Timer" for n in scope.walk())
+    for c in scope.calls():
+        name = call_name(c)
+        if name == "perf_counter":
+            marks.append(c.lineno)
+        elif uses_timer and name in ("start", "stop"):
+            marks.append(c.lineno)
+    if len(marks) < 2:
+        return None
+    return min(marks), max(marks)
+
+
+def _in_window(node: ast.AST, window: tuple[int, int]) -> bool:
+    lo, hi = window
+    return lo <= node.lineno <= hi
+
+
+# ---------------------------------------------------------------------------
+# fence-by-value
+# ---------------------------------------------------------------------------
+
+
+@rule(
+    "fence-by-value",
+    "block_until_ready inside a timing window is not an execution fence "
+    "on relay backends; fence on a fetched VALUE (common.value_fence)",
+)
+def check_fence_by_value(ctx: ModuleContext) -> Iterator[tuple[int, str]]:
+    """``jax.block_until_ready`` reports buffers ready before the chain
+    has executed on the axon relay (probe-40 banked an impossible
+    8.2M img/s off it).  Any use of it in a scope that also reads
+    ``perf_counter`` is timing through readiness, not execution.
+
+    One ``perf_counter`` in the scope is enough to trigger: a
+    ``Timer.stop``-style method is only the CLOSING edge of a window
+    opened elsewhere, and is exactly where the readiness fence hides.
+    Blind spot: a helper function that only fences (no perf_counter of
+    its own) called from a timing loop is not flagged — the stale-args
+    rule usually catches that loop instead.
+    """
+    for scope in ctx.scopes():
+        has_clock = any(
+            call_name(c) == "perf_counter" for c in scope.calls())
+        if not has_clock:
+            continue
+        for c in scope.calls():
+            if call_name(c) == "block_until_ready":
+                yield (
+                    c.lineno,
+                    "block_until_ready in a timing window only proves "
+                    "readiness, not execution, on relay backends — fence "
+                    "on the fetched VALUE of the producing program's own "
+                    "output (sparknet_tpu.common.value_fence)",
+                )
+
+
+# ---------------------------------------------------------------------------
+# no-env-platform
+# ---------------------------------------------------------------------------
+
+
+def _writes_jax_platforms_env(node: ast.AST) -> int | None:
+    """Line of an ``os.environ``-level write of JAX_PLATFORMS, else None.
+
+    Shapes: ``os.environ["JAX_PLATFORMS"] = ...``,
+    ``environ["JAX_PLATFORMS"] = ...``, ``os.environ.setdefault/update``
+    with the key.  Writes into plain dicts (subprocess ``env=`` payloads)
+    are the CHILD process's contract and are not flagged here.
+    """
+
+    def is_environ(expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id == "environ"
+        if isinstance(expr, ast.Attribute):
+            return expr.attr == "environ"
+        return False
+
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            if (isinstance(t, ast.Subscript) and is_environ(t.value)
+                    and isinstance(t.slice, ast.Constant)
+                    and t.slice.value == "JAX_PLATFORMS"):
+                return node.lineno
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        if (node.func.attr in ("setdefault", "update")
+                and is_environ(node.func.value)):
+            for a in node.args:
+                if isinstance(a, ast.Constant) and a.value == "JAX_PLATFORMS":
+                    return node.lineno
+                if isinstance(a, ast.Dict):
+                    for k in a.keys:
+                        if (isinstance(k, ast.Constant)
+                                and k.value == "JAX_PLATFORMS"):
+                            return node.lineno
+    return None
+
+
+def _pins_platform_via_config(ctx: ModuleContext) -> bool:
+    """True if the module also pins through the route that actually wins:
+    ``jax.config.update("jax_platforms", ...)`` or
+    ``common.force_platform(...)``."""
+    for n in ast.walk(ctx.tree):
+        if not isinstance(n, ast.Call):
+            continue
+        name = call_name(n)
+        if name == "force_platform":
+            return True
+        if name == "update" and n.args:
+            a0 = n.args[0]
+            if isinstance(a0, ast.Constant) and a0.value == "jax_platforms":
+                return True
+    return False
+
+
+@rule(
+    "no-env-platform",
+    "JAX_PLATFORMS env-var writes do not force a platform under the site "
+    "hook; pin via jax.config.update('jax_platforms', ...) as well",
+)
+def check_no_env_platform(ctx: ModuleContext) -> Iterator[tuple[int, str]]:
+    """The site hook pre-pins ``jax.config.jax_platforms`` to the axon
+    plugin, and the config route outranks the env var — so a process
+    that only sets ``JAX_PLATFORMS=cpu`` still dials the TPU relay and
+    hangs ~25 minutes (CLAUDE.md "Platform gotcha").
+
+    A module that writes the env var AND pins via the config route (or
+    ``common.force_platform``) is belt-and-braces for its subprocesses
+    and passes.  Modules that never import jax pass too: they cannot
+    initialize a backend themselves.
+    """
+    if not ctx.imports_jax():
+        return
+    if _pins_platform_via_config(ctx):
+        return
+    for n in ast.walk(ctx.tree):
+        line = _writes_jax_platforms_env(n)
+        if line is not None:
+            yield (
+                line,
+                "writing JAX_PLATFORMS in a jax-importing module without "
+                "a jax.config.update('jax_platforms', ...) pin — the site "
+                "hook makes the env var a no-op and this process will "
+                "dial the TPU relay anyway",
+            )
+
+
+# ---------------------------------------------------------------------------
+# bank-guard
+# ---------------------------------------------------------------------------
+
+# What counts as banked chip evidence: the *_last*.json ratchet files and
+# the headline last-good record.  docs/evidence_r<N>/ journals are the
+# window runner's host-side ledger (never measurement-gated), and sweep
+# outputs (tau_sweep_*.json) are CPU-runnable convergence artifacts —
+# both deliberately outside this pattern.
+_EVIDENCE = re.compile(r"(_last[a-z0-9_]*\.json)|(bench_last_good\.json)")
+
+
+def _is_write_open(call: ast.Call) -> bool:
+    if call_name(call) != "open":
+        return False
+    mode = None
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+        mode = call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            mode = kw.value.value
+    return isinstance(mode, str) and mode[:1] in ("w", "a", "x")
+
+
+@rule(
+    "bank-guard",
+    "evidence files (docs/*_last*.json) may only be written through "
+    "common.bank_guard, which diverts unmeasured runs away from docs/",
+)
+def check_bank_guard(ctx: ModuleContext) -> Iterator[tuple[int, str]]:
+    """A stray CPU smoke run once overwrote ``docs/int8_bench_last.json``
+    (round-5 note: "CPU runs of evidence tools must never bank").  The
+    blessed sink is ``sparknet_tpu.common.bank_guard(path, payload,
+    measured=...)`` — it stamps and diverts rehearsal payloads to /tmp.
+    This rule flags any direct write-mode ``open`` in a scope that
+    mentions an evidence path, except inside ``bank_guard`` itself.
+    Module-level evidence strings (path constants like bench.py's
+    ``LAST_GOOD_PATH``) are ambient: they arm every scope in the file.
+    """
+    module_evidence = any(
+        _EVIDENCE.search(s) for s in ctx.module_strings())
+    for scope in ctx.scopes():
+        if scope.name == "bank_guard":
+            continue
+        has_evidence = module_evidence or any(
+            _EVIDENCE.search(s.value) for s in scope.strings())
+        if not has_evidence:
+            continue
+        for c in scope.calls():
+            if _is_write_open(c):
+                yield (
+                    c.lineno,
+                    "direct write to an evidence path — route it through "
+                    "sparknet_tpu.common.bank_guard(path, payload, "
+                    "measured=...) so unmeasured runs divert to /tmp "
+                    "instead of overwriting banked chip evidence",
+                )
+
+
+# ---------------------------------------------------------------------------
+# require-measured
+# ---------------------------------------------------------------------------
+
+_REQ_ENV = "SPARKNET_BENCH_REQUIRE_MEASURED"
+
+
+def _emits_measured_records(ctx: ModuleContext) -> int | None:
+    """Line of the first ``"measured"`` dict-literal key or ``measured=``
+    keyword (a record the window runner will read), else None."""
+    for n in ast.walk(ctx.tree):
+        if isinstance(n, ast.Dict):
+            for k in n.keys:
+                if isinstance(k, ast.Constant) and k.value == "measured":
+                    return n.lineno
+        if isinstance(n, ast.Call):
+            for kw in n.keywords:
+                if kw.arg == "measured":
+                    return n.lineno
+    return None
+
+
+@rule(
+    "require-measured",
+    "chip-evidence scripts must honor SPARKNET_BENCH_REQUIRE_MEASURED "
+    "(rc 4 on unmeasured runs) so queue runners retry instead of "
+    "marking the job done",
+)
+def check_require_measured(ctx: ModuleContext) -> Iterator[tuple[int, str]]:
+    """Under the window runner, a job that silently falls back to CPU
+    mid-window and exits 0 reads as success — the measurement is lost
+    for the round (round-5 note: "arm it in every queue job or a wedge
+    mid-window marks the job done").  Any script module (has a
+    ``__main__`` guard) that emits ``measured``-keyed records must
+    consult the env knob, either by its literal name or via
+    ``bench._require_measured()``.
+    """
+    if not ctx.has_main_guard():
+        return
+    line = _emits_measured_records(ctx)
+    if line is None:
+        return
+    honors = any(_REQ_ENV in s for s in ctx.module_strings()) or any(
+        isinstance(n, ast.Call)
+        and call_name(n) in ("_require_measured", "require_measured")
+        for n in ast.walk(ctx.tree))
+    if not honors:
+        yield (
+            line,
+            f"this script emits 'measured' records but never consults "
+            f"{_REQ_ENV}: under the window runner an unmeasured fallback "
+            f"exits 0 and the job is marked done — honor the knob "
+            f"(exit rc 4 when armed and unmeasured)",
+        )
+
+
+# ---------------------------------------------------------------------------
+# stale-args-dispatch
+# ---------------------------------------------------------------------------
+
+# calls that are host-side bookkeeping, not device dispatches
+_LOOP_CALL_WHITELIST = {
+    "perf_counter", "print", "append", "extend", "update", "range",
+    "len", "int", "float", "str", "repr", "next", "iter", "sleep",
+    "flush", "write", "format", "join", "get", "items", "keys",
+    "values", "dumps", "loads", "asarray", "isfinite", "abs", "round",
+}
+
+
+@rule(
+    "stale-args-dispatch",
+    "a timed loop must thread state between dispatches: identical "
+    "repeated args give the relay a second way to answer without "
+    "executing",
+)
+def check_stale_args(ctx: ModuleContext) -> Iterator[tuple[int, str]]:
+    """The second ``value_fence`` trap: repeated dispatches of one jitted
+    callable with bit-identical arguments let the relay serve cached
+    answers — the round-4 ``tpunet time`` artifacts banked 0.256
+    ms/step => 7,860% MFU off exactly this.  A timed loop passes when
+    at least one argument of each non-trivial call is (re)assigned
+    inside the loop body (threaded state), as ``bench.measured_run``
+    does with ``variables, slots``.
+
+    Scoped to jax-importing modules: host-side loops (numpy transforms,
+    PIL decodes) repeat identical args and really do the work each time.
+    """
+    if not ctx.imports_jax():
+        return
+    for scope in ctx.scopes():
+        window = _timing_window(scope)
+        if window is None:
+            continue
+        for node in scope.walk():
+            if not isinstance(node, (ast.For, ast.While)):
+                continue
+            if not _in_window(node, window):
+                continue
+            bound = assigned_names(node.body)
+            if isinstance(node, ast.For):
+                for n in ast.walk(node.target):
+                    if isinstance(n, ast.Name):
+                        bound.add(n.id)
+            for c in _loop_body_calls(node):
+                name = call_name(c)
+                if name in _LOOP_CALL_WHITELIST:
+                    continue
+                names = arg_names(c)
+                if not names:
+                    continue  # constants-only helper, not a dispatch shape
+                if names & bound:
+                    continue  # threaded: consumes loop-assigned state
+                yield (
+                    c.lineno,
+                    f"'{name}(...)' is dispatched repeatedly inside a "
+                    "timed loop with arguments never reassigned in the "
+                    "loop body — thread the previous output into the "
+                    "next call (see common.value_fence: un-threaded "
+                    "repeats are not timeable on relay backends)",
+                )
+
+
+def _loop_body_calls(loop: ast.For | ast.While) -> Iterator[ast.Call]:
+    stack = list(loop.body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue  # nested defs are their own scope
+        if isinstance(n, ast.Call):
+            yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+# ---------------------------------------------------------------------------
+# no-pkill-self
+# ---------------------------------------------------------------------------
+
+_PKILL = re.compile(r"\bpkill\b[^'\"]*-f")
+
+
+@rule(
+    "no-pkill-self",
+    "pkill -f matches the calling shell's own command line (exit 144); "
+    "use pgrep -f with a [b]racketed pattern and kill by pid",
+)
+def check_no_pkill(ctx: ModuleContext) -> Iterator[tuple[int, str]]:
+    """Round-5 note: ``pkill -f <pattern>`` run through a shell whose own
+    cmdline contains the pattern kills the shell (exit 144) and the
+    intended command never runs.  Flag the string anywhere in Python
+    source — subprocess payloads, queue-job builders, doc strings in
+    runnable snippets all count.
+    """
+    for n in ast.walk(ctx.tree):
+        if (isinstance(n, ast.Constant) and isinstance(n.value, str)
+                and _PKILL.search(n.value)):
+            yield (
+                n.lineno,
+                "'pkill -f <pattern>' can match the calling shell itself "
+                "(exit 144, command never runs) — use "
+                "pgrep -f '/path/narrow[p]attern' and kill by pid",
+            )

@@ -7,6 +7,14 @@ caffe/tools/caffe.cpp:290-380).  On TPU a real training step is ONE fused
 XLA program, so per-layer numbers here are diagnostic (each layer jitted
 and fenced in isolation) — the fused step is strictly faster; use
 ``jax.profiler`` traces for the true schedule.
+
+LOCAL BACKENDS ONLY: this module times through ``block_until_ready``
+and repeats dispatches with identical args, both of which are
+untimeable over the axon relay (graftlint ``fence-by-value`` /
+``stale-args-dispatch``; suppressed below with this justification).
+Every relay-facing timing path — bench.py, ``tpunet time --fused`` /
+``--trace`` — instead fences on a fetched VALUE with threaded state
+(``common.value_fence``).
 """
 
 from __future__ import annotations
@@ -32,6 +40,7 @@ class Timer:
 
     def stop(self, fence: Any = None) -> float:
         if fence is not None:
+            # graftlint: disable-next-line=fence-by-value -- local-backend diagnostic (readiness IS execution without a relay); relay timing uses common.value_fence
             jax.block_until_ready(fence)
         self.elapsed_ms = (time.perf_counter() - self._t0) * 1e3
         return self.elapsed_ms
@@ -62,6 +71,7 @@ def time_layers(network, variables, feeds, iterations: int = 10) -> list[dict]:
         tops = jfwd(params, state, inputs)  # compile + capture outputs
         t = Timer().start()
         for _ in range(iterations):
+            # graftlint: disable-next-line=stale-args-dispatch -- per-layer diagnostic on local backends, where repeat dispatches really execute; the honest TPU path is the traced fused step (op_profile)
             tops = jfwd(params, state, inputs)
         fwd_ms = t.stop(tops) / iterations
 
@@ -85,6 +95,7 @@ def time_layers(network, variables, feeds, iterations: int = 10) -> list[dict]:
                 g = jbwd(params, [inputs[i] for i in float_idx])
                 t = Timer().start()
                 for _ in range(iterations):
+                    # graftlint: disable-next-line=stale-args-dispatch -- same local-backend diagnostic caveat as the forward loop above
                     g = jbwd(params, [inputs[i] for i in float_idx])
                 bwd_ms = t.stop(g) / iterations
             except Exception:

@@ -31,3 +31,66 @@ def test_googlenet_rejects_non_multiple_of_32_crop():
 
     with pytest.raises(ValueError, match="multiple of 32"):
         zoo.googlenet(batch=1, num_classes=10, crop=95)
+
+
+# -- bank_guard: the one blessed evidence sink (graftlint bank-guard) -------
+
+
+@pytest.mark.smoke
+def test_bank_guard_measured_writes_in_place(tmp_path):
+    from sparknet_tpu.common import bank_guard
+
+    path = str(tmp_path / "int8_bench_last.json")
+    written = bank_guard(path, {"arms": [1, 2]}, measured=True)
+    assert written == path
+    import json
+
+    with open(path) as f:
+        payload = json.load(f)
+    assert payload == {"arms": [1, 2]}  # no rehearsal stamp on evidence
+    assert not os.path.exists(path + ".tmp")  # atomic: tmp file consumed
+
+
+@pytest.mark.smoke
+def test_bank_guard_unmeasured_diverts_and_stamps(tmp_path):
+    """A CPU rehearsal must land OUTSIDE the requested (docs/) location,
+    stamped so it can never read as chip evidence — the round-5 rule
+    after a smoke run overwrote docs/int8_bench_last.json."""
+    import json
+    import tempfile
+
+    from sparknet_tpu.common import bank_guard
+
+    path = str(tmp_path / "docs" / "int8_bench_last.json")
+    written = bank_guard(path, {"arms": []}, measured=False)
+    assert written is not None
+    assert not os.path.exists(path)  # nothing under the evidence path
+    assert written == os.path.join(tempfile.gettempdir(),
+                                   "int8_bench_last_rehearsal.json")
+    with open(written) as f:
+        payload = json.load(f)
+    assert payload["rehearsal"] is True
+    assert payload["arms"] == []
+
+
+@pytest.mark.smoke
+def test_bank_path_idempotent_on_rehearsal_names():
+    from sparknet_tpu.common import bank_path
+
+    p1 = bank_path("docs/bench_extra_last.json", measured=False)
+    assert bank_path(p1, measured=False) == p1  # no _rehearsal_rehearsal
+    assert bank_path("docs/x_last.json", measured=True) == "docs/x_last.json"
+
+
+@pytest.mark.smoke
+def test_record_last_good_refuses_unmeasured_records(tmp_path, monkeypatch):
+    """Defense in depth behind the callers' platform gate: a rec without
+    measured:true diverts away from docs/bench_last_good.json."""
+    import bench
+
+    path = str(tmp_path / "bench_last_good.json")
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH", path)
+    bench.record_last_good({"metric": "m", "value": 1.0, "measured": False})
+    assert not os.path.exists(path)
+    bench.record_last_good({"metric": "m", "value": 2.0, "measured": True})
+    assert os.path.exists(path)

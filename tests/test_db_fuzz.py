@@ -11,8 +11,13 @@ would miss.
 
 import itertools
 
-from hypothesis import HealthCheck, given, settings
-from hypothesis import strategies as st
+import pytest
+
+# gate, don't hard-import: boxes without hypothesis must still COLLECT
+# the suite (a bare ImportError here interrupts the whole pytest run)
+pytest.importorskip("hypothesis")
+from hypothesis import HealthCheck, given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
 
 from sparknet_tpu.data.leveldb_io import LevelDbReader, LevelDbWriter
 from sparknet_tpu.data.leveldb_io import snappy_decompress

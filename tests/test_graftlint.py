@@ -1,0 +1,522 @@
+"""graftlint: fixture snippets per rule + the repo-wide self-lint gate.
+
+Each rule gets three fixtures — a positive hit, the same hit suppressed
+with a justified directive, and a clean rewrite — so the rule's
+boundary is pinned from both sides.  ``test_repo_self_lint_is_clean``
+is the CI wiring: it runs the analyzer over the repo's contract surface
+(``sparknet_tpu/``, ``tools/``, ``bench.py``) and fails on any
+unsuppressed finding, so future PRs cannot reintroduce unfenced timing
+or unguarded evidence banking (the probe-40 / round-4 artifact class).
+
+All smoke-marked: the analyzer is stdlib-AST only, no jax dispatch.
+"""
+# graftlint: disable-file=no-pkill-self -- PKILL_BAD/PKILL_GOOD are this rule's own fixture strings
+
+import json
+
+import pytest
+
+from sparknet_tpu.analysis import RULES, lint_paths, lint_source
+from sparknet_tpu.analysis.__main__ import default_paths
+from sparknet_tpu.analysis.__main__ import main as cli_main
+
+pytestmark = pytest.mark.smoke
+
+EXPECTED_RULES = {
+    "fence-by-value",
+    "no-env-platform",
+    "bank-guard",
+    "require-measured",
+    "stale-args-dispatch",
+    "no-pkill-self",
+}
+
+
+def hits(src, rule_id, path="snippet.py"):
+    """Unsuppressed findings of one rule for a source fixture."""
+    return [f for f in lint_source(src, path)
+            if f.rule == rule_id and not f.suppressed]
+
+
+def suppressed_hits(src, rule_id, path="snippet.py"):
+    return [f for f in lint_source(src, path)
+            if f.rule == rule_id and f.suppressed]
+
+
+# -- registry ---------------------------------------------------------------
+
+
+def test_rule_catalog_complete():
+    assert set(RULES) == EXPECTED_RULES
+    for info in RULES.values():
+        assert info.summary, info.id
+
+
+# -- fence-by-value ---------------------------------------------------------
+
+FENCE_BAD = """
+import time
+import jax
+
+def timed(step, x):
+    out = step(x)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = step(out)
+    jax.block_until_ready(out)
+    return time.perf_counter() - t0
+"""
+
+FENCE_GOOD = """
+import time
+from sparknet_tpu.common import value_fence
+
+def timed(step, x):
+    out = step(x)
+    value_fence(out)
+    t0 = time.perf_counter()
+    out = step(out)
+    value_fence(out)
+    return time.perf_counter() - t0
+"""
+
+
+def test_fence_by_value_positive():
+    found = hits(FENCE_BAD, "fence-by-value")
+    assert len(found) == 2
+    assert "value_fence" in found[0].message
+
+
+def test_fence_by_value_suppressed():
+    src = FENCE_BAD.replace(
+        "    jax.block_until_ready(out)",
+        "    jax.block_until_ready(out)  "
+        "# graftlint: disable=fence-by-value -- local-backend test rig")
+    assert not hits(src, "fence-by-value")
+    assert len(suppressed_hits(src, "fence-by-value")) == 2
+
+
+def test_fence_by_value_clean():
+    assert not hits(FENCE_GOOD, "fence-by-value")
+
+
+def test_fence_outside_timing_window_is_fine():
+    # readiness sync with no clock in scope is not a timing lie
+    src = "import jax\ndef sync(x):\n    jax.block_until_ready(x)\n"
+    assert not hits(src, "fence-by-value")
+
+
+# -- no-env-platform --------------------------------------------------------
+
+ENV_BAD = """
+import os
+import jax
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+print(jax.devices())
+"""
+
+ENV_GOOD_PAIRED = """
+import os
+import jax
+
+os.environ["JAX_PLATFORMS"] = "cpu"          # for subprocesses
+jax.config.update("jax_platforms", "cpu")    # the route that wins
+"""
+
+ENV_GOOD_NO_JAX = """
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # consumed by a child's own contract
+"""
+
+
+def test_no_env_platform_positive():
+    found = hits(ENV_BAD, "no-env-platform")
+    assert len(found) == 1
+    assert "site hook" in found[0].message
+
+
+def test_no_env_platform_setdefault_positive():
+    src = ENV_BAD.replace('os.environ["JAX_PLATFORMS"] = "cpu"',
+                          'os.environ.setdefault("JAX_PLATFORMS", "cpu")')
+    assert len(hits(src, "no-env-platform")) == 1
+
+
+def test_no_env_platform_suppressed():
+    src = ENV_BAD.replace(
+        'os.environ["JAX_PLATFORMS"] = "cpu"',
+        'os.environ["JAX_PLATFORMS"] = "cpu"  '
+        "# graftlint: disable=no-env-platform -- child processes only")
+    assert not hits(src, "no-env-platform")
+    assert suppressed_hits(src, "no-env-platform")
+
+
+def test_no_env_platform_clean_when_config_pinned():
+    # the conftest.py / multihost_worker.py shape: env var AND config pin
+    assert not hits(ENV_GOOD_PAIRED, "no-env-platform")
+
+
+def test_no_env_platform_clean_without_jax():
+    assert not hits(ENV_GOOD_NO_JAX, "no-env-platform")
+
+
+# -- bank-guard -------------------------------------------------------------
+
+BANK_BAD = """
+import json
+import os
+
+def save(rec):
+    path = "docs/int8_bench_last.json"
+    with open(path + ".tmp", "w") as f:
+        json.dump(rec, f)
+    os.replace(path + ".tmp", path)
+"""
+
+BANK_GOOD = """
+from sparknet_tpu.common import bank_guard
+
+def save(rec, on_accel):
+    bank_guard("docs/int8_bench_last.json", rec, measured=on_accel)
+"""
+
+BANK_MODULE_CONST = """
+import json
+
+PATH = "docs/bench_last_good.json"
+
+def save(rec):
+    with open(PATH, "w") as f:
+        json.dump(rec, f)
+"""
+
+
+def test_bank_guard_positive():
+    found = hits(BANK_BAD, "bank-guard")
+    assert len(found) == 1
+    assert "bank_guard" in found[0].message
+
+
+def test_bank_guard_sees_module_level_path_constants():
+    # the bench.py LAST_GOOD_PATH shape: string at module scope, write in
+    # a function — module strings are ambient
+    assert len(hits(BANK_MODULE_CONST, "bank-guard")) == 1
+
+
+def test_bank_guard_suppressed():
+    src = BANK_BAD.replace(
+        'with open(path + ".tmp", "w") as f:',
+        'with open(path + ".tmp", "w") as f:  '
+        "# graftlint: disable=bank-guard -- offline re-attribution tool")
+    assert not hits(src, "bank-guard")
+    assert suppressed_hits(src, "bank-guard")
+
+
+def test_bank_guard_clean_via_helper():
+    assert not hits(BANK_GOOD, "bank-guard")
+
+
+def test_bank_guard_read_is_fine():
+    src = ('import json\n'
+           'def load():\n'
+           '    with open("docs/bench_last_good.json") as f:\n'
+           '        return json.load(f)\n')
+    assert not hits(src, "bank-guard")
+
+
+def test_bank_guard_non_evidence_write_is_fine():
+    src = ('import json\n'
+           'def save(rec):\n'
+           '    with open("docs/tau_sweep_alexnet.json", "w") as f:\n'
+           '        json.dump(rec, f)\n')
+    assert not hits(src, "bank-guard")
+
+
+# -- require-measured -------------------------------------------------------
+
+REQ_BAD = """
+import json
+
+def main():
+    print(json.dumps({"metric": "x_img_s", "measured": False}))
+    return 0
+
+if __name__ == "__main__":
+    main()
+"""
+
+REQ_GOOD = """
+import json
+import os
+
+def main():
+    print(json.dumps({"metric": "x_img_s", "measured": False}))
+    if os.environ.get("SPARKNET_BENCH_REQUIRE_MEASURED") == "1":
+        return 4
+    return 0
+
+if __name__ == "__main__":
+    main()
+"""
+
+REQ_GOOD_HELPER = """
+import json
+import bench
+
+def main():
+    print(json.dumps({"metric": "x_img_s", "measured": False}))
+    return 4 if bench._require_measured() else 0
+
+if __name__ == "__main__":
+    main()
+"""
+
+
+def test_require_measured_positive():
+    found = hits(REQ_BAD, "require-measured")
+    assert len(found) == 1
+    assert "SPARKNET_BENCH_REQUIRE_MEASURED" in found[0].message
+
+
+def test_require_measured_suppressed():
+    src = REQ_BAD.replace(
+        '    print(json.dumps({"metric": "x_img_s", "measured": False}))',
+        '    print(json.dumps({"metric": "x_img_s", "measured": False}))  '
+        "# graftlint: disable=require-measured -- never queued on chip")
+    assert not hits(src, "require-measured")
+    assert suppressed_hits(src, "require-measured")
+
+
+def test_require_measured_clean_env_literal():
+    assert not hits(REQ_GOOD, "require-measured")
+
+
+def test_require_measured_clean_bench_helper():
+    assert not hits(REQ_GOOD_HELPER, "require-measured")
+
+
+def test_require_measured_ignores_libraries_and_hostside_tools():
+    # no __main__ guard -> library module, not a queueable script
+    assert not hits("x = {'measured': True}\n", "require-measured")
+    # script without measured records (host-side tool) is fine too
+    src = ('import json\n'
+           'def main():\n'
+           '    print(json.dumps({"metric": "feed_ms"}))\n'
+           'if __name__ == "__main__":\n'
+           '    main()\n')
+    assert not hits(src, "require-measured")
+
+
+# -- stale-args-dispatch ----------------------------------------------------
+
+STALE_BAD = """
+import time
+import jax
+
+def bench(step, feeds):
+    t0 = time.perf_counter()
+    for _ in range(20):
+        loss = step(feeds)
+    return time.perf_counter() - t0
+"""
+
+STALE_GOOD_THREADED = """
+import time
+import jax
+
+def bench(step, variables, slots, feeds, key):
+    t0 = time.perf_counter()
+    for i in range(20):
+        variables, slots, loss = step(variables, slots, i, feeds, key)
+    float(loss)
+    return time.perf_counter() - t0
+"""
+
+STALE_NO_JAX = """
+import time
+
+def bench(xform, raw):
+    t0 = time.perf_counter()
+    for _ in range(20):
+        out = xform(raw)
+    return time.perf_counter() - t0
+"""
+
+
+def test_stale_args_positive():
+    found = hits(STALE_BAD, "stale-args-dispatch")
+    assert len(found) == 1
+    assert "thread" in found[0].message
+
+
+def test_stale_args_suppressed():
+    src = STALE_BAD.replace(
+        "        loss = step(feeds)",
+        "        loss = step(feeds)  "
+        "# graftlint: disable=stale-args-dispatch -- local diagnostic")
+    assert not hits(src, "stale-args-dispatch")
+    assert suppressed_hits(src, "stale-args-dispatch")
+
+
+def test_stale_args_clean_when_threaded():
+    assert not hits(STALE_GOOD_THREADED, "stale-args-dispatch")
+
+
+def test_stale_args_ignores_hostside_modules():
+    # no jax import: a numpy/PIL loop really does the work every call
+    assert not hits(STALE_NO_JAX, "stale-args-dispatch")
+
+
+def test_stale_args_ignores_untimed_loops():
+    src = ('import jax\n'
+           'def warmup(step, feeds):\n'
+           '    for _ in range(3):\n'
+           '        loss = step(feeds)\n'
+           '    return loss\n')
+    assert not hits(src, "stale-args-dispatch")
+
+
+# -- no-pkill-self ----------------------------------------------------------
+
+PKILL_BAD = """
+import subprocess
+
+def stop_runner():
+    subprocess.run("pkill -f tpu_window_runner", shell=True)
+"""
+
+PKILL_GOOD = """
+import subprocess
+
+def stop_runner():
+    pids = subprocess.run(["pgrep", "-f", "tools/tpu_window_[r]unner"],
+                          capture_output=True, text=True).stdout.split()
+    for pid in pids:
+        subprocess.run(["kill", pid])
+"""
+
+
+def test_no_pkill_positive():
+    found = hits(PKILL_BAD, "no-pkill-self")
+    assert len(found) == 1
+    assert "pgrep" in found[0].message
+
+
+def test_no_pkill_suppressed():
+    src = PKILL_BAD.replace(
+        '    subprocess.run("pkill -f tpu_window_runner", shell=True)',
+        '    subprocess.run("pkill -f tpu_window_runner", shell=True)  '
+        "# graftlint: disable=no-pkill-self -- pattern can never match a "
+        "shell cmdline here")
+    assert not hits(src, "no-pkill-self")
+    assert suppressed_hits(src, "no-pkill-self")
+
+
+def test_no_pkill_clean():
+    assert not hits(PKILL_GOOD, "no-pkill-self")
+
+
+# -- suppression machinery --------------------------------------------------
+
+
+def test_disable_next_line_directive():
+    src = FENCE_BAD.replace(
+        "    jax.block_until_ready(out)",
+        "    # graftlint: disable-next-line=fence-by-value -- rig\n"
+        "    jax.block_until_ready(out)")
+    assert not hits(src, "fence-by-value")
+    assert len(suppressed_hits(src, "fence-by-value")) == 2
+
+
+def test_disable_file_directive():
+    src = ("# graftlint: disable-file=fence-by-value -- whole-file rig\n"
+           + FENCE_BAD)
+    assert not hits(src, "fence-by-value")
+    assert len(suppressed_hits(src, "fence-by-value")) == 2
+
+
+def test_disable_all_and_comma_lists():
+    src = FENCE_BAD.replace(
+        "    jax.block_until_ready(out)",
+        "    jax.block_until_ready(out)  # graftlint: disable=all")
+    assert not hits(src, "fence-by-value")
+    src2 = STALE_BAD.replace(
+        "        loss = step(feeds)",
+        "        loss = step(feeds)  "
+        "# graftlint: disable=stale-args-dispatch,fence-by-value -- x")
+    assert not hits(src2, "stale-args-dispatch")
+
+
+def test_suppression_is_per_line_not_per_file():
+    # a directive on ONE hit must not hide the other
+    src = FENCE_BAD.replace(
+        "    out = step(out)\n    jax.block_until_ready(out)",
+        "    out = step(out)\n    jax.block_until_ready(out)  "
+        "# graftlint: disable=fence-by-value -- only this one")
+    assert len(hits(src, "fence-by-value")) == 1
+
+
+def test_parse_error_is_reported_not_raised():
+    findings = lint_source("def broken(:\n", "bad.py")
+    assert findings and findings[0].rule == "parse-error"
+
+
+# -- CLI --------------------------------------------------------------------
+
+
+def test_cli_json_format_and_exit_code(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(PKILL_BAD)
+    rc = cli_main([str(bad), "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["unsuppressed"] == 1
+    assert out["findings"][0]["rule"] == "no-pkill-self"
+
+
+def test_cli_clean_file_exits_zero(tmp_path, capsys):
+    good = tmp_path / "good.py"
+    good.write_text(FENCE_GOOD)
+    rc = cli_main([str(good)])
+    assert rc == 0
+    assert "0 finding(s)" in capsys.readouterr().out
+
+
+def test_cli_single_rule_filter(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(PKILL_BAD + ENV_BAD)
+    rc = cli_main([str(bad), "--rule", "no-env-platform", "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert {f["rule"] for f in out["findings"]} == {"no-env-platform"}
+
+
+def test_cli_list_rules(capsys):
+    assert cli_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in EXPECTED_RULES:
+        assert rule_id in out
+
+
+def test_cli_unknown_rule_is_usage_error(capsys):
+    assert cli_main(["--rule", "no-such-rule"]) == 2
+
+
+# -- CI wiring: the repo lints itself ---------------------------------------
+
+
+def test_default_scope_covers_contract_surface():
+    paths = default_paths()
+    tails = {p.rsplit("/", 1)[-1] for p in paths}
+    assert {"sparknet_tpu", "tools", "bench.py"} <= tails
+
+
+def test_repo_self_lint_is_clean():
+    """THE ratchet: zero unsuppressed findings over sparknet_tpu/,
+    tools/, and bench.py.  A new violation fails tier-1; an intentional
+    exception must carry a justified ``# graftlint: disable=...``."""
+    findings = lint_paths(default_paths())
+    bad = [f for f in findings if not f.suppressed]
+    assert not bad, "unsuppressed graftlint findings:\n" + "\n".join(
+        f"{f.path}:{f.line}: [{f.rule}] {f.message}" for f in bad)

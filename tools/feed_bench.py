@@ -9,6 +9,13 @@ same batch shape, numpy and multithreaded C++ backends, plus the
 prefetcher's overlap — and prints one JSON line per variant:
 
     python tools/feed_bench.py [--batch 256] [--iters 20]
+
+Timing-contract note (graftlint audit): every timed loop here is
+HOST-side — numpy/PIL transforms and the prefetcher's queue — so
+repeating identical args really does the work each call and no value
+fence is needed; nothing in this module dispatches to a device inside
+a timing window (the stale-args-dispatch rule is scoped to
+jax-importing modules for exactly this distinction).
 """
 
 from __future__ import annotations

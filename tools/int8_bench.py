@@ -146,15 +146,17 @@ def main() -> int:
         out_path = os.path.join(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
             out_path)
-    try:
-        with open(out_path + ".tmp", "w") as f:
-            json.dump({"arms": results,
-                       "utc": time.strftime("%Y-%m-%d %H:%M:%SZ",
-                                            time.gmtime())}, f, indent=1)
-        os.replace(out_path + ".tmp", out_path)
-    except OSError as e:
-        print(f"int8_bench: could not write {out_path}: {e}",
-              file=sys.stderr)
+    # common.bank_guard is the one blessed evidence sink (bank-guard
+    # lint rule): atomic write, and — although the CPU branch above
+    # already returned — an unmeasured payload would divert to /tmp
+    # rather than overwrite banked chip evidence
+    from sparknet_tpu.common import bank_guard
+
+    if bank_guard(out_path,
+                  {"arms": results,
+                   "utc": time.strftime("%Y-%m-%d %H:%M:%SZ",
+                                        time.gmtime())},
+                  measured=on_accel) is None:
         return 1
     return 0
 

@@ -172,13 +172,14 @@ def main() -> int:
     if not os.path.isabs(out_path):
         out_path = os.path.join(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))), out_path)
-    try:
-        with open(out_path + ".tmp", "w") as f:
-            json.dump({"arms": results, "utc": time.strftime(
-                "%Y-%m-%d %H:%M:%SZ", time.gmtime())}, f, indent=1)
-        os.replace(out_path + ".tmp", out_path)
-    except OSError as e:
-        print(f"layout_ab: could not write {out_path}: {e}", file=sys.stderr)
+    # common.bank_guard: the one blessed evidence sink (bank-guard lint
+    # rule) — atomic write; unmeasured payloads divert to /tmp
+    from sparknet_tpu.common import bank_guard
+
+    if bank_guard(out_path,
+                  {"arms": results, "utc": time.strftime(
+                      "%Y-%m-%d %H:%M:%SZ", time.gmtime())},
+                  measured=on_accel) is None:
         return 1
     return 0
 

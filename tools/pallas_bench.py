@@ -48,40 +48,53 @@ if os.environ.get("SPARKNET_PALLAS_ATTN_SHAPE"):
     assert len(ATTN_SHAPE) == 4, ATTN_SHAPE
 
 
-def _fence(args):
-    """Force execution of everything `args` depends on by pulling a scalar
-    to the host.  On remote-relay backends (axon) ``block_until_ready``
-    can return before the chain has actually executed — the same lesson
-    bench.py's measured_run encodes; a value fetch is the reliable fence
-    (round-3 on-chip runs showed per-call block_until_ready timing
-    understating LRN forward by >20x vs its bandwidth roofline).
+def _probed(fn):
+    """Wrap a jitted ``fn`` so every dispatch ALSO returns a tiny f32
+    probe scalar summing one element of each output leaf, computed
+    INSIDE the producing program.
 
-    Caveat vs common.value_fence: ``leaf.sum()`` is a DERIVED device
-    computation (the round-4 trace-tool trap) — but _time_fn's args are
-    large tensors (a direct value fetch would time a multi-MB tunnel
-    copy), and every iteration is CHAINED through the previous output,
-    so a premature-ready fetch can at most shave the LAST of the 20
-    chained calls: the error ceiling is ~5%, amortized, not the 100x
-    the un-chained trace tool banked."""
+    This is how a big-output kernel satisfies ``common.value_fence``'s
+    caller contract: the probe is an output buffer of the producing
+    program itself — fetching its VALUE is the direct-copy fence —
+    without pulling the multi-MB outputs through the tunnel and without
+    the derived-computation trap (a separate post-hoc ``leaf.sum()``
+    dispatch is exactly what the round-4 trace tool banked 7,860% MFU
+    off; this tool's previous ``_fence`` carried that shape with a
+    documented ~5% error ceiling — now zero by construction).  The
+    chained iterations make the LAST probe transitively depend on every
+    timed call; per-element cost is one gather per leaf, noise against
+    the kernels under test and identical across impls."""
     import jax
+    import jax.numpy as jnp
 
-    leaf = jax.tree_util.tree_leaves(args)[0]
-    float(leaf.sum())
+    def wrapped(*a):
+        out = fn(*a)
+        leaves = jax.tree_util.tree_leaves(out)
+        probe = sum(x.ravel()[0].astype(jnp.float32) for x in leaves)
+        return out, probe
+
+    return jax.jit(wrapped)
 
 
 def _time_fn(fn, args, chain, iters=20, warmup=3):
     """ms/iter over `iters` invocations chained through `chain(args, out)
     -> next_args` so each call consumes the previous call's output: the
-    device can't overlap or elide iterations, and one fence at the end
-    times real execution with dispatch overhead amortized."""
+    device can't overlap or elide iterations, no two dispatches carry
+    identical args, and one value_fence on the final probe times real
+    execution with dispatch overhead amortized."""
+    from sparknet_tpu.common import value_fence
+
+    pfn = _probed(fn)
     a = args
     for _ in range(warmup):
-        a = chain(a, fn(*a))
-    _fence(a)
+        out, probe = pfn(*a)
+        a = chain(a, out)
+    value_fence(probe)
     t0 = time.perf_counter()
     for _ in range(iters):
-        a = chain(a, fn(*a))
-    _fence(a)
+        out, probe = pfn(*a)
+        a = chain(a, out)
+    value_fence(probe)
     return (time.perf_counter() - t0) * 1e3 / iters
 
 
@@ -261,13 +274,15 @@ def main() -> int:
         print(json.dumps(r))
     for v in verdicts:
         print(json.dumps(v))
-    try:
-        path = os.path.join(REPO, "docs", "pallas_bench_last.json")
-        with open(path + ".tmp", "w") as f:
-            json.dump({"records": records, "verdicts": verdicts}, f, indent=1)
-        os.replace(path + ".tmp", path)
-    except OSError:
-        pass
+    # the blessed evidence sink: CPU/interpret plumbing runs divert to
+    # /tmp with a rehearsal stamp instead of overwriting the banked
+    # on-chip shootout (they used to — the bank-guard lint's first catch
+    # in this file)
+    from sparknet_tpu.common import bank_guard
+
+    bank_guard(os.path.join(REPO, "docs", "pallas_bench_last.json"),
+               {"records": records, "verdicts": verdicts},
+               measured=on_accel)
     return 0
 
 

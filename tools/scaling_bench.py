@@ -103,7 +103,11 @@ def main() -> int:
         if not probe["ok"]:
             print(json.dumps({"metric": "sync_dp_scaling_efficiency",
                               "measured": False, "reason": probe["reason"]}))
-            return 0
+            # runner window-death contract (bench._require_measured reads
+            # SPARKNET_BENCH_REQUIRE_MEASURED, same env test as
+            # tpu_window_runner.window_death): an unmeasured record must
+            # stay in the retry ledger, not read as success
+            return 4 if bench._require_measured() else 0
 
     import jax
 
@@ -113,7 +117,7 @@ def main() -> int:
                           "measured": False,
                           "reason": "CPU backend; pass --allow-cpu for a "
                           "plumbing-only run"}))
-        return 0
+        return 4 if bench._require_measured() else 0
 
     n = args.devices or len(jax.devices())
     n = min(n, len(jax.devices()))
@@ -148,6 +152,10 @@ def main() -> int:
     if not on_accel:
         rec["plumbing_only_cpu"] = True
     print(json.dumps(rec))
+    if not on_accel and bench._require_measured():
+        # an armed queue job that silently fell back to CPU mid-window
+        # must not be marked done (rc 4 = window death to the runner)
+        return 4
     return 0
 
 
