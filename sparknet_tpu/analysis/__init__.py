@@ -6,22 +6,33 @@ platform pinning, evidence banking (SparkNet's equivalent contracts were
 enforced by Spark around the native solver; ref: PAPER.md, Moritz et
 al., arXiv:1511.06051 — here the system must check them itself).
 
+Two engines share this package and one findings schema:
+
+* graftlint (``core``/``rules``) — AST lint of the SOURCE contracts;
+* graphcheck (``graphcheck``/``comm_model``) — static analysis of the
+  LOWERED graphs: each parallel mode's train step is lowered on the
+  virtual 8-device CPU mesh and audited for comm budget, sharding,
+  dtype, and donation against banked manifests (docs/graph_contracts/).
+
 Usage:
 
     python -m sparknet_tpu.analysis                # default repo scope
     python -m sparknet_tpu.analysis tools bench.py --format json
     python -m sparknet_tpu.analysis --list-rules
+    python -m sparknet_tpu.analysis graph [--mode dp] [--json] [--update]
 
 Library API: ``lint_paths`` / ``lint_source`` return ``Finding``
 records; CI asserts ``not [f for f in findings if not f.suppressed]``
 (tests/test_graftlint.py::test_repo_self_lint_is_clean).
 
-IMPORTANT: the analysis modules themselves are stdlib-only, and nothing
-on this package's import path may INITIALIZE a jax backend (no
-``jax.devices()``, no compiles): the linter has to run on boxes where
-the first backend touch dials a wedged TPU relay and hangs ~25 min.
-Importing jax via the parent package is safe — backend init is lazy —
-but keep it that way.
+IMPORTANT: the analysis modules themselves are stdlib-only at import
+time, and nothing on this package's import path may INITIALIZE a jax
+backend (no ``jax.devices()``, no compiles): the linter has to run on
+boxes where the first backend touch dials a wedged TPU relay and hangs
+~25 min.  graphcheck honors the same contract by importing jax lazily
+inside ``run_graphcheck`` — after pinning the CPU platform through the
+config route — and by keeping its jax-heavy mode factories in
+``sparknet_tpu/parallel/modes.py``, outside this package.
 """
 
 from sparknet_tpu.analysis.core import (  # noqa: F401

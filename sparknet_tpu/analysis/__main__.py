@@ -1,9 +1,20 @@
-"""graftlint CLI: ``python -m sparknet_tpu.analysis [paths] [options]``.
+"""Analysis CLI: ``python -m sparknet_tpu.analysis [lint|graph] ...``.
 
-Exit codes: 0 clean (or suppressed-only), 1 unsuppressed findings,
-2 usage error.  With no paths, lints the repo's contract surface —
-``sparknet_tpu/``, ``tools/``, ``bench.py`` — the same set the tier-1
-self-lint test pins (tests/test_graftlint.py).
+Two engines share one front door and one findings schema:
+
+* ``lint``  — graftlint, the AST source-contract linter (the default:
+  a bare invocation or one starting with paths/flags lints, so every
+  pre-existing call site keeps working).
+* ``graph`` — graphcheck, the jaxpr/StableHLO/HLO graph-contract
+  analysis (lowers each parallel mode on the virtual CPU mesh and
+  audits comm budget, sharding, dtype, donation against the banked
+  manifests in docs/graph_contracts/).
+
+Exit codes (both subcommands): 0 clean (or suppressed-only), 1
+unsuppressed findings, 2 usage error.  ``--json`` (or the legacy
+``--format json``) emits the shared schema: ``{"findings": [{rule,
+path, line, message, suppressed}...], "unsuppressed": N,
+"suppressed": N}``.
 """
 
 from __future__ import annotations
@@ -39,9 +50,9 @@ def default_paths() -> list[str]:
     return out
 
 
-def main(argv: list[str] | None = None) -> int:
+def lint_main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
-        prog="python -m sparknet_tpu.analysis",
+        prog="python -m sparknet_tpu.analysis lint",
         description="graftlint: machine-check the repo's TPU timing, "
         "platform, and evidence-banking contracts",
     )
@@ -49,6 +60,8 @@ def main(argv: list[str] | None = None) -> int:
                     help="files or directories (default: repo scope "
                     f"{'/'.join(DEFAULT_SCOPE)})")
     ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--json", action="store_true",
+                    help="shorthand for --format json")
     ap.add_argument("--rule", action="append", default=[],
                     help="run only this rule id (repeatable)")
     ap.add_argument("--show-suppressed", action="store_true",
@@ -75,11 +88,84 @@ def main(argv: list[str] | None = None) -> int:
         return 2
 
     findings = lint_paths(paths, only=set(args.rule) or None)
-    if args.format == "json":
+    if args.json or args.format == "json":
         print(render_json(findings))
     else:
         print(render_text(findings, show_suppressed=args.show_suppressed))
     return 1 if any(not f.suppressed for f in findings) else 0
+
+
+def graph_main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m sparknet_tpu.analysis graph",
+        description="graphcheck: lower each parallel mode's train step "
+        "on the virtual CPU mesh and machine-check comm-budget, "
+        "sharding, dtype, and donation contracts against the banked "
+        "manifests (docs/graph_contracts/) — zero chip time",
+    )
+    ap.add_argument("--mode", action="append", default=[],
+                    help="check only this mode (repeatable; default all)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--json", action="store_true",
+                    help="shorthand for --format json")
+    ap.add_argument("--update", action="store_true",
+                    help="rewrite the banked manifests (and the "
+                    "SOURCES.json freshness fingerprint on a full run) "
+                    "instead of diffing against them")
+    ap.add_argument("--show-suppressed", action="store_true")
+    ap.add_argument("--list-modes", action="store_true",
+                    help="print the mode registry and exit")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the graph-rule catalog and exit")
+    ap.add_argument("--devices", type=int, default=8,
+                    help="virtual CPU mesh width (default 8, the test "
+                    "harness mesh)")
+    args = ap.parse_args(argv)
+
+    from sparknet_tpu.analysis import graphcheck
+
+    if args.list_rules:
+        for rule_id, summary in graphcheck.iter_rules():
+            print(f"{rule_id}: {summary}")
+        return 0
+    if args.list_modes:
+        # mode names live in parallel/modes.py, which imports jax —
+        # safe here: listing never initializes a backend
+        from sparknet_tpu.parallel.modes import list_modes
+
+        for name in list_modes():
+            print(name)
+        return 0
+
+    as_json = args.json or args.format == "json"
+    progress = None if as_json else (
+        lambda m: print(f"graphcheck: lowering {m} ...", file=sys.stderr))
+    try:
+        findings, _ = graphcheck.run_graphcheck(
+            args.mode or None, update=args.update, n_devices=args.devices,
+            progress=progress)
+    except KeyError as e:
+        print(e.args[0], file=sys.stderr)
+        return 2
+    if as_json:
+        print(render_json(findings))
+    else:
+        print(render_text(findings, show_suppressed=args.show_suppressed,
+                          label="graphcheck"))
+        if args.update:
+            print(f"graphcheck: manifests updated in "
+                  f"{os.path.relpath(graphcheck.MANIFEST_DIR)}")
+    return 1 if any(not f.suppressed for f in findings) else 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "graph":
+        return graph_main(argv[1:])
+    if argv and argv[0] == "lint":
+        return lint_main(argv[1:])
+    # legacy invocation: bare paths/flags mean lint
+    return lint_main(argv)
 
 
 if __name__ == "__main__":

@@ -332,7 +332,8 @@ def lint_paths(paths: Iterable[str],
 # -- reporting -------------------------------------------------------------
 
 
-def render_text(findings: list[Finding], show_suppressed: bool = False) -> str:
+def render_text(findings: list[Finding], show_suppressed: bool = False,
+                label: str = "graftlint") -> str:
     lines = []
     active = [f for f in findings if not f.suppressed]
     for f in active:
@@ -344,7 +345,7 @@ def render_text(findings: list[Finding], show_suppressed: bool = False) -> str:
                     f"{f.path}:{f.line}: [{f.rule}] (suppressed) {f.message}")
     n_sup = sum(1 for f in findings if f.suppressed)
     lines.append(
-        f"graftlint: {len(active)} finding(s), {n_sup} suppressed")
+        f"{label}: {len(active)} finding(s), {n_sup} suppressed")
     return "\n".join(lines)
 
 

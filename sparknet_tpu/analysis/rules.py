@@ -17,6 +17,9 @@ entry to ``docs/LINTING.md``.
 from __future__ import annotations
 
 import ast
+import hashlib
+import json
+import os
 import re
 from typing import Iterator
 
@@ -387,6 +390,78 @@ def _loop_body_calls(loop: ast.For | ast.While) -> Iterator[ast.Call]:
 # ---------------------------------------------------------------------------
 
 _PKILL = re.compile(r"\bpkill\b[^'\"]*-f")
+
+# ---------------------------------------------------------------------------
+# graph-manifest-fresh
+# ---------------------------------------------------------------------------
+
+# the graph-contract source surface: editing any of these changes what
+# graphcheck lowers, so the banked manifests must be regenerated in the
+# same PR (kept in sync with graphcheck.GRAPH_SOURCE_PATTERNS — spelled
+# out here too so this module stays importable without graphcheck)
+_GRAPH_SOURCE_DIR = "sparknet_tpu/parallel/"
+_GRAPH_SOURCE_FILES = (
+    "sparknet_tpu/models/zoo.py",
+    "sparknet_tpu/analysis/graphcheck.py",
+    "sparknet_tpu/analysis/comm_model.py",
+)
+_REGEN = ("regenerate with `python -m sparknet_tpu.analysis graph "
+          "--update`")
+
+
+def _graph_source_rel(path: str) -> tuple[str, str] | None:
+    """(repo_root, repo_relative_path) when ``path`` is part of the
+    graph-contract source surface, else None."""
+    norm = os.path.abspath(path).replace(os.sep, "/")
+    idx = norm.rfind("/sparknet_tpu/")
+    if idx < 0:
+        return None
+    root, rel = norm[:idx], norm[idx + 1:]
+    if rel.startswith(_GRAPH_SOURCE_DIR) or rel in _GRAPH_SOURCE_FILES:
+        return root, rel
+    return None
+
+
+@rule(
+    "graph-manifest-fresh",
+    "a PR touching parallel/ or models/zoo.py (or graphcheck itself) "
+    "must regenerate the docs/graph_contracts/ manifests",
+)
+def check_graph_manifest_fresh(ctx: ModuleContext) -> Iterator[tuple[int, str]]:
+    """The golden graph manifests are only worth diffing against if
+    they describe the code as it is NOW: an edit to the parallel
+    machinery or the zoo sweep that skips regeneration leaves future
+    PRs diffing against a stale baseline.  ``graphcheck --update``
+    banks a sha256 per source file in
+    ``docs/graph_contracts/SOURCES.json``; this rule re-hashes the
+    linted source and flags any mismatch.  Blind spot: an edit that
+    reverts to the banked bytes passes (correctly — the lowered graphs
+    are the banked ones again).
+    """
+    hit = _graph_source_rel(ctx.path)
+    if hit is None:
+        return
+    root, rel = hit
+    src = os.path.join(root, "docs", "graph_contracts", "SOURCES.json")
+    if not os.path.exists(src):
+        yield (1, f"{rel} is graph-contract source but no manifests are "
+                  f"banked (docs/graph_contracts/SOURCES.json missing) "
+                  f"— {_REGEN}")
+        return
+    try:
+        with open(src, encoding="utf-8") as f:
+            recorded = json.load(f)
+    except (OSError, ValueError):
+        yield (1, f"docs/graph_contracts/SOURCES.json unreadable — {_REGEN}")
+        return
+    want = recorded.get(rel)
+    digest = hashlib.sha256(ctx.source.encode("utf-8")).hexdigest()
+    if want is None:
+        yield (1, f"{rel} is new graph-contract source not covered by "
+                  f"the banked manifests — {_REGEN}")
+    elif want != digest:
+        yield (1, f"{rel} changed since the graph manifests were banked "
+                  f"— {_REGEN}")
 
 
 @rule(

@@ -17,6 +17,9 @@ host input pipeline; batch is a builder argument, not baked into the file.
 
 from __future__ import annotations
 
+import dataclasses
+from typing import Any
+
 from sparknet_tpu.layers_dsl import (
     AccuracyLayer,
     BatchNormLayer,
@@ -1011,3 +1014,60 @@ def charlm_solver() -> SolverConfig:
         base_lr=2e-3, lr_policy="fixed", momentum=0.9, weight_decay=0.0,
         max_iter=2000, solver_type="Adam", display=100,
     )
+
+
+# ---------------------------------------------------------------------------
+# Graph-contract sweep configs (sparknet_tpu/analysis/graphcheck.py).
+#
+# Tiny, shape-valid instantiations of the zoo families the static graph
+# analysis lowers on the virtual 8-device CPU mesh — small enough that a
+# CPU compile is seconds, real enough that the lowered collectives are
+# the same op set a pod-scale run would emit (collective structure
+# depends on mesh axes and layer types, not on batch/crop).  The feed
+# field drives synthetic input construction: "image" = float NCHW data +
+# int class labels, "tokens" = int id matrix + int class labels.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class GraphFamily:
+    """One zoo family as the graph-contract sweep traces it."""
+
+    solver: Any  # () -> SolverConfig
+    net: Any  # (batch: int) -> Message
+    feed: str  # "image" | "tokens"
+    num_classes: int
+    image_shape: tuple = ()  # (C, H, W) for image feeds
+    seq_len: int = 0  # for token feeds
+    vocab: int = 0
+
+
+GRAPH_SWEEP_FAMILIES: dict[str, GraphFamily] = {
+    "cifar10_quick": GraphFamily(
+        solver=cifar10_quick_solver,
+        net=lambda b: cifar10_quick(b),
+        feed="image", num_classes=10, image_shape=(3, 32, 32),
+    ),
+    # lenet is the TP vehicle: ip1's 500 outputs clear the
+    # ShardingRules.min_tp_dim=128 floor and divide a 2-way 'model' axis
+    "lenet": GraphFamily(
+        solver=lenet_solver,
+        net=lambda b: lenet(b),
+        feed="image", num_classes=10, image_shape=(1, 28, 28),
+    ),
+    # the dryrun mode-6b transformer shape: trains on a (data x seq) mesh
+    "transformer": GraphFamily(
+        solver=transformer_solver,
+        net=lambda b: transformer(b, seq_len=32, vocab=32, embed_dim=16,
+                                  heads=4, ffn_dim=32, blocks=1),
+        feed="tokens", num_classes=10, seq_len=32, vocab=32,
+    ),
+    # depthwise group conv + synced BN — the sharding interaction the
+    # mobilenet_dp mode exists to pin (VERDICT r5 weak 8)
+    "mobilenet": GraphFamily(
+        solver=lambda: dataclasses.replace(mobilenet_solver(),
+                                           base_lr=1e-3),
+        net=lambda b: mobilenet(batch=b, num_classes=5, crop=64),
+        feed="image", num_classes=5, image_shape=(3, 64, 64),
+    ),
+}

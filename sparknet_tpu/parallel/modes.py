@@ -1,0 +1,340 @@
+"""Parallel-mode registry: mode name -> traceable train-step factory.
+
+The graph-contract analysis (``sparknet_tpu/analysis/graphcheck.py``)
+needs, for every parallel mode the framework ships, a jitted step
+function plus concrete example arguments it can ``.lower()`` on the
+virtual 8-device CPU mesh WITHOUT executing a single step.  This module
+is that seam: each factory builds the same trainer objects
+``dryrun_multichip`` exercises (ref: __graft_entry__.py modes 1-13) but
+stops at the jitted callable, exposing everything the static audits
+need — carry structure for the donation audit, intended param
+shardings for the sharding audit, byte totals for the comm model.
+
+Kept in ``parallel/`` (not ``analysis/``) because it imports jax and
+the trainer stack; the analysis package stays stdlib-importable and
+pulls this in lazily only when the ``graph`` subcommand actually runs.
+
+Modes mirror the communication design space of the paper and its
+TPU-first extensions: ``solo`` (no mesh — the negative control: any
+collective is a bug), ``dp``/``dp_bf16``/``mobilenet_dp`` (tau=1
+GSPMD sync SGD, ref: CifarApp.scala:95-136 degenerate case), ``tau``
+(the SparkNet tau-averaging round), ``easgd`` (elastic coupling),
+``tp`` (Megatron-style output-channel sharding), ``sp`` (Ulysses
+all-to-all sequence parallelism — the ring impl is trace-broken under
+the pinned jax, see test_seq_parallel's seed state), ``gpipe``
+(pipeline ppermute), ``moe`` (expert all_to_all dispatch).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["TraceTarget", "MODES", "build_target", "list_modes"]
+
+
+@dataclasses.dataclass
+class TraceTarget:
+    """Everything graphcheck needs to lower + audit one mode.
+
+    ``fn(*args)`` is a jitted callable; ``alt_args`` is a second
+    argument tuple with identical shapes/dtypes (typically the
+    iteration counter bumped) — lowering both must produce identical
+    StableHLO or the step recompiles every iteration.
+    ``carry_argnums`` are the positions whose buffers thread between
+    rounds (must be donated); the first ``carry_out_leaves`` flattened
+    outputs are that carry coming back (their shardings must match the
+    inputs' or every round pays a reshard).
+    """
+
+    name: str
+    fn: Any
+    args: tuple
+    meta: dict
+    param_bytes: int
+    state_bytes: int
+    carry_argnums: tuple = ()
+    carry_out_leaves: int = 0
+    alt_args: tuple | None = None
+    # context entered around lower()/compile(): trace-time config such
+    # as compute_dtype and the sequence-parallel attention routing
+    trace_context: Callable[[], Any] = contextlib.nullcontext
+    # tp/moe-style modes declare that at least one param MUST be sharded
+    expects_sharded_params: bool = False
+
+
+def _tree_bytes(tree) -> int:
+    return sum(int(np.prod(l.shape)) * l.dtype.itemsize
+               for l in jax.tree_util.tree_leaves(tree)
+               if hasattr(l, "shape"))
+
+
+def _feeds_for(family, batch: int, rs: np.random.RandomState,
+               tau: int = 0) -> dict:
+    """Synthetic feeds matching the family's RDD layer shapes; a
+    leading [tau] axis when the round carries tau local steps."""
+    if family.feed == "tokens":
+        data = rs.randint(0, family.vocab, (batch, family.seq_len))
+        data = data.astype(np.int32)
+    else:
+        data = rs.randn(batch, *family.image_shape).astype(np.float32) * 10
+    label = rs.randint(0, family.num_classes, batch).astype(np.int32)
+    if tau:
+        data = np.stack([data] * tau)
+        label = np.stack([label] * tau)
+    return {"data": data, "label": label}
+
+
+def _trainer_target(name: str, family_name: str, mesh, *, tau: int = 1,
+                    elastic_alpha: float = 0.0, per_device_batch: int = 2,
+                    rules=None, compute_dtype=None,
+                    expects_sharded_params: bool = False) -> TraceTarget:
+    """The shared trainer-mode factory: construct Solver+ParallelTrainer
+    exactly as the dryrun does, stop at the jitted round function."""
+    from sparknet_tpu.common import get_config, set_config
+    from sparknet_tpu.models.zoo import GRAPH_SWEEP_FAMILIES
+    from sparknet_tpu.parallel.trainer import ParallelTrainer
+    from sparknet_tpu.solvers.solver import Solver
+
+    family = GRAPH_SWEEP_FAMILIES[family_name]
+    cfg = get_config()
+    data_size = mesh.shape.get(cfg.data_axis, 1)
+    B_global = per_device_batch * data_size
+
+    @contextlib.contextmanager
+    def dtype_ctx():
+        if compute_dtype is None:
+            yield
+            return
+        prior = get_config().compute_dtype
+        set_config(compute_dtype=compute_dtype)
+        try:
+            yield
+        finally:
+            set_config(compute_dtype=prior)
+
+    with dtype_ctx():
+        # tau/EASGD rounds run per-worker replicas: the solver's own
+        # batch is the per-device slice (dryrun modes 2/7 shape)
+        solver_batch = per_device_batch if (tau > 1 or elastic_alpha) \
+            else B_global
+        solver = Solver(family.solver(), family.net(solver_batch))
+        trainer = ParallelTrainer(solver, mesh=mesh, tau=tau,
+                                  rules=rules, elastic_alpha=elastic_alpha)
+        rs = np.random.RandomState(0)
+        stacked = tau > 1 or elastic_alpha > 0
+        feeds = trainer._put_feeds(
+            _feeds_for(family, B_global, rs, tau=trainer.tau if stacked else 0),
+            with_tau_axis=stacked,
+        )
+
+    if elastic_alpha:
+        args = (trainer.variables, trainer.slots, trainer.center, 0, feeds,
+                solver._key)
+        alt = args[:3] + (1,) + args[4:]
+        carry_argnums: tuple = (0, 1, 2)
+        carry_out = sum(len(jax.tree_util.tree_leaves(t)) for t in args[:3])
+    else:
+        args = (trainer.variables, trainer.slots, 0, feeds, solver._key)
+        alt = args[:2] + (1,) + args[3:]
+        carry_argnums = (0, 1)
+        carry_out = sum(len(jax.tree_util.tree_leaves(t)) for t in args[:2])
+
+    @contextlib.contextmanager
+    def trace_ctx():
+        with dtype_ctx():
+            with trainer._sp_context():
+                yield
+
+    return TraceTarget(
+        name=name,
+        fn=trainer._train,
+        args=args,
+        alt_args=alt,
+        meta={
+            "family": family_name,
+            "mesh": dict(mesh.shape),
+            "tau": trainer.tau,
+            "elastic_alpha": elastic_alpha,
+            "batch": B_global,
+            "dtype": "bf16" if compute_dtype == jnp.bfloat16 else "f32",
+        },
+        # model sizes for the comm model come from the SOLVER's (single-
+        # replica) tree: tau/EASGD trainers stack a worker axis, but the
+        # pmean still moves one model's bytes per chip per round
+        param_bytes=_tree_bytes(solver.variables.params),
+        state_bytes=_tree_bytes(solver.variables.state),
+        carry_argnums=carry_argnums,
+        carry_out_leaves=carry_out,
+        trace_context=trace_ctx,
+        expects_sharded_params=expects_sharded_params,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Mode factories.  Each takes the device list and returns a TraceTarget.
+# ---------------------------------------------------------------------------
+
+
+def _mode_solo(devices) -> TraceTarget:
+    """Single-chip Solver step — the negative control (no mesh, so the
+    lowered program must contain ZERO collectives) and the donation
+    audit's original catch: ``Solver._train_step`` shipped undonated
+    until this audit flagged the 2x params+slots HBM bloat."""
+    from sparknet_tpu.models.zoo import GRAPH_SWEEP_FAMILIES
+    from sparknet_tpu.solvers.solver import Solver
+
+    family = GRAPH_SWEEP_FAMILIES["cifar10_quick"]
+    B = 16
+    solver = Solver(family.solver(), family.net(B))
+    rs = np.random.RandomState(0)
+    feeds = {k: jnp.asarray(v)
+             for k, v in _feeds_for(family, B, rs).items()}
+    args = (solver.variables, solver.slots, 0, feeds, solver._key)
+    carry_out = sum(len(jax.tree_util.tree_leaves(t)) for t in args[:2])
+    return TraceTarget(
+        name="solo", fn=solver._train_step, args=args,
+        alt_args=args[:2] + (1,) + args[3:],
+        meta={"family": "cifar10_quick", "mesh": {}, "tau": 1,
+              "batch": B, "dtype": "f32"},
+        param_bytes=_tree_bytes(solver.variables.params),
+        state_bytes=_tree_bytes(solver.variables.state),
+        carry_argnums=(0, 1), carry_out_leaves=carry_out,
+    )
+
+
+def _data_mesh(devices):
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(devices), ("data",))
+
+
+def _mode_dp(devices) -> TraceTarget:
+    return _trainer_target("dp", "cifar10_quick", _data_mesh(devices))
+
+
+def _mode_dp_bf16(devices) -> TraceTarget:
+    return _trainer_target("dp_bf16", "cifar10_quick", _data_mesh(devices),
+                           compute_dtype=jnp.bfloat16)
+
+
+def _mode_mobilenet_dp(devices) -> TraceTarget:
+    return _trainer_target("mobilenet_dp", "mobilenet", _data_mesh(devices))
+
+
+def _mode_tau(devices) -> TraceTarget:
+    return _trainer_target("tau", "cifar10_quick", _data_mesh(devices),
+                           tau=3)
+
+
+def _mode_easgd(devices) -> TraceTarget:
+    return _trainer_target("easgd", "cifar10_quick", _data_mesh(devices),
+                           tau=2, elastic_alpha=0.9 / len(devices))
+
+
+def _mode_tp(devices) -> TraceTarget:
+    from sparknet_tpu.parallel.mesh import auto_mesh
+
+    mesh = auto_mesh(num_devices=len(devices), model_parallel=2)
+    return _trainer_target("tp", "lenet", mesh,
+                           expects_sharded_params=True)
+
+
+def _mode_sp(devices) -> TraceTarget:
+    from sparknet_tpu.parallel.mesh import auto_mesh
+    from sparknet_tpu.parallel.sharding import ShardingRules
+
+    mesh = auto_mesh(num_devices=len(devices), seq_parallel=4)
+    return _trainer_target(
+        "sp", "transformer", mesh,
+        rules=ShardingRules(attention_impl="ulysses"),
+    )
+
+
+def _mode_gpipe(devices) -> TraceTarget:
+    """GPipe microbatch schedule (dryrun mode 5 shape): forward-only
+    stage pipeline — the ppermute activation hops are the contract."""
+    from jax.sharding import Mesh
+
+    from sparknet_tpu.parallel.pipeline import pipeline_blocks, \
+        stack_stage_params
+
+    mesh = Mesh(np.array(devices), ("stage",))
+    rs = np.random.RandomState(0)
+    D = 16
+    stacked = stack_stage_params([
+        {"w": jnp.asarray(rs.randn(D, D) * 0.3, jnp.float32)}
+        for _ in range(len(devices))
+    ])
+    blk = lambda p, a: jnp.tanh(a @ p["w"])
+    xs = jnp.asarray(rs.randn(2 * len(devices), 4, D), jnp.float32)
+    fn = jax.jit(lambda st, x: pipeline_blocks(mesh, blk, st, x))
+    return TraceTarget(
+        name="gpipe", fn=fn, args=(stacked, xs),
+        meta={"family": "toy_blocks", "mesh": dict(mesh.shape),
+              "tau": 1, "batch": int(xs.shape[0]), "dtype": "f32"},
+        param_bytes=_tree_bytes(stacked), state_bytes=0,
+    )
+
+
+def _mode_moe(devices) -> TraceTarget:
+    """Expert-parallel top-1 MoE token dispatch (dryrun mode 6 shape):
+    the two all_to_alls (scatter out, gather back) are the contract."""
+    from jax.sharding import Mesh
+
+    from sparknet_tpu.parallel.expert import expert_parallel_moe
+
+    mesh = Mesh(np.array(devices), ("expert",))
+    rs = np.random.RandomState(0)
+    E, D, H = len(devices), 16, 32
+    params = tuple(
+        jnp.asarray(rs.randn(*s) * 0.3, jnp.float32)
+        for s in [(E, D), (E, H, D), (E, H), (E, D, H), (E, D)]
+    )
+    toks = jnp.asarray(rs.randn(8 * E, D), jnp.float32)
+    fn = jax.jit(partial(expert_parallel_moe, mesh,
+                         capacity_factor=float(E)))
+    return TraceTarget(
+        name="moe", fn=fn, args=(params, toks),
+        meta={"family": "toy_moe", "mesh": dict(mesh.shape),
+              "tau": 1, "batch": int(toks.shape[0]), "dtype": "f32"},
+        param_bytes=_tree_bytes(params), state_bytes=0,
+    )
+
+
+MODES: dict[str, Callable] = {
+    "solo": _mode_solo,
+    "dp": _mode_dp,
+    "dp_bf16": _mode_dp_bf16,
+    "tau": _mode_tau,
+    "easgd": _mode_easgd,
+    "tp": _mode_tp,
+    "sp": _mode_sp,
+    "gpipe": _mode_gpipe,
+    "moe": _mode_moe,
+    "mobilenet_dp": _mode_mobilenet_dp,
+}
+
+
+def list_modes() -> list[str]:
+    return list(MODES)
+
+
+def build_target(name: str, n_devices: int = 8) -> TraceTarget:
+    """Build one mode's traceable target on the first ``n_devices``
+    visible devices.  Caller (graphcheck) is responsible for having
+    pinned the CPU platform and forced the virtual device count."""
+    devices = jax.devices()
+    if len(devices) < n_devices:
+        raise RuntimeError(
+            f"mode {name!r} needs {n_devices} devices, found "
+            f"{len(devices)}; launch with "
+            "XLA_FLAGS=--xla_force_host_platform_device_count="
+            f"{n_devices} JAX_PLATFORMS=cpu")
+    return MODES[name](devices[:n_devices])

@@ -127,7 +127,25 @@ class ParallelTrainer:
         if self.tau == 1 and not self._elastic:
             self.variables = place(solver.variables, self._pshard)
             self.slots = self._place_slots(solver.slots)
-            self._train = jax.jit(self._step_fn, donate_argnums=(0, 1))
+            # Pin the carry's OUTPUT shardings to its input shardings:
+            # with TP/SP axes live, GSPMD otherwise propagates activation
+            # shardings into updated params (graphcheck caught ip-style
+            # weights returning P(None,'model') after entering P()), so
+            # every round paid an entry reshard and the changed layout
+            # broke the donation aliasing for those leaves.
+            out_shards = (
+                self._pshard,
+                {
+                    lname: [
+                        [self._pshard.params[lname][i]] * len(hl)
+                        for i, hl in enumerate(per_param)
+                    ]
+                    for lname, per_param in solver.slots.items()
+                },
+                NamedSharding(self.mesh, P()),  # scalar loss
+            )
+            self._train = jax.jit(self._step_fn, donate_argnums=(0, 1),
+                                  out_shardings=out_shards)
         else:
             # stack a worker axis: leaf [R, ...] sharded over 'data' — each
             # device owns its own (initially identical) model replica

@@ -29,6 +29,7 @@ EXPECTED_RULES = {
     "require-measured",
     "stale-args-dispatch",
     "no-pkill-self",
+    "graph-manifest-fresh",
 }
 
 
@@ -415,6 +416,67 @@ def test_no_pkill_suppressed():
 
 def test_no_pkill_clean():
     assert not hits(PKILL_GOOD, "no-pkill-self")
+
+
+# -- graph-manifest-fresh ---------------------------------------------------
+
+FRESH_SRC = "import jax\n\ndef round_fn(v):\n    return v\n"
+
+
+def _graph_tree(tmp_path, src=FRESH_SRC, record=True, stale=False):
+    """A fake repo: sparknet_tpu/parallel/x.py (+ optional SOURCES.json
+    recording its hash, optionally stale)."""
+    import hashlib
+    import json as _json
+
+    mod = tmp_path / "sparknet_tpu" / "parallel" / "x.py"
+    mod.parent.mkdir(parents=True)
+    mod.write_text(src)
+    if record:
+        digest = hashlib.sha256(src.encode()).hexdigest()
+        if stale:
+            digest = "0" * 64
+        cdir = tmp_path / "docs" / "graph_contracts"
+        cdir.mkdir(parents=True)
+        (cdir / "SOURCES.json").write_text(
+            _json.dumps({"sparknet_tpu/parallel/x.py": digest}))
+    return str(mod)
+
+
+def test_graph_manifest_fresh_positive_on_stale_hash(tmp_path):
+    path = _graph_tree(tmp_path, stale=True)
+    found = hits(FRESH_SRC, "graph-manifest-fresh", path=path)
+    assert len(found) == 1
+    assert "--update" in found[0].message
+
+
+def test_graph_manifest_fresh_positive_when_never_banked(tmp_path):
+    path = _graph_tree(tmp_path, record=False)
+    found = hits(FRESH_SRC, "graph-manifest-fresh", path=path)
+    assert len(found) == 1
+    assert "SOURCES.json missing" in found[0].message
+
+
+def test_graph_manifest_fresh_suppressed(tmp_path):
+    path = _graph_tree(tmp_path, stale=True)
+    src = ("# graftlint: disable-file=graph-manifest-fresh -- "
+           "manifest regen follows in this PR\n" + FRESH_SRC)
+    assert not hits(src, "graph-manifest-fresh", path=path)
+    assert suppressed_hits(src, "graph-manifest-fresh", path=path)
+
+
+def test_graph_manifest_fresh_clean_when_hash_matches(tmp_path):
+    path = _graph_tree(tmp_path)
+    assert not hits(FRESH_SRC, "graph-manifest-fresh", path=path)
+
+
+def test_graph_manifest_fresh_ignores_non_contract_files(tmp_path):
+    other = tmp_path / "sparknet_tpu" / "ops" / "y.py"
+    other.parent.mkdir(parents=True)
+    other.write_text(FRESH_SRC)
+    assert not hits(FRESH_SRC, "graph-manifest-fresh", path=str(other))
+    # and plain fixture paths (no sparknet_tpu/ segment) never fire
+    assert not hits(FRESH_SRC, "graph-manifest-fresh")
 
 
 # -- suppression machinery --------------------------------------------------

@@ -1,0 +1,379 @@
+"""graphcheck: per-contract fixtures + the banked-manifest smoke gate.
+
+Mirrors test_graftlint.py one layer down: each contract family gets a
+deliberately defective fixture — an unsharded "tensor-parallel" param,
+a smuggled f32 upcast under bf16, an undonated carry, a comm census
+that misses/violates its model — and each must produce EXACTLY its
+finding.  The gate tests then lower the cheap real modes (dp + tau) on
+the virtual 8-device mesh and diff them against the golden manifests
+in docs/graph_contracts/, so any PR that changes the lowered
+communication structure of the SparkNet step fails tier-1 until it
+regenerates the manifests (`python -m sparknet_tpu.analysis graph
+--update`).  The full 10-mode sweep is the slow-marked twin.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sparknet_tpu.analysis.comm_model import CommExpectation, expected_comm
+from sparknet_tpu.analysis.graphcheck import (
+    GRAPH_RULES,
+    audit_target,
+    census_summary,
+    collective_census,
+    dtype_census,
+    run_graphcheck,
+    sources_fingerprint,
+    trace_artifacts,
+)
+from sparknet_tpu.parallel.modes import TraceTarget, list_modes
+
+pytestmark = pytest.mark.smoke
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:8]), ("data",))
+
+
+def _rules_of(problems):
+    return sorted(p["rule"] for p in problems)
+
+
+def _audit(target, exp):
+    return audit_target(target, trace_artifacts(target), exp)
+
+
+_NO_EXPECTATION = CommExpectation(required={}, forbidden=())
+
+
+# -- HLO/StableHLO parsing (pure text, no lowering) -------------------------
+
+_HLO_FIXTURE = """\
+HloModule jit_step, entry_computation_layout={()->()}
+
+%region_0.19_spmd (a: f32[]) -> f32[] {
+  %ar.1 = f32[] all-reduce(f32[] %a), replica_groups=[1,8]<=[8], to_apply=%add
+}
+
+%while_body (b: (s32[], f32[])) -> (s32[], f32[]) {
+  %call.1 = f32[] call(f32[] %x), to_apply=%region_0.19_spmd
+}
+
+ENTRY %main_spmd (p0: f32[4]) -> f32[4] {
+  %w = (s32[], f32[]) while((s32[], f32[]) %init), condition=%cond, body=%while_body
+  %big = f32[64,1024]{1,0} all-reduce(f32[64,1024]{1,0} %g), to_apply=%add
+  %gath = f32[2,8]{1,0} all-gather(f32[2,1]{1,0} %s), dimensions={1}
+  %done = f32[] all-reduce-done(f32[] %start)
+}
+"""
+
+
+def test_collective_census_parses_kinds_bytes_and_loops():
+    ops = collective_census(_HLO_FIXTURE)
+    kinds = sorted((o.kind, o.bytes, o.in_loop) for o in ops)
+    # the -done op must NOT count; the call inside the while body makes
+    # region_0's all-reduce loop-resident transitively
+    assert kinds == [
+        ("all-gather", 64, False),
+        ("all-reduce", 4, True),
+        ("all-reduce", 262144, False),
+    ]
+    summary = census_summary(ops)
+    assert summary["all-reduce"] == {
+        "count": 2, "bytes": 262148,
+        "in_loop_count": 1, "in_loop_bytes": 4,
+    }
+
+
+def test_dtype_census_flags_f32_dots_only():
+    shlo = """\
+    %3 = stablehlo.convolution(%0, %1) {} : (tensor<2x3xbf16>, tensor<3x4xbf16>) -> tensor<2x4xbf16>
+    %4 = stablehlo.dot_general %0, %1, contracting_dims = [1] x [0] : (tensor<2x3xf32>, tensor<3x4xf32>) -> tensor<2x4xf32>
+    %5 = stablehlo.exponential %4 : tensor<2x4xf32>
+    """
+    out = dtype_census(shlo)
+    assert out["dot_conv_total"] == 2
+    assert out["dot_conv_f32"] == 1
+    assert out["f32_ops"][0][0] == "dot_general"
+
+
+# -- fixture targets: each defect produces exactly its finding --------------
+
+
+def test_fixture_unsharded_param_is_caught():
+    """A mode that declares tensor parallelism whose params all lowered
+    replicated -> graph-replicated-param, and nothing else."""
+    mesh = _mesh()
+    rep = NamedSharding(mesh, P())
+    fn = jax.jit(lambda w, x: (w, (w[0] * x).sum()),
+                 in_shardings=(rep, NamedSharding(mesh, P("data"))),
+                 out_shardings=(rep, rep), donate_argnums=(0,))
+    w = jax.device_put(jnp.ones((128, 4)), rep)
+    x = jax.device_put(jnp.ones((16, 4)),
+                       NamedSharding(mesh, P("data")))
+    target = TraceTarget(
+        name="fx_tp", fn=fn, args=(w, x), meta={"dtype": "f32"},
+        param_bytes=int(w.nbytes), state_bytes=0,
+        carry_argnums=(0,), carry_out_leaves=1,
+        expects_sharded_params=True,
+    )
+    problems, contract = _audit(target, _NO_EXPECTATION)
+    assert _rules_of(problems) == ["graph-replicated-param"]
+    assert contract["sharding"]["params_sharded"] == 0
+
+
+def test_fixture_smuggled_f32_upcast_is_caught():
+    """bf16 config with a matmul upcast to f32 -> graph-dtype-upcast."""
+    def smuggle(a, b):
+        return (a.astype(jnp.float32) @ b.astype(jnp.float32)
+                ).astype(jnp.bfloat16)
+
+    a = jnp.ones((8, 8), jnp.bfloat16)
+    target = TraceTarget(
+        name="fx_bf16", fn=jax.jit(smuggle), args=(a, a),
+        meta={"dtype": "bf16"}, param_bytes=0, state_bytes=0,
+    )
+    problems, contract = _audit(target, _NO_EXPECTATION)
+    assert _rules_of(problems) == ["graph-dtype-upcast"]
+    assert contract["dtype"]["dot_conv_f32"] == 1
+    # the clean twin: same matmul kept in bf16 passes
+    clean = TraceTarget(
+        name="fx_bf16_ok", fn=jax.jit(lambda a, b: a @ b), args=(a, a),
+        meta={"dtype": "bf16"}, param_bytes=0, state_bytes=0,
+    )
+    problems, _ = _audit(clean, _NO_EXPECTATION)
+    assert problems == []
+
+
+def test_fixture_undonated_carry_is_caught():
+    """A train-step-shaped carry jitted without donation ->
+    graph-undonated-carry with the byte figure."""
+    fn = jax.jit(lambda w, x: (w - 0.1 * x.sum() * w, (w ** 2).sum()))
+    w = jnp.ones((256,), jnp.float32)
+    target = TraceTarget(
+        name="fx_nodonate", fn=fn, args=(w, jnp.ones((4,))),
+        meta={"dtype": "f32"}, param_bytes=int(w.nbytes), state_bytes=0,
+        carry_argnums=(0,), carry_out_leaves=1,
+    )
+    problems, contract = _audit(target, _NO_EXPECTATION)
+    assert _rules_of(problems) == ["graph-undonated-carry"]
+    assert contract["donation"]["undonated_bytes"] == w.nbytes
+    assert "1,024" in problems[0]["message"]
+
+
+def _scalar_reduce_target(name="fx_comm"):
+    """A sharded-input scalar reduction: exactly one 4-byte all-reduce."""
+    mesh = _mesh()
+    rep = NamedSharding(mesh, P())
+    data = NamedSharding(mesh, P("data"))
+    fn = jax.jit(lambda w, x: (w, (x * w[0]).sum()),
+                 in_shardings=(rep, data), out_shardings=(rep, rep),
+                 donate_argnums=(0,))
+    w = jax.device_put(jnp.ones((4,)), rep)
+    x = jax.device_put(jnp.ones((16,)), data)
+    return TraceTarget(
+        name=name, fn=fn, args=(w, x), meta={"dtype": "f32"},
+        param_bytes=int(w.nbytes), state_bytes=0,
+        carry_argnums=(0,), carry_out_leaves=1,
+    )
+
+
+def test_fixture_comm_count_mismatch_is_caught():
+    """The comm-budget family from both sides: a required collective
+    that is absent, a byte total outside the model window, and a
+    forbidden collective that is present."""
+    mesh = _mesh()
+    rep = NamedSharding(mesh, P())
+    data = NamedSharding(mesh, P("data"))
+    # no cross-shard math at all -> required all-reduce missing
+    silent_fn = jax.jit(lambda w, x: (w, x * 2.0),
+                        in_shardings=(rep, data),
+                        out_shardings=(rep, data), donate_argnums=(0,))
+    w = jax.device_put(jnp.ones((4,)), rep)
+    x = jax.device_put(jnp.ones((16,)), data)
+    silent = TraceTarget(
+        name="fx_silent", fn=silent_fn, args=(w, x),
+        meta={"dtype": "f32"}, param_bytes=16, state_bytes=0,
+        carry_argnums=(0,), carry_out_leaves=1,
+    )
+    exp = CommExpectation(required={"all-reduce": (16, 32)}, forbidden=())
+    problems, _ = _audit(silent, exp)
+    assert _rules_of(problems) == ["graph-comm-missing"]
+
+    # a 4-byte loss sync where the model demands a grad-sized one
+    problems, _ = _audit(_scalar_reduce_target(),
+                         CommExpectation(required={"all-reduce": (1000, 2000)},
+                                         forbidden=()))
+    assert _rules_of(problems) == ["graph-comm-bytes"]
+
+    # the same op where the mode forbids the family outright
+    problems, _ = _audit(_scalar_reduce_target(),
+                         CommExpectation(required={},
+                                         forbidden=("all-reduce",)))
+    assert _rules_of(problems) == ["graph-comm-forbidden"]
+
+
+def test_fixture_collective_inside_local_step_loop_is_caught():
+    """A loop-carried cross-shard reduction inside lax.scan — per-step
+    sync in a tau-averaging mode -> graph-comm-in-loop."""
+    mesh = _mesh()
+    rep = NamedSharding(mesh, P())
+    data = NamedSharding(mesh, P("data"))
+
+    def f(w, x):
+        def body(c, _):
+            return (x * c).sum() * 1e-3, 0.0
+
+        out, _ = jax.lax.scan(body, 1.0, None, length=8)
+        return w, out
+
+    fn = jax.jit(f, in_shardings=(rep, data), out_shardings=(rep, rep),
+                 donate_argnums=(0,))
+    w = jax.device_put(jnp.ones((4,)), rep)
+    x = jax.device_put(jnp.ones((16,)), data)
+    target = TraceTarget(
+        name="fx_loop", fn=fn, args=(w, x), meta={"dtype": "f32"},
+        param_bytes=16, state_bytes=0, carry_argnums=(0,),
+        carry_out_leaves=1,
+    )
+    exp = CommExpectation(required={}, forbidden=(),
+                          loop_collectives_ok=False, loop_bytes_floor=0)
+    problems, _ = _audit(target, exp)
+    assert _rules_of(problems) == ["graph-comm-in-loop"]
+
+
+def test_fixture_recompile_hazard_is_caught():
+    """alt_args whose avals differ (weak-type flapping) re-lower to
+    different StableHLO -> graph-recompile-hazard."""
+    fn = jax.jit(lambda a, s: a * s)
+    a = jnp.ones((8,), jnp.float32)
+    target = TraceTarget(
+        name="fx_recompile", fn=fn,
+        args=(a, jnp.float32(2.0)), alt_args=(a, 2),
+        meta={"dtype": "f32"}, param_bytes=0, state_bytes=0,
+    )
+    problems, contract = _audit(target, _NO_EXPECTATION)
+    assert _rules_of(problems) == ["graph-recompile-hazard"]
+    assert contract["recompile_hazard"] is True
+
+
+# -- manifest machinery -----------------------------------------------------
+
+
+def test_manifest_bank_diff_and_allow(tmp_path):
+    """moe (sub-second to lower) exercises the full manifest loop:
+    missing -> banked -> drift -> allow-suppressed."""
+    banked = str(tmp_path / "contracts")
+    findings, _ = run_graphcheck(["moe"], banked_dir=banked)
+    assert [f.rule for f in findings] == ["graph-manifest-missing"]
+
+    findings, manifests = run_graphcheck(["moe"], banked_dir=banked,
+                                         update=True)
+    assert findings == []
+    mpath = tmp_path / "contracts" / "moe.json"
+    assert mpath.exists()
+
+    findings, _ = run_graphcheck(["moe"], banked_dir=banked)
+    assert findings == []  # steady state: re-run diffs clean
+
+    banked_manifest = json.loads(mpath.read_text())
+    banked_manifest["contract"]["comm"]["all-to-all"]["count"] = 99
+    mpath.write_text(json.dumps(banked_manifest))
+    findings, _ = run_graphcheck(["moe"], banked_dir=banked)
+    assert [f.rule for f in findings] == ["graph-manifest-drift"]
+    assert not findings[0].suppressed
+    assert "all-to-all" in findings[0].message
+
+    banked_manifest["allow"] = {
+        "graph-manifest-drift": "fixture: tampered count"}
+    mpath.write_text(json.dumps(banked_manifest))
+    findings, _ = run_graphcheck(["moe"], banked_dir=banked)
+    assert [f.rule for f in findings] == ["graph-manifest-drift"]
+    assert findings[0].suppressed
+
+
+def test_expected_comm_rejects_unknown_mode():
+    with pytest.raises(KeyError):
+        expected_comm("warp-speed", param_bytes=1)
+
+
+def test_sources_fingerprint_covers_the_contract_surface():
+    fp = sources_fingerprint()
+    assert "sparknet_tpu/models/zoo.py" in fp
+    assert "sparknet_tpu/parallel/trainer.py" in fp
+    assert "sparknet_tpu/analysis/graphcheck.py" in fp
+    assert all(len(h) == 64 for h in fp.values())
+
+
+# -- the gate: real modes vs the golden manifests ---------------------------
+
+
+def test_graphcheck_smoke_gate_dp_and_tau():
+    """THE ratchet, graph edition: the two cheap SparkNet modes (tau=1
+    sync DP and the tau-averaging round) must lower to exactly the
+    banked contract — comm census, sharding, dtype, donation — with
+    zero unsuppressed findings.  Catches both code drift (the lowered
+    graph changed: regenerate manifests or fix the regression) and
+    contract violations (a new undonated carry, a smuggled collective).
+    """
+    findings, manifests = run_graphcheck(["dp", "tau"])
+    bad = [f for f in findings if not f.suppressed]
+    assert not bad, "unsuppressed graphcheck findings:\n" + "\n".join(
+        f"{f.path}: [{f.rule}] {f.message}" for f in bad)
+    # spot-pin the load-bearing physics: DP all-reduces the full grads,
+    # tau's model-sized sync stays OUT of the local-step loop
+    dp = manifests["dp"]["contract"]["comm"]["all-reduce"]
+    assert dp["bytes"] >= manifests["dp"]["model"]["param_bytes"]
+    tau = manifests["tau"]["contract"]["comm"]["all-reduce"]
+    assert tau["in_loop_bytes"] == 0
+
+
+def test_rule_catalog_and_modes():
+    assert set(GRAPH_RULES) >= {
+        "graph-comm-missing", "graph-comm-forbidden", "graph-comm-bytes",
+        "graph-comm-in-loop", "graph-replicated-param",
+        "graph-carry-reshard", "graph-dtype-upcast",
+        "graph-undonated-carry", "graph-recompile-hazard",
+        "graph-manifest-missing", "graph-manifest-drift",
+    }
+    modes = list_modes()
+    assert len(modes) >= 6
+    assert {"solo", "dp", "dp_bf16", "tau", "easgd", "tp", "sp",
+            "mobilenet_dp"} <= set(modes)
+
+
+# -- CLI: shared schema with lint ------------------------------------------
+
+
+def test_cli_graph_json_schema(tmp_path, capsys, monkeypatch):
+    """`graph --json` emits the same findings schema as `lint --json`."""
+    from sparknet_tpu.analysis import graphcheck as gc
+    from sparknet_tpu.analysis.__main__ import main as cli_main
+
+    # point the CLI at a tmp manifest dir so this test never writes docs/
+    monkeypatch.setattr(gc, "MANIFEST_DIR", str(tmp_path))
+    rc = cli_main(["graph", "--mode", "moe", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1  # manifest missing in the tmp dir
+    assert set(out) == {"findings", "unsuppressed", "suppressed"}
+    assert out["findings"][0]["rule"] == "graph-manifest-missing"
+    for key in ("rule", "path", "line", "message", "suppressed"):
+        assert key in out["findings"][0]
+
+    rc = cli_main(["graph", "--mode", "moe", "--update"])
+    assert rc == 0
+    capsys.readouterr()
+    rc = cli_main(["graph", "--mode", "moe", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["unsuppressed"] == 0
+
+
+def test_cli_graph_unknown_mode_is_usage_error(capsys):
+    from sparknet_tpu.analysis.__main__ import main as cli_main
+
+    assert cli_main(["graph", "--mode", "no-such-mode"]) == 2

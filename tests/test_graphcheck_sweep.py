@@ -1,0 +1,24 @@
+"""The full graphcheck mode sweep vs the banked golden manifests.
+
+Slow-marked twin of tests/test_graphcheck.py's dp+tau smoke gate: every
+registered parallel mode — including the compile-heavy mobilenet_dp —
+is lowered on the virtual 8-device mesh and diffed against
+docs/graph_contracts/.  CLI equivalent: `python -m sparknet_tpu.analysis
+graph` (regenerate with `--update`).
+"""
+
+import pytest
+
+from sparknet_tpu.analysis.graphcheck import run_graphcheck
+from sparknet_tpu.parallel.modes import list_modes
+
+pytestmark = pytest.mark.slow
+
+
+def test_graphcheck_full_sweep_is_clean():
+    findings, manifests = run_graphcheck()
+    bad = [f for f in findings if not f.suppressed]
+    assert not bad, "\n".join(
+        f"{f.path}: [{f.rule}] {f.message}" for f in bad)
+    assert set(manifests) == set(list_modes())
+    assert len(manifests) >= 6 and "mobilenet_dp" in manifests

@@ -78,9 +78,12 @@ def test_sync_dp_matches_single_device():
 
     s1 = Solver(cfg, small_net())
     s2 = Solver(cfg, small_net())
-    # identical init
-    s2.variables = jax.tree_util.tree_map(lambda x: x, s1.variables)
-    s2.slots = jax.tree_util.tree_map(lambda x: x, s1.slots)
+    # identical init — fresh buffers, not aliases: Solver.step and the
+    # trainer both donate their carries now
+    copy = lambda t: jax.tree_util.tree_map(
+        lambda x: jnp.array(np.asarray(x)), t)
+    s2.variables = copy(s1.variables)
+    s2.slots = copy(s1.slots)
 
     tr = ParallelTrainer(s2, mesh=data_parallel_mesh(), tau=1)
     for it in range(3):
@@ -212,8 +215,11 @@ def test_tensor_parallel_shards_big_fc():
 
     imgs, labels = synth(BATCH, seed=3)
     ref = Solver(cfg, small_net())
-    ref.variables = jax.tree_util.tree_map(lambda x: x, solver.variables)
-    ref.slots = jax.tree_util.tree_map(lambda x: x, solver.slots)
+    # fresh buffers, not aliases: ref.step donates its carry
+    copy = lambda t: jax.tree_util.tree_map(
+        lambda x: jnp.array(np.asarray(x)), t)
+    ref.variables = copy(solver.variables)
+    ref.slots = copy(solver.slots)
     for it in range(2):
         ref.step(1, lambda i: feeds_of(imgs, labels))
         tr.train_round(lambda i: feeds_of(imgs, labels))
