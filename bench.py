@@ -47,6 +47,12 @@ from sparknet_tpu.common import (  # noqa: E402
     bank_path,
 )
 
+# obs journaling (sparknet_tpu/obs, off unless SPARKNET_OBS is set): the
+# Recorder registers a common.bank_guard observer, so every banked
+# record and this script's own measurements share ONE code path for the
+# measured:true stamp.  Importing obs never initializes a backend.
+from sparknet_tpu.obs import get_recorder  # noqa: E402
+
 V5E_PEAK_FLOPS = TPU_PEAK_FLOPS["v5e"]
 
 
@@ -404,6 +410,12 @@ def measured_run(batch: int, iters: int, warmup: int, model: str, crop: int,
         if record_last:
             record_last_good(rec)  # re-record with the roofline attached
         watchdog_phase[0] = "done"
+    # journal the finished record (roofline evidence included) through
+    # the obs Recorder — its wall was closed by fence() above, a value
+    # fetch of the step's own loss output, so the stamp is honest
+    obs = get_recorder()
+    if obs:
+        obs.bench(rec, wall_s=dt, fence_value=final_loss, fenced=True)
     return rec
 
 
@@ -540,6 +552,9 @@ def main() -> int:
     # fail fast on a malformed A/B options string — before any dial
     _parse_compiler_options(
         os.environ.get("SPARKNET_BENCH_COMPILER_OPTIONS", ""))
+    # build the obs Recorder (a no-op unless SPARKNET_OBS is armed) NOW,
+    # so its bank_guard observer is registered before the first bank
+    get_recorder()
     # forced-CPU detection must cover BOTH routes: the env var and the
     # jax.config route (the CLI's --platform flag and site hooks pin the
     # platform through config, which outranks the env var).  Importing
@@ -568,8 +583,12 @@ def main() -> int:
                 file=sys.stderr,
                 flush=True,
             )
-            print(json.dumps(partial_record(batch, model, crop, dtype_name,
-                                            probe["reason"])))
+            prec = partial_record(batch, model, crop, dtype_name,
+                                  probe["reason"])
+            obs = get_recorder()
+            if obs:
+                obs.bench(prec, fenced=False)  # no measurement, no stamp
+            print(json.dumps(prec))
             # queue runners (tpu_window_runner) need "partial" to read as
             # failure so the job retries in a later window; the driver's
             # plain invocation keeps rc=0 (a partial record IS its answer)

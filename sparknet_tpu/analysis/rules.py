@@ -464,6 +464,73 @@ def check_graph_manifest_fresh(ctx: ModuleContext) -> Iterator[tuple[int, str]]:
                   f"— {_REGEN}")
 
 
+# ---------------------------------------------------------------------------
+# obs-fenced-span
+# ---------------------------------------------------------------------------
+
+
+@rule(
+    "obs-fenced-span",
+    "a Recorder span around device work must close with a fence stamp "
+    "(span.fence/fence_value) or declare host=True — unstamped walls "
+    "are refused by the obs report",
+)
+def check_obs_fenced_span(ctx: ModuleContext) -> Iterator[tuple[int, str]]:
+    """The obs Recorder (``sparknet_tpu/obs``) journals span walls as
+    evidence, and the report renderer refuses any wall without a fence
+    stamp — but a refused wall is a silently lost measurement, so the
+    contract is also enforced at the source: every ``with ...span(...)``
+    in a jax-importing module must either call ``<var>.fence(out)`` /
+    ``<var>.fence_value(v)`` somewhere in its body or declare
+    ``host=True`` (no device work enclosed).  A span with no ``as``
+    binding can never be stamped and is flagged outright.
+
+    Blind spot: a span variable handed to a helper that fences it
+    elsewhere is flagged — fence where you time, or mark the span host.
+    """
+    if not ctx.imports_jax():
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        for item in node.items:
+            call = item.context_expr
+            if not (isinstance(call, ast.Call)
+                    and call_name(call) == "span"):
+                continue
+            host = any(
+                kw.arg == "host" and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True for kw in call.keywords)
+            if host:
+                continue
+            var = item.optional_vars
+            if not isinstance(var, ast.Name):
+                yield (
+                    call.lineno,
+                    "Recorder span without an `as` binding can never be "
+                    "fence-stamped — bind it (`with rec.span(...) as "
+                    "sp:`) and close with sp.fence(out), or declare "
+                    "host=True for a host-only span",
+                )
+                continue
+            fenced = any(
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in ("fence", "fence_value")
+                and isinstance(n.func.value, ast.Name)
+                and n.func.value.id == var.id
+                for n in ast.walk(node))
+            if not fenced:
+                yield (
+                    call.lineno,
+                    f"span {var.id!r} closes without a fence stamp — "
+                    "call sp.fence(out) on the enclosed program's own "
+                    "output (common.value_fence contract), or declare "
+                    "host=True if the span truly encloses no device "
+                    "work; the obs report refuses unstamped walls",
+                )
+
+
 @rule(
     "no-pkill-self",
     "pkill -f matches the calling shell's own command line (exit 144); "

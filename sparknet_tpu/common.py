@@ -177,6 +177,28 @@ def bank_path(path: str, *, measured: bool) -> str:
     return os.path.join(tempfile.gettempdir(), f"{root}_rehearsal{ext}")
 
 
+# Observers notified after every successful bank_guard write — the obs
+# Recorder (sparknet_tpu/obs) registers here so banked evidence and the
+# runtime journal share ONE code path for ``measured`` stamping.
+_BANK_OBSERVERS: list = []
+
+
+def add_bank_observer(fn) -> None:
+    """Register ``fn(path, payload, measured)`` to run after each
+    successful :func:`bank_guard` write (idempotent per callable).
+    Observer exceptions are contained: banking outranks journaling."""
+    if fn not in _BANK_OBSERVERS:
+        _BANK_OBSERVERS.append(fn)
+
+
+def remove_bank_observer(fn) -> None:
+    """Deregister a bank observer (no-op if absent)."""
+    try:
+        _BANK_OBSERVERS.remove(fn)
+    except ValueError:
+        pass
+
+
 def bank_guard(path: str, payload, *, measured: bool) -> str | None:
     """The one blessed sink for evidence-file writes (JSON, atomic).
 
@@ -208,4 +230,9 @@ def bank_guard(path: str, payload, *, measured: bool) -> str | None:
     except OSError as e:
         print(f"bank_guard: could not write {path}: {e}", file=sys.stderr)
         return None
+    for observer in list(_BANK_OBSERVERS):
+        try:
+            observer(path, payload, measured)
+        except Exception as e:
+            print(f"bank_guard: observer failed: {e!r}", file=sys.stderr)
     return path

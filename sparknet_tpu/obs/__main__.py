@@ -1,0 +1,160 @@
+"""obs CLI: ``python -m sparknet_tpu.obs {report|validate|dryrun} ...``.
+
+* ``report <journal> [--out f.md]`` — render a journal to markdown
+  (refuses unstamped walls; never prints a throughput above its stated
+  roofline bound).
+* ``validate [journals...]`` — schema-check journal files; with no
+  arguments, every ``docs/evidence_r*/journal.jsonl`` in the repo.
+  Legacy deviations pass only via the explicit allowlist in
+  ``obs/schema.py``.  Exit 1 on any non-allowlisted violation.
+* ``dryrun [--out p] [--rounds N]`` — the zero-chip-time proof: run dp
+  (tau=1 sync SGD) and tau (SparkNet averaging) rounds on the virtual
+  8-device CPU mesh with the Recorder armed, producing a journal whose
+  per-round records carry fenced walls, img/s, loss EMA, and the
+  comm_model-predicted collective budget.  Render it with ``report``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def report_main(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m sparknet_tpu.obs report",
+        description="render an obs journal to markdown")
+    ap.add_argument("journal")
+    ap.add_argument("--out", help="write here instead of stdout")
+    args = ap.parse_args(argv)
+    if not os.path.exists(args.journal):
+        print(f"no such journal: {args.journal}", file=sys.stderr)
+        return 2
+    from sparknet_tpu.obs.report import render_path
+
+    text = render_path(args.journal)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(text)
+        print(f"wrote {args.out}")
+    else:
+        print(text)
+    return 0
+
+
+def validate_main(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m sparknet_tpu.obs validate",
+        description="schema-check journal files (default: every "
+        "docs/evidence_r*/journal.jsonl)")
+    ap.add_argument("journals", nargs="*")
+    args = ap.parse_args(argv)
+    from sparknet_tpu.obs import schema
+
+    paths = args.journals or sorted(glob.glob(
+        os.path.join(_REPO, "docs", "evidence_r*", "journal.jsonl")))
+    if not paths:
+        print("no journals found", file=sys.stderr)
+        return 2
+    rc = 0
+    for path in paths:
+        try:
+            n, allowed, errors = schema.validate_journal(path)
+        except OSError as e:
+            print(f"{path}: unreadable ({e})", file=sys.stderr)
+            rc = 1
+            continue
+        status = "OK" if not errors else "FAIL"
+        extra = f", {allowed} legacy line(s) allowlisted" if allowed else ""
+        print(f"{status} {path}: {n} line(s){extra}")
+        for err in errors:
+            print(f"  {err}")
+        if errors:
+            rc = 1
+    return rc
+
+
+def dryrun_main(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m sparknet_tpu.obs dryrun",
+        description="dp+tau rounds on the virtual CPU mesh with the "
+        "Recorder armed — zero chip time")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.sep + "tmp", "obs_dryrun.jsonl"))
+    ap.add_argument("--rounds", type=int, default=2)
+    ap.add_argument("--tau", type=int, default=3)
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--family", default="cifar10_quick")
+    args = ap.parse_args(argv)
+
+    # pin the CPU platform via the config route (the env var alone does
+    # not win against the site hook) and force the virtual device count
+    # — graphcheck's helper does both, before any backend initializes
+    from sparknet_tpu.analysis.graphcheck import _pin_cpu_mesh
+
+    _pin_cpu_mesh(args.devices)
+
+    # a fresh journal per dryrun: appending over a previous run would
+    # interleave run ids in the rendered report
+    if os.path.exists(args.out):
+        os.remove(args.out)
+    from sparknet_tpu.obs.recorder import Recorder, set_recorder
+
+    rec = set_recorder(Recorder(args.out))
+
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from sparknet_tpu.models.zoo import GRAPH_SWEEP_FAMILIES
+    from sparknet_tpu.parallel.modes import _feeds_for
+    from sparknet_tpu.parallel.trainer import ParallelTrainer
+    from sparknet_tpu.solvers.solver import Solver
+
+    family = GRAPH_SWEEP_FAMILIES[args.family]
+    devices = jax.devices()[:args.devices]
+    mesh = Mesh(np.array(devices), ("data",))
+    per_device = 2
+    batch = per_device * len(devices)
+    rs = np.random.RandomState(0)
+
+    print(f"obs dryrun: dp mode, {args.rounds} round(s) ...",
+          file=sys.stderr)
+    trainer = ParallelTrainer(
+        Solver(family.solver(), family.net(batch)), mesh=mesh, tau=1)
+    for _ in range(args.rounds):
+        trainer.train_round(lambda it: _feeds_for(family, batch, rs))
+
+    print(f"obs dryrun: tau={args.tau} mode, {args.rounds} round(s) ...",
+          file=sys.stderr)
+    trainer = ParallelTrainer(
+        Solver(family.solver(), family.net(per_device)), mesh=mesh,
+        tau=args.tau)
+    for _ in range(args.rounds):
+        trainer.train_round(
+            lambda it: _feeds_for(family, batch, rs, tau=args.tau))
+
+    rec.close()
+    set_recorder(None)
+    print(f"obs dryrun: journal at {args.out} — render with "
+          f"`python -m sparknet_tpu.obs report {args.out}`")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    commands = {"report": report_main, "validate": validate_main,
+                "dryrun": dryrun_main}
+    if not argv or argv[0] not in commands:
+        print(__doc__)
+        return 2
+    return commands[argv[0]](argv[1:])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,296 @@
+"""The obs Recorder: off-by-default JSONL runtime telemetry.
+
+Arm it with ``SPARKNET_OBS=<path>.jsonl`` (the literal ``1`` means
+``./obs_journal.jsonl``); anything else — unset, empty, ``0`` — keeps it
+OFF, and the off state is a hard contract: instrumented call sites
+(``Solver.step``, ``ParallelTrainer.train_round``, bench.py) guard every
+obs touch behind ``if rec:``, so the disabled hot path is bit-identical
+— same lowered StableHLO, same dispatch count — which
+``tests/test_obs.py`` pins.
+
+Walls are only evidence when they are FENCE-STAMPED.  A span that
+encloses device work must close through :meth:`Span.fence`, which fetches
+the VALUE of the producing program's own output via
+``common.value_fence`` — the round-5 anti-trap contract (readiness is
+not execution on relay backends; a derived computation is not a fence).
+A span that never touches the device declares ``host=True`` instead.
+Spans that do neither are journaled with ``fenced: false`` and the
+report renderer refuses their walls.  The ``obs-fenced-span`` graftlint
+rule machine-checks call sites for the same contract.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+from sparknet_tpu.obs import schema
+from sparknet_tpu.obs.sentinel import get_sentinel
+
+__all__ = ["Recorder", "Span", "get_recorder", "set_recorder"]
+
+_ENV = "SPARKNET_OBS"
+
+# loss EMA decay for per-round records: ~"average of the last 10 rounds",
+# the observability analog of SolverParameter.average_loss
+_EMA_DECAY = 0.9
+
+
+class Span:
+    """One fenced wall.  Use as a context manager off
+    :meth:`Recorder.span`; close device-work spans with :meth:`fence`
+    (or :meth:`fence_value` when the caller already materialized the
+    producing program's own output)."""
+
+    __slots__ = ("_rec", "name", "host", "note", "_t0", "_fenced",
+                 "_fence_value")
+
+    def __init__(self, rec: "Recorder | None", name: str,
+                 host: bool = False, note: str | None = None):
+        self._rec = rec if (rec is not None and rec.enabled) else None
+        self.name = name
+        self.host = bool(host)
+        self.note = note
+        self._t0 = 0.0
+        self._fenced = False
+        self._fence_value: float | None = None
+
+    def __enter__(self) -> "Span":
+        self._t0 = time.perf_counter()
+        return self
+
+    def fence(self, out) -> float | None:
+        """Fence-stamp this span on the VALUE of ``out`` (the enclosed
+        program's own output pytree; last leaf must be a small scalar —
+        see ``common.value_fence``).  No-op when obs is disabled, so a
+        guarded call site stays dispatch-identical."""
+        if self._rec is None:
+            return None
+        from sparknet_tpu.common import value_fence
+
+        self._fence_value = value_fence(out)
+        self._fenced = True
+        return self._fence_value
+
+    def fence_value(self, value: float) -> float:
+        """Fence-stamp with an ALREADY-MATERIALIZED value.  Caller
+        contract: ``value`` was fetched from the producing program's own
+        output (e.g. ``float(loss_arr)`` on the step's loss) — passing a
+        host-computed number here forges the stamp."""
+        self._fence_value = float(value)
+        self._fenced = True
+        return self._fence_value
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if self._rec is None:
+            return
+        wall = time.perf_counter() - self._t0
+        fields: dict = {
+            "name": self.name,
+            "wall_s": round(wall, 6),
+            "fenced": self._fenced and not self.host,
+        }
+        if self.host:
+            fields["host"] = True
+        if self._fence_value is not None:
+            fields["fence_value"] = self._fence_value
+        if self.note:
+            fields["note"] = self.note
+        self._rec._emit_span(fields)
+
+
+class Recorder:
+    """Append-only JSONL journal of schema-validated obs events."""
+
+    def __init__(self, path: str | None, run_id: str | None = None):
+        self.path = path
+        self.enabled = bool(path)
+        self._lock = threading.Lock()
+        self._started = False
+        self._n_rounds = 0
+        self._n_spans = 0
+        self._ema: dict[str, float] = {}
+        self._warm_modes: set[str] = set()
+        self._last_compiles = 0
+        self._compiles0 = 0
+        self.sentinel = get_sentinel()
+        if self.enabled:
+            self.run_id = run_id or f"{os.getpid():x}-{time.time_ns() & 0xFFFFFF:06x}"
+            self.sentinel.install()
+            self._compiles0 = self._last_compiles = self.sentinel.count
+            from sparknet_tpu import common
+
+            common.add_bank_observer(self._on_bank)
+        else:
+            self.run_id = run_id or "off"
+
+    @classmethod
+    def from_env(cls) -> "Recorder":
+        raw = os.environ.get(_ENV, "").strip()
+        if raw in ("", "0"):
+            return cls(None)
+        return cls("obs_journal.jsonl" if raw == "1" else raw)
+
+    def __bool__(self) -> bool:
+        return self.enabled
+
+    # -- low-level emit ----------------------------------------------------
+
+    def emit(self, event: str, **fields) -> None:
+        """Validate against the schema and append one journal line.
+        Never raises out of an armed training run: a schema bug or a
+        read-only disk prints to stderr and drops the line — telemetry
+        must not take the run down."""
+        if not self.enabled:
+            return
+        try:
+            line = schema.make_event(event, run_id=self.run_id, **fields)
+        except ValueError as e:
+            print(f"obs: dropped invalid event: {e}", file=sys.stderr)
+            return
+        payload = json.dumps(line)
+        with self._lock:
+            if not self._started:
+                self._started = True
+                start = schema.make_event(
+                    "run_start", run_id=self.run_id, pid=os.getpid(),
+                    argv=list(sys.argv))
+                self._write(json.dumps(start))
+            self._write(payload)
+
+    def _write(self, payload: str) -> None:
+        try:
+            with open(self.path, "a", encoding="utf-8") as f:
+                f.write(payload + "\n")
+        except OSError as e:
+            print(f"obs: could not append to {self.path}: {e}",
+                  file=sys.stderr)
+
+    def _emit_span(self, fields: dict) -> None:
+        self._n_spans += 1
+        self.emit("span", **fields)
+
+    # -- public surface ----------------------------------------------------
+
+    def span(self, name: str, host: bool = False,
+             note: str | None = None) -> Span:
+        """A fenced-wall context manager (works, as a no-op, when obs is
+        off).  ``host=True`` declares the span never encloses device
+        work and exempts it from the fence contract."""
+        return Span(self, name, host=host, note=note)
+
+    def round(self, *, mode: str, tau: int, devices: int, iters: int,
+              batch: int, wall_s: float, loss: float, fenced: bool,
+              comm: dict | None = None, iteration: int | None = None,
+              workers: int | None = None) -> None:
+        """One per-round training record.  ``batch`` is images per local
+        step; throughput is ``iters * batch / wall_s``.  Also drives the
+        recompile sentinel: any backend compilation between rounds of an
+        already-warm mode is flagged live as a ``recompile`` event."""
+        if not self.enabled:
+            return
+        loss = float(loss)
+        ema = self._ema.get(mode)
+        ema = loss if ema is None else (
+            _EMA_DECAY * ema + (1.0 - _EMA_DECAY) * loss)
+        self._ema[mode] = ema
+
+        total = self.sentinel.count
+        compiles = total - self._last_compiles
+        self._last_compiles = total
+        if compiles > 0 and mode in self._warm_modes:
+            self.emit("recompile", count=compiles,
+                      total=total - self._compiles0, where=mode,
+                      expected=False)
+        self._warm_modes.add(mode)
+
+        images_per_sec = (iters * batch / wall_s) if wall_s > 0 else 0.0
+        fields: dict = {
+            "mode": mode, "tau": int(tau), "devices": int(devices),
+            "iters": int(iters), "batch": int(batch),
+            "wall_s": round(float(wall_s), 6),
+            "images_per_sec": round(images_per_sec, 1),
+            "loss": loss, "loss_ema": round(ema, 6),
+            "fenced": bool(fenced), "compiles": compiles,
+        }
+        if comm is not None:
+            fields["comm"] = comm
+        if iteration is not None:
+            fields["iteration"] = int(iteration)
+        if workers is not None:
+            fields["workers"] = int(workers)
+        self._n_rounds += 1
+        self.emit("round", **fields)
+
+    def bench(self, record: dict, *, wall_s: float | None = None,
+              fence_value: float | None = None,
+              fenced: bool = False) -> None:
+        """Journal one bench.py record whole (the record's keys are
+        bench.py's contract; the schema wraps, it does not re-specify)."""
+        if not self.enabled:
+            return
+        fields: dict = {
+            "metric": str(record.get("metric", "?")),
+            "measured": bool(record.get("measured")),
+            "fenced": bool(fenced),
+            "record": dict(record),
+        }
+        if wall_s is not None:
+            fields["wall_s"] = round(float(wall_s), 6)
+        if fence_value is not None:
+            fields["fence_value"] = float(fence_value)
+        self.emit("bench", **fields)
+
+    def _on_bank(self, path: str, payload, measured: bool) -> None:
+        """common.bank_guard observer: every banked-evidence write lands
+        in the journal too, measured-stamping shared with the sink."""
+        fields: dict = {"path": path, "measured": bool(measured)}
+        if isinstance(payload, dict):
+            if isinstance(payload.get("metric"), str):
+                fields["metric"] = payload["metric"]
+            value = payload.get("value")
+            if value is None or isinstance(value, (int, float)):
+                fields["value"] = value
+            if payload.get("rehearsal"):
+                fields["rehearsal"] = True
+        self.emit("bank", **fields)
+
+    def close(self) -> None:
+        """Emit the run summary (idempotent enough for atexit use)."""
+        if not self.enabled or not self._started:
+            return
+        self.emit("run_end", rounds=self._n_rounds, spans=self._n_spans,
+                  compiles=self.sentinel.count - self._compiles0)
+
+    def detach(self) -> None:
+        """Deregister this Recorder's bank observer (tests; replaced
+        singletons) so a retired Recorder stops journaling."""
+        if self.enabled:
+            from sparknet_tpu import common
+
+            common.remove_bank_observer(self._on_bank)
+
+
+_recorder: Recorder | None = None
+
+
+def get_recorder() -> Recorder:
+    """The process singleton, built from ``SPARKNET_OBS`` on first use."""
+    global _recorder
+    if _recorder is None:
+        _recorder = Recorder.from_env()
+    return _recorder
+
+
+def set_recorder(rec: Recorder | None) -> Recorder | None:
+    """Replace the singleton (tests; the dryrun CLI).  ``None`` resets
+    to lazy env-driven construction.  The outgoing Recorder is detached
+    so it stops observing bank_guard writes."""
+    global _recorder
+    if _recorder is not None:
+        _recorder.detach()
+    _recorder = rec
+    return rec

@@ -1,0 +1,210 @@
+"""Render an obs journal into a markdown run report.
+
+The rendering twin of ``tools/tunnel_log.py`` / ``tools/trace_report.py``
+for the runtime journal: deterministic markdown from JSONL, safe to
+regenerate, honest about what is and is not evidence.  Two refusals are
+load-bearing:
+
+* **Unstamped walls are refused.**  A span or round journaled with
+  ``fenced: false`` (and not declared ``host``) renders with its wall
+  withheld — the pre-round-5 tools banked physically impossible walls
+  off exactly such numbers (probe-40's 8.2M img/s, the 7,860% MFU
+  artifacts), and this renderer will not launder a new one.
+* **No throughput above its stated roofline bound.**  A bench record
+  whose value exceeds its own ``roofline_img_s_upper_bound`` (or that
+  carries a ``bound_inconsistency``) renders as a named conflict, never
+  as a headline number (CLAUDE.md: no value above its stated roofline).
+"""
+
+from __future__ import annotations
+
+from sparknet_tpu.obs import schema
+
+__all__ = ["render", "render_path"]
+
+
+def _fmt_comm(comm: dict) -> str:
+    """One cell for the round's comm_model-predicted budget."""
+    predicted = comm.get("predicted") or {}
+    parts = []
+    for kind in sorted(predicted):
+        window = predicted[kind]
+        if window is None:
+            parts.append(f"{kind} (presence)")
+        else:
+            lo, hi = window
+            parts.append(f"{kind} {lo:,}–{hi:,} B")
+    return "; ".join(parts) if parts else "—"
+
+
+def _round_rows(rounds: list[dict]) -> list[str]:
+    lines = [
+        "| # | mode | tau | devices | iters | batch | wall s | img/s "
+        "| loss | loss EMA | predicted comm | compiles |",
+        "|---|---|---|---|---|---|---|---|---|---|---|---|",
+    ]
+    for i, ev in enumerate(rounds, start=1):
+        if ev.get("fenced"):
+            wall = f"{ev.get('wall_s', 0):.3f}"
+            ips = f"{ev.get('images_per_sec', 0):,.1f}"
+        else:
+            # an unstamped wall is not evidence on relay backends
+            wall = "REFUSED"
+            ips = "REFUSED (unfenced)"
+        lines.append(
+            f"| {i} | {ev.get('mode', '?')} | {ev.get('tau', '?')} "
+            f"| {ev.get('devices', '?')} | {ev.get('iters', '?')} "
+            f"| {ev.get('batch', '?')} | {wall} | {ips} "
+            f"| {ev.get('loss', float('nan')):.4f} "
+            f"| {ev.get('loss_ema', float('nan')):.4f} "
+            f"| {_fmt_comm(ev.get('comm') or {})} "
+            f"| {ev.get('compiles', 0)} |")
+    return lines
+
+
+def _span_rows(spans: list[dict]) -> list[str]:
+    lines = [
+        "| span | wall s | fence |",
+        "|---|---|---|",
+    ]
+    for ev in spans:
+        name = ev.get("name", "?")
+        if ev.get("host"):
+            wall = f"{ev.get('wall_s', 0):.3f}"
+            fence = "host-side (no device work)"
+        elif ev.get("fenced"):
+            wall = f"{ev.get('wall_s', 0):.3f}"
+            fv = ev.get("fence_value")
+            fence = "value-stamped" if fv is None else f"value={fv:g}"
+        else:
+            wall = "—"
+            fence = "REFUSED: span closed without a fence stamp"
+        lines.append(f"| {name} | {wall} | {fence} |")
+    return lines
+
+
+def _bench_lines(benches: list[dict]) -> list[str]:
+    lines = []
+    for ev in benches:
+        rec = ev.get("record") or {}
+        metric = ev.get("metric", "?")
+        value = rec.get("value")
+        unit = rec.get("unit", "")
+        bound = rec.get("roofline_img_s_upper_bound")
+        conflict = rec.get("bound_inconsistency") or rec.get(
+            "roofline_img_s_upper_bound_conflicting")
+        tags = []
+        tags.append("measured" if ev.get("measured") else "UNMEASURED")
+        if not ev.get("fenced"):
+            tags.append("unfenced")
+        if rec.get("probe") is not None:
+            tags.append(f"probe {rec['probe']}")
+        tag = ", ".join(tags)
+        if conflict is not None:
+            why = rec.get("bound_inconsistency",
+                          "value above its stated bound")
+            lines.append(
+                f"- `{metric}`: REFUSED — record carries a roofline "
+                f"conflict ({why}); not printable as a headline number "
+                f"({tag})")
+            continue
+        if (value is not None and bound is not None
+                and isinstance(value, (int, float)) and value > bound):
+            lines.append(
+                f"- `{metric}`: REFUSED — value exceeds its stated "
+                f"roofline bound {bound:g} {unit} and is withheld "
+                f"({tag})")
+            continue
+        shown = "n/a" if value is None else f"{value:g} {unit}".rstrip()
+        extra = f", bound {bound:g}" if bound is not None else ""
+        lines.append(f"- `{metric}` = {shown} ({tag}{extra})")
+    return lines
+
+
+def _bank_lines(banks: list[dict]) -> list[str]:
+    lines = []
+    for ev in banks:
+        label = "measured" if ev.get("measured") else \
+            "rehearsal — not chip evidence"
+        detail = ""
+        if ev.get("metric") is not None:
+            value = ev.get("value")
+            detail = f" {ev['metric']}" + (
+                f"={value:g}" if isinstance(value, (int, float)) else "")
+        lines.append(f"- `{ev.get('path', '?')}` ({label}){detail}")
+    return lines
+
+
+def render(events: list[dict], source: str = "journal") -> str:
+    """Deterministic markdown for one journal's events (pure function of
+    its input — the golden test depends on that)."""
+    lines = [
+        f"# obsnet run report — {source}",
+        "",
+        "Rendered by `python -m sparknet_tpu.obs report` from the "
+        "structured obs journal (`sparknet_tpu/obs/schema.py`).",
+        "Walls are trusted only when fence-stamped via "
+        "`common.value_fence` (unstamped walls are REFUSED), and no "
+        "throughput is printed above its stated roofline bound.",
+    ]
+    runs: list[str] = []
+    by_run: dict[str, dict[str, list]] = {}
+    for ev in events:
+        run_id = ev.get("run_id")
+        if run_id is None:
+            continue  # window-runner events render via tools/tunnel_log.py
+        if run_id not in by_run:
+            runs.append(run_id)
+            by_run[run_id] = {"start": [], "round": [], "span": [],
+                              "recompile": [], "bench": [], "bank": [],
+                              "end": []}
+        kind = ev.get("event")
+        key = {"run_start": "start", "run_end": "end"}.get(kind, kind)
+        if key in by_run[run_id]:
+            by_run[run_id][key].append(ev)
+
+    if not runs:
+        lines += ["", "_No obs events in this journal._", ""]
+        return "\n".join(lines)
+
+    for run_id in runs:
+        group = by_run[run_id]
+        started = group["start"][0].get("utc", "?") if group["start"] \
+            else "?"
+        lines += ["", f"## run `{run_id}` (started {started})"]
+        if group["round"]:
+            lines += ["", "### rounds", ""]
+            lines += _round_rows(group["round"])
+        if group["span"]:
+            lines += ["", "### spans", ""]
+            lines += _span_rows(group["span"])
+        if group["recompile"]:
+            lines += ["", "### recompiles", ""]
+            for ev in group["recompile"]:
+                lines.append(
+                    f"- **{ev.get('count', '?')} unexpected XLA "
+                    f"compilation(s)** after warmup in mode "
+                    f"`{ev.get('where', '?')}` (process total "
+                    f"{ev.get('total', '?')}) — a warm step should "
+                    "never recompile")
+        if group["bench"]:
+            lines += ["", "### bench records", ""]
+            lines += _bench_lines(group["bench"])
+        if group["bank"]:
+            lines += ["", "### banked evidence", ""]
+            lines += _bank_lines(group["bank"])
+        if group["end"]:
+            ev = group["end"][0]
+            lines += ["",
+                      f"Run end: {ev.get('rounds', 0)} round(s), "
+                      f"{ev.get('spans', 0)} span(s), "
+                      f"{ev.get('compiles', 0)} backend compilation(s)."]
+    lines.append("")
+    return "\n".join(lines)
+
+
+def render_path(path: str, source: str | None = None) -> str:
+    import os
+
+    return render(schema.load_journal(path),
+                  source=source or os.path.basename(path))

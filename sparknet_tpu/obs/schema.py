@@ -1,0 +1,279 @@
+"""The one journal-line schema: window-runner events + obs runtime events.
+
+Until this module existed the journal format lived informally in three
+tools — ``tools/tpu_window_runner.py`` wrote lines, ``tools/tunnel_log.py``
+and the judge read them, and nothing checked that the two sides agreed
+(the round-3 journal silently lacks per-dial probe ids, which is exactly
+how a bench record's provenance field became unmatchable).  This module
+states the format once, as checkable data: every line is one JSON object
+with an ``event`` discriminator, a ``utc`` wall stamp, and per-event
+required/optional fields.  Writers build lines through :func:`make_event`
+(validates before the bytes hit disk); readers validate through
+:func:`validate_line` / :func:`validate_journal`.
+
+Two event families share the format deliberately — the window runner's
+host-side ledger (dials, jobs) and the obs Recorder's runtime telemetry
+(spans, rounds, recompiles, banked evidence) — so one validator audits
+the whole evidence chain and one renderer vocabulary covers both.
+
+Deliberately stdlib-only (the analysis-package contract: importable on a
+box with a wedged relay; nothing here touches jax, and nothing it
+triggers may initialize a backend).
+
+Legacy journals: lines that predate the schema are NOT silently skipped.
+:data:`LEGACY_ALLOWLIST` names each known-deviant (journal, event,
+error) triple with the reason; the validator forgives exactly those and
+reports everything else.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import time
+from typing import Any, Iterator
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "EVENTS",
+    "LEGACY_ALLOWLIST",
+    "utc_now",
+    "make_event",
+    "validate_line",
+    "validate_journal",
+    "load_journal",
+]
+
+SCHEMA_VERSION = 1
+
+# the journal's wall-stamp format, shared verbatim with the window
+# runner's historical lines: "2026-07-31 15:35:45Z"
+_UTC_FMT = "%Y-%m-%d %H:%M:%SZ"
+_UTC_RE = re.compile(r"^\d{4}-\d{2}-\d{2} \d{2}:\d{2}:\d{2}Z$")
+
+_NUM = (int, float)
+_OPT_STR = (str, type(None))
+
+# event name -> (required {field: type(s)}, optional {field: type(s)}).
+# ``event`` and ``utc`` are implicit on every line.  Unknown events and
+# unknown fields are validation errors: both writers live in this repo,
+# so drift is a bug, not forward compatibility.
+EVENTS: dict[str, tuple[dict, dict]] = {
+    # -- tools/tpu_window_runner.py (host-side evidence ledger) ---------
+    "runner_start": ({"queue": str, "jobs": list}, {}),
+    "dial_start": ({"probe": int}, {}),
+    "dial_end": (
+        {"probe": int, "ok": bool, "dt_s": _NUM},
+        {"platform": _OPT_STR, "error": _OPT_STR},
+    ),
+    # post-hoc adjudication of a dial whose runner died mid-flight
+    "dial_abandoned": ({"probe": int, "note": str}, {}),
+    "job_start": (
+        {"job": str, "argv": list, "deadline_s": _NUM},
+        {"setup": bool},
+    ),
+    "job_end": (
+        {"job": str, "rc": (int, type(None)), "dt_s": _NUM,
+         "timed_out": bool},
+        {"window_death": bool, "setup": bool},
+    ),
+    "queue_reload_failed": ({"error": str}, {}),
+    "setup_failed": ({"job": str, "note": str}, {}),
+    "runner_done": ({"reason": str}, {"blocked_jobs": list}),
+    # -- sparknet_tpu/obs Recorder (runtime telemetry) ------------------
+    "run_start": ({"run_id": str}, {"pid": int, "argv": list, "note": str}),
+    # a fenced wall around arbitrary work; ``fenced`` False means the
+    # wall is NOT evidence (the report refuses it) unless ``host`` says
+    # the span never enclosed device work
+    "span": (
+        {"run_id": str, "name": str, "wall_s": _NUM, "fenced": bool},
+        {"host": bool, "fence_value": _NUM, "note": str},
+    ),
+    # one training round: tau local steps (tau=1 sync SGD degenerate
+    # case included), with the comm_model-predicted collective budget
+    # attached so measured rounds carry their analytic expectation
+    "round": (
+        {"run_id": str, "mode": str, "tau": int, "devices": int,
+         "iters": int, "batch": int, "wall_s": _NUM,
+         "images_per_sec": _NUM, "loss": _NUM, "loss_ema": _NUM,
+         "fenced": bool},
+        {"comm": dict, "compiles": int, "iteration": int, "workers": int},
+    ),
+    # the recompile sentinel fired: ``count`` backend compilations since
+    # the previous round of an already-warm mode
+    "recompile": (
+        {"run_id": str, "count": int, "total": int},
+        {"where": str, "expected": bool},
+    ),
+    # a bench.py measurement, embedded whole under ``record`` (the
+    # record's own keys are bench.py's contract, not re-specified here)
+    "bench": (
+        {"run_id": str, "metric": str, "measured": bool, "fenced": bool},
+        {"record": dict, "wall_s": _NUM, "fence_value": _NUM},
+    ),
+    # one common.bank_guard write (the blessed evidence sink); measured
+    # False means the payload was diverted to /tmp with a rehearsal stamp
+    "bank": (
+        {"run_id": str, "path": str, "measured": bool},
+        {"metric": str, "value": (int, float, type(None)),
+         "rehearsal": bool},
+    ),
+    "run_end": (
+        {"run_id": str, "rounds": int, "spans": int, "compiles": int}, {},
+    ),
+}
+
+# Known-deviant legacy lines, forgiven explicitly (never silently): each
+# entry names the journal (path suffix), the event, the exact error
+# prefix being excused, and why.
+LEGACY_ALLOWLIST: tuple[dict, ...] = (
+    {
+        "journal": "docs/evidence_r3/journal.jsonl",
+        "event": "dial_start",
+        "error": "missing required field 'probe'",
+        "reason": "round-3 journal predates per-dial probe ids "
+                  "(introduced for r4 provenance matching)",
+    },
+    {
+        "journal": "docs/evidence_r3/journal.jsonl",
+        "event": "dial_end",
+        "error": "missing required field 'probe'",
+        "reason": "round-3 journal predates per-dial probe ids "
+                  "(introduced for r4 provenance matching)",
+    },
+)
+
+
+def utc_now() -> str:
+    """The journal wall stamp, in the format every round has used."""
+    return time.strftime(_UTC_FMT, time.gmtime())
+
+
+def _type_name(spec) -> str:
+    types = spec if isinstance(spec, tuple) else (spec,)
+    return "|".join("null" if t is type(None) else t.__name__
+                    for t in types)
+
+
+def _check_fields(event: str, obj: dict) -> list[str]:
+    required, optional = EVENTS[event]
+    errors: list[str] = []
+    for field, spec in required.items():
+        if field not in obj:
+            errors.append(f"missing required field {field!r}")
+        elif not isinstance(obj[field], spec):
+            errors.append(
+                f"field {field!r} is {type(obj[field]).__name__}, "
+                f"schema wants {_type_name(spec)}")
+    for field, value in obj.items():
+        if field in ("event", "utc") or field in required:
+            continue
+        if field not in optional:
+            errors.append(f"unknown field {field!r} for event {event!r}")
+        elif not isinstance(value, optional[field]):
+            errors.append(
+                f"field {field!r} is {type(value).__name__}, "
+                f"schema wants {_type_name(optional[field])}")
+    return errors
+
+
+def validate_line(obj: Any) -> list[str]:
+    """Schema errors for one parsed journal line (empty list = valid)."""
+    if not isinstance(obj, dict):
+        return ["line is not a JSON object"]
+    event = obj.get("event")
+    if not isinstance(event, str):
+        return ["missing 'event' discriminator"]
+    if event not in EVENTS:
+        return [f"unknown event {event!r}"]
+    errors = _check_fields(event, obj)
+    utc = obj.get("utc")
+    if not isinstance(utc, str) or not _UTC_RE.match(utc):
+        errors.append("missing or malformed 'utc' stamp "
+                      "(want 'YYYY-MM-DD HH:MM:SSZ')")
+    return errors
+
+
+def make_event(event: str, **fields) -> dict:
+    """Build one validated journal line (stamps ``utc``; raises
+    ValueError on any schema violation — writers fail loudly at build
+    time instead of banking unreadable evidence)."""
+    line = {"event": event, **fields}
+    line.setdefault("utc", utc_now())
+    errors = validate_line(line)
+    if errors:
+        raise ValueError(
+            f"journal line for event {event!r} violates the obs schema: "
+            + "; ".join(errors))
+    return line
+
+
+def _allowlisted(path: str, event: str, error: str,
+                 allowlist: tuple) -> bool:
+    norm = path.replace("\\", "/")
+    for entry in allowlist:
+        if (norm.endswith(entry["journal"]) and event == entry["event"]
+                and error.startswith(entry["error"])):
+            return True
+    return False
+
+
+def validate_journal(
+    path: str, allowlist: tuple = LEGACY_ALLOWLIST,
+) -> tuple[int, int, list[str]]:
+    """Validate every line of a journal file.
+
+    Returns ``(n_lines, n_allowlisted, errors)`` where ``errors`` holds
+    one ``"path:lineno: message"`` string per non-allowlisted violation.
+    Unparseable lines are errors too — the runner appends atomically
+    enough that a torn line means something worth knowing about.
+    """
+    n_lines = 0
+    n_allowlisted = 0
+    errors: list[str] = []
+    with open(path, encoding="utf-8") as f:
+        for lineno, raw in enumerate(f, start=1):
+            if not raw.strip():
+                continue
+            n_lines += 1
+            try:
+                obj = json.loads(raw)
+            except ValueError as e:
+                errors.append(f"{path}:{lineno}: unparseable JSON ({e})")
+                continue
+            line_errors = validate_line(obj)
+            if not line_errors:
+                continue
+            event = obj.get("event") if isinstance(obj, dict) else None
+            kept = [e for e in line_errors
+                    if not _allowlisted(path, str(event), e, allowlist)]
+            if len(kept) < len(line_errors):
+                n_allowlisted += 1
+            errors.extend(f"{path}:{lineno}: [{event}] {e}" for e in kept)
+    return n_lines, n_allowlisted, errors
+
+
+def load_journal(path: str) -> list[dict]:
+    """Parse a journal into event dicts, best-effort (renderers want
+    whatever landed; use :func:`validate_journal` for the strict view).
+    Unparseable lines are dropped here — and counted as errors there."""
+    events: list[dict] = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(obj, dict):
+                    events.append(obj)
+    except OSError:
+        pass
+    return events
+
+
+def iter_events(path: str, event: str) -> Iterator[dict]:
+    """Events of one kind from a journal, in file order."""
+    for obj in load_journal(path):
+        if obj.get("event") == event:
+            yield obj

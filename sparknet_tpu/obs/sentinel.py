@@ -1,0 +1,80 @@
+"""Recompile sentinel: count XLA backend compilations per process.
+
+graphcheck's static ``graph-recompile-hazard`` audit proves a step's
+StableHLO is iteration-stable at lowering time; this sentinel is the
+RUNTIME complement — it counts actual backend compilations through
+jax's monitoring hooks so a live run can flag the recompiles the static
+check cannot see (shape-polymorphic feeds, a Python value captured in a
+closure, a cache-defeating donation change).  Over the axon relay a
+recompile is minutes of chip-window time, so "the step compiled again"
+is an operational incident, not a curiosity.
+
+Counts ``/jax/core/compile/backend_compile_duration`` events: one fires
+per XLA backend compilation (a single ``jit`` call may legitimately
+emit a few — sub-computations compile separately); a cache hit fires
+none.  That asymmetry is all the Recorder needs: zero new events
+between rounds of a warm mode means no recompile, anything else is
+flagged.
+
+jax's listener registry has no stability guarantee; if the hook is
+missing the sentinel degrades to ``available=False`` and counts stay 0
+(observability must never take the training run down with it).
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["RecompileSentinel", "get_sentinel"]
+
+# the event name jax 0.4.x records one of per backend compilation
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+class RecompileSentinel:
+    """Process-wide backend-compilation counter (install once)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._installed = False
+        self.available = False
+
+    def install(self) -> "RecompileSentinel":
+        """Register the jax monitoring listener (idempotent).  Imports
+        jax lazily so this module stays importable on relay-wedged boxes
+        without paying a backend-adjacent import."""
+        with self._lock:
+            if self._installed:
+                return self
+            self._installed = True
+        try:
+            from jax._src import monitoring
+
+            def _on_duration(name: str, duration: float, **_kw) -> None:
+                if name == _COMPILE_EVENT:
+                    with self._lock:
+                        self._count += 1
+
+            monitoring.register_event_duration_secs_listener(_on_duration)
+            self.available = True
+        except Exception:
+            # registry moved or import failed: stay silent but honest —
+            # count remains 0 and callers can see available=False
+            self.available = False
+        return self
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+
+_sentinel: RecompileSentinel | None = None
+
+
+def get_sentinel() -> RecompileSentinel:
+    global _sentinel
+    if _sentinel is None:
+        _sentinel = RecompileSentinel()
+    return _sentinel

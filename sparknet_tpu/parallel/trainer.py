@@ -25,6 +25,7 @@ ImageNet — ref: CifarApp.scala:119, ImageNetApp.scala:151).
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable
 
 import jax
@@ -34,6 +35,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from sparknet_tpu.common import get_config
 from sparknet_tpu.compiler.graph import NetVars
+from sparknet_tpu.obs import get_recorder
 from sparknet_tpu.net import WeightCollection, collection_to_variables, variables_to_collection
 from sparknet_tpu.parallel.mesh import data_parallel_mesh, shard_map
 from sparknet_tpu.parallel.sharding import (
@@ -354,16 +356,26 @@ class ParallelTrainer:
         On a multi-process mesh the batch axis is the PER-PROCESS shard
         instead of B_global — each host feeds only its own partition (see
         _put_feeds).  Returns mean loss (device value materialized — call
-        sites that care about overlap should batch rounds)."""
+        sites that care about overlap should batch rounds).
+
+        With ``SPARKNET_OBS`` armed each round emits one obs record
+        (wall fence-stamped on the loss VALUE, comm_model-predicted
+        collective bytes attached); disabled, the body is untouched —
+        the fenced return value IS the ``float(loss)`` this method
+        always materialized, so obs adds zero extra dispatches either
+        way."""
+        rec = get_recorder()
+        t0 = time.perf_counter() if rec else 0.0
+        raw = data_fn(self.iter)
         if self._elastic:
-            feeds = self._put_feeds(data_fn(self.iter), with_tau_axis=True)
+            feeds = self._put_feeds(raw, with_tau_axis=True)
             self.variables, self.slots, self.center, loss = self._train(
                 self.variables, self.slots, self.center, self.iter, feeds,
                 self.solver._key,
             )
             self.iter += self.tau
         elif self.tau == 1:
-            feeds = self._put_feeds(data_fn(self.iter), with_tau_axis=False)
+            feeds = self._put_feeds(raw, with_tau_axis=False)
             with self._sp_context():
                 self.variables, self.slots, loss = self._train(
                     self.variables, self.slots, self.iter, feeds,
@@ -371,11 +383,13 @@ class ParallelTrainer:
                 )
             self.iter += 1
         else:
-            feeds = self._put_feeds(data_fn(self.iter), with_tau_axis=True)
+            feeds = self._put_feeds(raw, with_tau_axis=True)
             self.variables, self.slots, loss = self._train(
                 self.variables, self.slots, self.iter, feeds, self.solver._key
             )
             self.iter += self.tau
+        if rec:
+            return self._emit_obs_round(rec, raw, t0, loss)
         return float(loss)
 
     def train(self, num_outer: int, data_fn: DataFn, callback=None) -> float:
@@ -385,6 +399,77 @@ class ParallelTrainer:
             if callback:
                 callback(self.iter, loss)
         return loss
+
+    # ------------------------------------------------------------------
+    def _obs_mode(self) -> str:
+        """The comm_model mode name this trainer's rounds run as."""
+        if self._elastic:
+            return "easgd"
+        return "tau" if self.tau > 1 else "dp"
+
+    def _obs_comm(self) -> dict | None:
+        """comm_model's analytic per-round collective budget for this
+        trainer's mode and ACTUAL model sizes — attached to every obs
+        round record so a measured wall carries its predicted wire
+        volume inline (the runtime tie-in to graphcheck's static
+        manifests).  Cached: the model does not change between rounds."""
+        cached = getattr(self, "_obs_comm_cache", False)
+        if cached is not False:
+            return cached
+        from sparknet_tpu.analysis.comm_model import expected_comm
+
+        def tree_bytes(tree) -> int:
+            return sum(
+                int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+                for l in jax.tree_util.tree_leaves(tree)
+                if hasattr(l, "shape") and hasattr(l, "dtype"))
+
+        # single-replica sizes from the wrapped Solver's tree: tau/EASGD
+        # stack a worker axis, but the sync still moves one model's
+        # bytes per chip per round (same convention as parallel/modes.py)
+        pb = tree_bytes(self.solver.variables.params)
+        sb = tree_bytes(self.solver.variables.state)
+        try:
+            exp = expected_comm(self._obs_mode(), param_bytes=pb,
+                                state_bytes=sb)
+            comm: dict | None = {
+                "param_bytes": pb,
+                "state_bytes": sb,
+                "predicted": {k: (list(v) if v is not None else None)
+                              for k, v in exp.required.items()},
+                "note": exp.note,
+            }
+        except KeyError:
+            comm = None
+        self._obs_comm_cache = comm
+        return comm
+
+    def _emit_obs_round(self, rec, raw, t0: float, loss) -> float:
+        """Journal one round record; returns the fenced loss VALUE —
+        the same number ``float(loss)`` yields (``value_fence`` on the
+        scalar loss IS the value fetch), so obs-on and obs-off return
+        identically and no extra dispatch is added."""
+        from sparknet_tpu.common import value_fence
+
+        loss_val = value_fence(loss)
+        wall = time.perf_counter() - t0
+        stacked = self.tau > 1 or self._elastic
+        batch = 0
+        for v in raw.values():
+            shp = getattr(v, "shape", None) or np.shape(v)
+            if shp:
+                batch = int(shp[1]) if stacked and len(shp) > 1 \
+                    else int(shp[0])
+                break
+        rec.round(
+            mode=self._obs_mode(), tau=self.tau,
+            devices=int(self.mesh.devices.size),
+            workers=self.num_workers,
+            iters=self.tau if stacked else 1, batch=batch,
+            wall_s=wall, loss=loss_val, fenced=True,
+            comm=self._obs_comm(), iteration=self.iter,
+        )
+        return loss_val
 
     # ------------------------------------------------------------------
     def train_rounds(self, n: int, data_fn: DataFn) -> float:
@@ -410,6 +495,8 @@ class ParallelTrainer:
             self._round_scan_fns[n], _, _, _ = self.solver.jitted_scan_steps(
                 n, donate=True, stacked_feeds=True, step_fn=self._step_fn
             )
+        rec = get_recorder()
+        t0 = time.perf_counter() if rec else 0.0
         host = [data_fn(self.iter + i) for i in range(n)]
         stacked = {
             k: np.stack([np.asarray(h[k]) for h in host]) for k in host[0]
@@ -424,6 +511,23 @@ class ParallelTrainer:
                 self.solver._key,
             )
         self.iter += n
+        if rec:
+            # one obs record for the fused n-round dispatch; value_fence
+            # on the [n] loss vector fetches its LAST element — the same
+            # number the plain return materializes
+            from sparknet_tpu.common import value_fence
+
+            loss_val = value_fence(losses)
+            batch = next(
+                (int(np.shape(v)[0]) for v in host[0].values()
+                 if np.shape(v)), 0)
+            rec.round(
+                mode="dp", tau=1, devices=int(self.mesh.devices.size),
+                workers=self.num_workers, iters=n, batch=batch,
+                wall_s=time.perf_counter() - t0, loss=loss_val,
+                fenced=True, comm=self._obs_comm(), iteration=self.iter,
+            )
+            return loss_val
         return float(losses[-1])
 
     # ------------------------------------------------------------------

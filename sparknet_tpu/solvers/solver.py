@@ -24,6 +24,7 @@ import numpy as np
 
 from sparknet_tpu.common import Phase, root_key, step_key
 from sparknet_tpu.compiler.graph import Network, NetVars
+from sparknet_tpu.obs import get_recorder
 from sparknet_tpu.proto.text_format import Message, parse_file
 from sparknet_tpu.solvers.lr_policy import learning_rate
 from sparknet_tpu.solvers.updates import apply_update, init_slots
@@ -220,6 +221,10 @@ class Solver:
         self.iter = 0
         self.smoothed_loss = 0.0
         self._loss_window: list[float] = []
+        # obs bookkeeping (sparknet_tpu/obs): both stay inert — and the
+        # jitted programs bit-identical — while SPARKNET_OBS is off
+        self._obs_in_step = False
+        self._obs_images_per_iter = 0
         self._specs = self.train_net.param_specs_for(self.variables)
         # Donate the (variables, slots) carry: step() rebinds both from
         # the outputs every iteration, so keeping the inputs alive just
@@ -416,13 +421,38 @@ class Solver:
         AFTER each chunk (each still sees its per-iteration loss, but
         solver state has already advanced to the chunk end — interactive
         per-step control wants scan_chunk=1).  ``debug_info`` forces the
-        per-iteration path (its stats are per-step host prints)."""
+        per-iteration path (its stats are per-step host prints).
+
+        With ``SPARKNET_OBS`` armed, one per-round obs record covers the
+        whole call (wall fence-stamped on the final loss VALUE, per the
+        round-5 contract); disabled, the body below runs byte-for-byte
+        unchanged — same programs, same dispatch count."""
+        rec = get_recorder()
+        if not (rec and not self._obs_in_step and num_iters > 0):
+            return self._step_impl(num_iters, data_fn, callback,
+                                   scan_chunk)
+        self._obs_in_step = True
+        t0 = time.perf_counter()
+        it0 = self.iter
+        try:
+            out = self._step_impl(num_iters, data_fn, callback,
+                                  scan_chunk)
+        finally:
+            self._obs_in_step = False
+        self._emit_obs_round(rec, it0, t0)
+        return out
+
+    def _step_impl(self, num_iters: int, data_fn: DataFn, callback=None,
+                   scan_chunk: int = 1) -> float:
+        """The body of :meth:`step` (see its docstring)."""
         cfg = self.config
         if scan_chunk > 1 and not cfg.debug_info:
             return self._step_scanned(num_iters, data_fn, callback,
                                       scan_chunk)
         for _ in range(num_iters):
             feeds = data_fn(self.iter)
+            if self._obs_in_step:
+                self._obs_images_per_iter = self._feed_images(feeds)
             out = self._train_step(
                 self.variables, self.slots, self.iter, feeds, self._key
             )
@@ -488,6 +518,8 @@ class Solver:
             fn = self._scan_fns[n]
             start = self.iter
             host = [data_fn(start + i) for i in range(n)]
+            if self._obs_in_step:
+                self._obs_images_per_iter = self._feed_images(host[0])
             if any(isinstance(v, jax.Array) for v in host[0].values()):
                 # prefetched feeds are already device-resident: stack on
                 # device — np.asarray here would force a blocking D2H of
@@ -530,6 +562,43 @@ class Solver:
         self.smoothed_loss = self._smoothed()
         return self.smoothed_loss
 
+    # ------------------------------------------------------------------
+    def _feed_images(self, feeds) -> int:
+        """Images per solver iteration in one feed dict (iter_size > 1
+        feeds carry a leading [iter_size] micro-batch axis)."""
+        for v in feeds.values():
+            shp = getattr(v, "shape", None)
+            if shp:
+                if self.config.iter_size > 1 and len(shp) > 1:
+                    return int(shp[0]) * int(shp[1])
+                return int(shp[0])
+        return 0
+
+    def _emit_obs_round(self, rec, it0: int, t0: float) -> None:
+        """One obs round record for a completed :meth:`step` call.
+
+        The wall is closed on the VALUE of the last loss — either a
+        direct ``value_fence`` fetch of the final program's own output
+        (the per-iteration path keeps losses as device arrays), or the
+        ``np.asarray(losses)`` materialization the scanned path already
+        performed.  Threaded state makes the final step depend on every
+        predecessor, so one fence covers the whole round."""
+        from sparknet_tpu.common import value_fence
+
+        if not self._loss_window:
+            return
+        loss = self._loss_window[-1]
+        if isinstance(loss, jax.Array):
+            loss_val = value_fence(loss)
+        else:
+            loss_val = float(loss)
+        rec.round(
+            mode="solo", tau=1, devices=1, iters=self.iter - it0,
+            batch=int(self._obs_images_per_iter),
+            wall_s=time.perf_counter() - t0, loss=loss_val, fenced=True,
+            iteration=self.iter,
+        )
+
     def solve(
         self,
         train_fn: DataFn,
@@ -551,7 +620,24 @@ class Solver:
 
         Returns the final display loss (or the smoothed loss when
         ``display`` is off).
-        """
+
+        With ``SPARKNET_OBS`` armed the whole run is wrapped in one obs
+        span, stamped with the returned loss (a value materialized from
+        the final program's own output — :meth:`step` fences by value,
+        and the display pass reads ``float(loss_arr)``)."""
+        rec = get_recorder()
+        if not rec:
+            return self._solve_impl(train_fn, test_fns, resume_file,
+                                    callback)
+        with rec.span("solver.solve") as sp:
+            loss = self._solve_impl(train_fn, test_fns, resume_file,
+                                    callback)
+            sp.fence_value(loss)
+        return loss
+
+    def _solve_impl(self, train_fn, test_fns=None, resume_file=None,
+                    callback=None) -> float:
+        """The body of :meth:`solve` (see its docstring)."""
         cfg = self.config
         early_exit = False
         if resume_file:

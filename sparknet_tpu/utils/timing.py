@@ -8,13 +8,18 @@ XLA program, so per-layer numbers here are diagnostic (each layer jitted
 and fenced in isolation) — the fused step is strictly faster; use
 ``jax.profiler`` traces for the true schedule.
 
-LOCAL BACKENDS ONLY: this module times through ``block_until_ready``
-and repeats dispatches with identical args, both of which are
-untimeable over the axon relay (graftlint ``fence-by-value`` /
-``stale-args-dispatch``; suppressed below with this justification).
-Every relay-facing timing path — bench.py, ``tpunet time --fused`` /
-``--trace`` — instead fences on a fetched VALUE with threaded state
-(``common.value_fence``).
+Fencing is contract-clean since the obs PR: :meth:`Timer.stop` closes
+its wall through ``common.value_fence`` — a VALUE fetch of the timed
+program's own output — never through ``block_until_ready`` (readiness
+is not execution on relay backends; ``value_fence`` docstring, round
+5).  To make that fence honest per layer, :func:`time_layers` has each
+jitted program return a scalar checksum with data dependence on every
+output/gradient leaf, and stops the timer on that checksum.
+
+One caveat stands: the per-layer loops repeat dispatches with identical
+arguments, which is untimeable over the axon relay (graftlint
+``stale-args-dispatch``, suppressed below with that justification) —
+relay-facing timing uses bench.py / ``tpunet time --trace`` instead.
 """
 
 from __future__ import annotations
@@ -23,12 +28,21 @@ import time
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 import numpy as np
+
+from sparknet_tpu.common import value_fence
 
 
 class Timer:
-    """start/stop wall timer with a device fence on stop (the cudaEvent
-    synchronize analog)."""
+    """start/stop wall timer whose stop edge is a value fence (the
+    cudaEvent-synchronize analog, minus the readiness trap).
+
+    ``stop(fence=out)`` fetches the VALUE of ``out``'s last pytree leaf
+    via ``common.value_fence`` before reading the clock; arrange for
+    that leaf to be a small scalar computed inside the timed program
+    (a loss, a checksum).  ``stop()`` with no fence is a bare host wall.
+    """
 
     def __init__(self):
         self._t0 = None
@@ -40,10 +54,20 @@ class Timer:
 
     def stop(self, fence: Any = None) -> float:
         if fence is not None:
-            # graftlint: disable-next-line=fence-by-value -- local-backend diagnostic (readiness IS execution without a relay); relay timing uses common.value_fence
-            jax.block_until_ready(fence)
+            value_fence(fence)
         self.elapsed_ms = (time.perf_counter() - self._t0) * 1e3
         return self.elapsed_ms
+
+
+def _checksum(leaves) -> jax.Array:
+    """Scalar with data dependence on every leaf, computed INSIDE the
+    jitted program that produced them — fetching its value is therefore
+    a true execution fence for that program (a derived second dispatch
+    would not be: ``value_fence`` trap 2)."""
+    total = jnp.float32(0)
+    for leaf in leaves:
+        total = total + jnp.sum(leaf).astype(jnp.float32)
+    return total
 
 
 def time_layers(network, variables, feeds, iterations: int = 10) -> list[dict]:
@@ -65,15 +89,15 @@ def time_layers(network, variables, feeds, iterations: int = 10) -> list[dict]:
 
         def fwd(params, state, inputs):
             out = layer.apply(params, state, inputs, train=True, rng=rng)
-            return out.outputs
+            return out.outputs, _checksum(out.outputs)
 
         jfwd = jax.jit(fwd)
-        tops = jfwd(params, state, inputs)  # compile + capture outputs
+        tops, chk = jfwd(params, state, inputs)  # compile + capture outputs
         t = Timer().start()
         for _ in range(iterations):
             # graftlint: disable-next-line=stale-args-dispatch -- per-layer diagnostic on local backends, where repeat dispatches really execute; the honest TPU path is the traced fused step (op_profile)
-            tops = jfwd(params, state, inputs)
-        fwd_ms = t.stop(tops) / iterations
+            tops, chk = jfwd(params, state, inputs)
+        fwd_ms = t.stop(chk) / iterations
 
         bwd_ms = float("nan")
         float_idx = [
@@ -90,14 +114,18 @@ def time_layers(network, variables, feeds, iterations: int = 10) -> list[dict]:
                 out = layer.apply(params, state, full, train=True, rng=rng)
                 return sum(jax.numpy.sum(t) for t in out.outputs)
 
-            jbwd = jax.jit(jax.grad(loss_like, argnums=(0, 1)))
+            def bwd(params, float_ins):
+                g = jax.grad(loss_like, argnums=(0, 1))(params, float_ins)
+                return g, _checksum(jax.tree_util.tree_leaves(g))
+
+            jbwd = jax.jit(bwd)
             try:
-                g = jbwd(params, [inputs[i] for i in float_idx])
+                g, gchk = jbwd(params, [inputs[i] for i in float_idx])
                 t = Timer().start()
                 for _ in range(iterations):
                     # graftlint: disable-next-line=stale-args-dispatch -- same local-backend diagnostic caveat as the forward loop above
-                    g = jbwd(params, [inputs[i] for i in float_idx])
-                bwd_ms = t.stop(g) / iterations
+                    g, gchk = jbwd(params, [inputs[i] for i in float_idx])
+                bwd_ms = t.stop(gchk) / iterations
             except Exception:
                 pass  # non-differentiable layer (Accuracy, ArgMax, ...)
 

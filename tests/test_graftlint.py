@@ -30,6 +30,7 @@ EXPECTED_RULES = {
     "stale-args-dispatch",
     "no-pkill-self",
     "graph-manifest-fresh",
+    "obs-fenced-span",
 }
 
 
@@ -477,6 +478,91 @@ def test_graph_manifest_fresh_ignores_non_contract_files(tmp_path):
     assert not hits(FRESH_SRC, "graph-manifest-fresh", path=str(other))
     # and plain fixture paths (no sparknet_tpu/ segment) never fire
     assert not hits(FRESH_SRC, "graph-manifest-fresh")
+
+
+# -- obs-fenced-span --------------------------------------------------------
+
+SPAN_BAD = """
+import jax
+
+def timed(rec, step, feeds):
+    with rec.span("train") as sp:
+        out = step(feeds)
+    return out
+"""
+
+SPAN_BAD_NO_AS = """
+import jax
+
+def timed(rec, step, feeds):
+    with rec.span("train"):
+        out = step(feeds)
+    return out
+"""
+
+SPAN_GOOD_FENCED = """
+import jax
+
+def timed(rec, step, feeds):
+    with rec.span("train") as sp:
+        out = step(feeds)
+        sp.fence(out)
+    return out
+"""
+
+SPAN_GOOD_FENCE_VALUE = """
+import jax
+
+def timed(rec, solver, fn):
+    with rec.span("solve") as sp:
+        loss = solver.solve(fn)
+        sp.fence_value(loss)
+    return loss
+"""
+
+SPAN_GOOD_HOST = """
+import jax
+
+def staged(rec, paths):
+    with rec.span("stage-db", host=True):
+        return [open(p).read() for p in paths]
+"""
+
+
+def test_obs_fenced_span_positive():
+    found = hits(SPAN_BAD, "obs-fenced-span")
+    assert len(found) == 1
+    assert "fence stamp" in found[0].message
+
+
+def test_obs_fenced_span_positive_without_as_binding():
+    found = hits(SPAN_BAD_NO_AS, "obs-fenced-span")
+    assert len(found) == 1
+    assert "`as` binding" in found[0].message
+
+
+def test_obs_fenced_span_suppressed():
+    src = SPAN_BAD.replace(
+        '    with rec.span("train") as sp:',
+        '    with rec.span("train") as sp:  '
+        "# graftlint: disable=obs-fenced-span -- fenced by the helper")
+    assert not hits(src, "obs-fenced-span")
+    assert suppressed_hits(src, "obs-fenced-span")
+
+
+def test_obs_fenced_span_clean_when_fenced():
+    assert not hits(SPAN_GOOD_FENCED, "obs-fenced-span")
+    assert not hits(SPAN_GOOD_FENCE_VALUE, "obs-fenced-span")
+
+
+def test_obs_fenced_span_clean_when_host():
+    assert not hits(SPAN_GOOD_HOST, "obs-fenced-span")
+
+
+def test_obs_fenced_span_ignores_non_jax_modules():
+    # a host-side tool's span times host work by construction
+    assert not hits(SPAN_BAD.replace("import jax", "import os"),
+                    "obs-fenced-span")
 
 
 # -- suppression machinery --------------------------------------------------

@@ -307,6 +307,24 @@ def test_setup_jobs_run_before_any_dial(runner, tmp_path, monkeypatch):
     assert last_setup < dials[0]
 
 
+def test_runner_journal_lines_are_schema_valid(runner, tmp_path,
+                                               monkeypatch):
+    """Every line the runner writes must satisfy the shared journal
+    schema (sparknet_tpu/obs/schema.py) with ZERO allowlist help — the
+    legacy allowlist is for pre-schema rounds, not for new writes."""
+    from sparknet_tpu.obs import schema
+
+    monkeypatch.setattr(runner, "dial", lambda probe_id=0: True)
+    q = _queue(tmp_path, [ok_job("a"), fail_job("b"), ok_job("c")],
+               max_attempts=1)
+    monkeypatch.setattr(sys, "argv", ["runner", q])
+    runner.main()
+    n, allowlisted, errors = schema.validate_journal(runner.JOURNAL)
+    assert n > 0
+    assert allowlisted == 0
+    assert not errors, "\n".join(errors)
+
+
 def test_rc4_backend_unreachable_is_window_death_not_failure(
         runner, tmp_path, monkeypatch):
     """bench.py exits 4 when its own probe says the backend is gone

@@ -59,6 +59,14 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # standalone invocation: tools/ is not a package
+    sys.path.insert(0, REPO)
+
+# the journal-line contract (stdlib-only; never initializes a backend):
+# every line this runner writes is built through schema.make_event, so
+# the ledger and its readers (tunnel_log, the obs report, the judge's
+# validator) can never drift apart again
+from sparknet_tpu.obs import schema  # noqa: E402
 # Overridden from the queue spec's "evidence_dir" in main().  The module
 # default stays evidence_r3 for backward compatibility: the r3 queue file
 # predates the key, and changing its journal location would break resume
@@ -77,7 +85,17 @@ MIN_DIAL_PERIOD_S = 120.0
 
 def log(event: dict) -> None:
     event = dict(event)
-    event["utc"] = time.strftime("%Y-%m-%d %H:%M:%SZ", time.gmtime())
+    try:
+        event = schema.make_event(
+            event["event"],
+            **{k: v for k, v in event.items() if k != "event"})
+    except (ValueError, KeyError) as e:
+        # journal it anyway — the journal is the round's record and must
+        # not lose evidence to a schema bug mid-window; the validator
+        # (`python -m sparknet_tpu.obs validate`) will flag the line
+        print(f"runner: journal line violates obs schema: {e}",
+              file=sys.stderr)
+        event.setdefault("utc", schema.utc_now())
     os.makedirs(EVIDENCE_DIR, exist_ok=True)
     with open(JOURNAL, "a") as f:
         f.write(json.dumps(event) + "\n")

@@ -11,24 +11,21 @@ Run:  python tools/tunnel_log.py [--round 4]
 from __future__ import annotations
 
 import argparse
-import json
 import os
+import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # standalone invocation: tools/ is not a package
+    sys.path.insert(0, REPO)
+
+# journal lines are the obs schema's (sparknet_tpu/obs/schema.py) — one
+# shared loader, and `python -m sparknet_tpu.obs validate` for the
+# strict view of the same files
+from sparknet_tpu.obs import schema  # noqa: E402
 
 
 def load(journal: str) -> list[dict]:
-    events = []
-    try:
-        with open(journal) as f:
-            for line in f:
-                try:
-                    events.append(json.loads(line))
-                except ValueError:
-                    continue
-    except OSError:
-        pass
-    return events
+    return schema.load_journal(journal)
 
 
 def render(events: list[dict], round_no: int) -> str:
