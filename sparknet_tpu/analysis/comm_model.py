@@ -98,13 +98,16 @@ def expected_comm(mode: str, *, param_bytes: int,
     sizes.  Raises KeyError for unknown modes — a new parallel mode
     must state its communication contract here before it can bank a
     manifest."""
-    if mode == "solo":
+    if mode in ("solo", "solo_nhwc"):
         return CommExpectation(
             required={},
             forbidden=COLLECTIVE_KINDS,
             note="single chip: any collective is a lowering bug",
         )
-    if mode in ("dp", "dp_bf16", "mobilenet_dp"):
+    # dp_nhwc shares dp's budget exactly: params never reorient under
+    # the nhwc layout (ops/layout.py), so the grad all-reduce moves the
+    # same bytes — a layout that changed this block would be a bug
+    if mode in ("dp", "dp_bf16", "mobilenet_dp", "dp_nhwc"):
         return CommExpectation(
             required={"all-reduce": _window(param_bytes, state_bytes)},
             forbidden=("all-to-all", "collective-permute", "all-gather"),

@@ -10,7 +10,7 @@ DAG validated its own shuffle boundaries).  Everything here is
 chip-free: lowering + CPU compilation only, never an execution, so it
 runs — like the linter — on a box where the TPU relay is wedged.
 
-Four contract families per mode:
+Five contract families per mode:
 
 1. **comm budget** — census every collective in the post-SPMD HLO
    (count, bytes, inside-a-loop-body or not) and assert it against the
@@ -35,6 +35,17 @@ Four contract families per mode:
    slots, center) must be donated or every step holds 2x params+slots
    in HBM; and lowering the step twice (iteration counter bumped) must
    produce byte-identical StableHLO or the step recompiles per call.
+5. **layout census** — a transpose/data-formatting census over both
+   the lowered StableHLO (what OUR frontend emits: rank-4 transposes
+   are image-blob reorientations — data formatting by construction;
+   rank-2 weight transposes from plain matmuls exist in every layout
+   and are not counted against the contract) and the compiled module
+   (what the backend's layout assignment adds).  The nhwc modes
+   (``solo_nhwc``/``dp_nhwc``) pin ZERO interior rank-4 StableHLO
+   transposes — the whole point of the channels-last path is that the
+   orientation rides ``dimension_numbers``, never a transpose op —
+   while the nchw manifests record today's counts as the banked
+   baseline the on-chip A/B (tools/layout_ab.py --framework) prices.
 
 Golden manifests are banked per mode in ``docs/graph_contracts/`` and
 diffed on every run: any change to the lowered communication structure
@@ -72,6 +83,7 @@ __all__ = [
     "collective_census",
     "census_summary",
     "dtype_census",
+    "layout_census",
     "manifest_path",
     "run_graphcheck",
     "sources_fingerprint",
@@ -104,6 +116,10 @@ GRAPH_RULES = {
     "the step holds two copies of params+slots",
     "graph-recompile-hazard": "re-lowering with a bumped iteration "
     "counter changed the StableHLO — the step recompiles every call",
+    "graph-layout-transpose": "an nhwc mode lowered with interior "
+    "rank-4 (image-blob) transposes in its StableHLO — the channels-"
+    "last path exists to carry orientation through dimension_numbers, "
+    "so a data-formatting transpose means a layer fell off it",
     "graph-manifest-missing": "no banked manifest for this mode "
     "(run `python -m sparknet_tpu.analysis graph --update`)",
     "graph-manifest-drift": "lowered contract differs from the banked "
@@ -234,6 +250,50 @@ def census_summary(ops: list[CollectiveOp]) -> dict:
 
 _DOT_CONV_RE = re.compile(
     r"stablehlo\.(dot_general|convolution)\b[^\n]*?:\s*\(([^)]*)\)\s*->")
+
+# `stablehlo.transpose %x, dims = [0, 3, 1, 2] : (tensor<8x32x32x3xf32>) ...`
+_SHLO_TRANSPOSE_RE = re.compile(
+    r"stablehlo\.transpose\b[^\n]*?dims = \[([\d, ]*)\][^\n]*?"
+    r"tensor<([0-9x]+)x(\w+)>")
+# HLO `%name = f32[8,3,32,32]{...} transpose(` / `copy(`
+_HLO_FMT_RE = re.compile(r"=\s+(\w+)\[([\d,]*)\][^\s]*\s+(transpose|copy)\(")
+
+
+def layout_census(stablehlo_text: str, hlo_text: str) -> dict:
+    """Count data-formatting ops per module.
+
+    StableHLO transposes split by rank: rank-4 operands are image-blob
+    reorientations (the data-formatting tax the nhwc layout exists to
+    erase); rank<=2 transposes are matmul weight flips that every
+    layout emits.  The compiled-module counts record what the BACKEND's
+    layout assignment adds on top (CPU here — backend-specific, banked
+    as a drift-pinned baseline, not modeled)."""
+    total = r4 = r4_elems = 0
+    for m in _SHLO_TRANSPOSE_RE.finditer(stablehlo_text):
+        total += 1
+        dims = [d for d in m.group(1).replace(" ", "").split(",") if d]
+        if len(dims) >= 4:
+            r4 += 1
+            n = 1
+            for d in m.group(2).split("x"):
+                n *= int(d)
+            r4_elems += n
+    hlo_t = hlo_t4 = hlo_c = 0
+    for m in _HLO_FMT_RE.finditer(hlo_text):
+        if m.group(3) == "copy":
+            hlo_c += 1
+            continue
+        hlo_t += 1
+        if len([d for d in m.group(2).split(",") if d]) >= 4:
+            hlo_t4 += 1
+    return {
+        "stablehlo_transposes": total,
+        "stablehlo_transposes_4d": r4,
+        "stablehlo_transpose_4d_elems": r4_elems,
+        "hlo_transposes": hlo_t,
+        "hlo_transposes_4d": hlo_t4,
+        "hlo_copies": hlo_c,
+    }
 
 
 def dtype_census(stablehlo_text: str) -> dict:
@@ -468,6 +528,20 @@ def audit_target(target, art: Artifacts,
             })
         dt = {k: v for k, v in dt.items() if k != "f32_ops"}
 
+    # -- 5. layout census --------------------------------------------------
+    lay = layout_census(art.stablehlo, art.hlo)
+    lay["layout"] = target.meta.get("layout", "nchw")
+    if lay["layout"] == "nhwc" and lay["stablehlo_transposes_4d"]:
+        problems.append({
+            "rule": "graph-layout-transpose",
+            "message": f"{lay['stablehlo_transposes_4d']} rank-4 "
+                       f"transpose(s) ({lay['stablehlo_transpose_4d_elems']:,}"
+                       " elements) in the nhwc StableHLO — a layer is "
+                       "reorienting image blobs instead of riding "
+                       "dimension_numbers (the data-formatting tax the "
+                       "channels-last path exists to erase)",
+        })
+
     # -- 4. donation / recompile -------------------------------------------
     undonated_bytes = 0
     undonated_leaves = 0
@@ -501,6 +575,7 @@ def audit_target(target, art: Artifacts,
 
     contract = {
         "comm": comm,
+        "layout": lay,
         "sharding": {
             "params_sharded": art.sharded_params,
             "params_replicated": art.replicated_params,
@@ -579,6 +654,7 @@ def sources_fingerprint(repo: str | None = None) -> dict:
         files += [os.path.join(pdir, f) for f in sorted(os.listdir(pdir))
                   if f.endswith(".py")]
     for rel in ("sparknet_tpu/models/zoo.py",
+                "sparknet_tpu/ops/layout.py",
                 "sparknet_tpu/analysis/graphcheck.py",
                 "sparknet_tpu/analysis/comm_model.py"):
         p = os.path.join(repo, *rel.split("/"))

@@ -166,6 +166,25 @@ def _clean_shape_errors():
         ) from None
 
 
+def _internalize(fn):
+    """Wrap a data fn so canonical-NCHW host batches (cifar readers, DB
+    cursors, listfile sources — every real data plane emits blob order)
+    arrive in the INTERNAL layout (``Config.layout``, ops/layout.py).
+    A passthrough under nchw; preserves an attached ``device_fn``
+    (whose DeviceAugment already speaks the internal layout)."""
+    from sparknet_tpu.ops.layout import feeds_to_internal, is_nhwc
+
+    if fn is None or not is_nhwc():
+        return fn
+
+    def wrapped(it):
+        return feeds_to_internal(fn(it))
+
+    if hasattr(fn, "device_fn"):
+        wrapped.device_fn = fn.device_fn
+    return wrapped
+
+
 def _attach_device_augment(train_fn, cfg, pid, seed=None):
     """Attach the in-XLA transform as the prefetcher's ``device_fn`` —
     one key policy for every source (deterministic per process, like the
@@ -307,7 +326,7 @@ def _data_fns(args, net, test_net=None):
                     inner(it)
                 return inner(it)
 
-        return train_src, eval_src
+        return _internalize(train_src), _internalize(eval_src)
 
     shapes = _feed_shapes(net, args)
     data_shape = shapes["data"]
@@ -359,7 +378,7 @@ def _data_fns(args, net, test_net=None):
                 "label": yte[lo : lo + batch].astype(np.int32),
             }
 
-        return train_fn, test_fn
+        return _internalize(train_fn), _internalize(test_fn)
 
     if args.data.startswith("db:"):
         # DB-backed training — the CifarDBApp/ImageNetRunDBApp flow (ref:
@@ -506,13 +525,17 @@ def _data_fns(args, net, test_net=None):
                 if "checked" not in state:
                     state["checked"] = True
                     got = tuple(b["data"].shape[1:])
-                    want = tuple(data_shape[1:])
+                    # DB records are canonical (C, H, W); compare against
+                    # the canonical view of the net's (internal) blob
+                    from sparknet_tpu.ops.layout import canonical_shape
+
+                    want = tuple(canonical_shape(data_shape)[1:])
                     if not train and test_net is not None:
                         # the test stream feeds the TEST net: check
                         # against ITS declared geometry (its own crop)
                         try:
-                            want = tuple(
-                                _feed_shapes(test_net, args)["data"][1:])
+                            want = tuple(canonical_shape(
+                                _feed_shapes(test_net, args)["data"])[1:])
                         except (KeyError, SystemExit):
                             pass  # fall back to the train net's blob
                     if raw and p["crop"]:
@@ -544,7 +567,8 @@ def _data_fns(args, net, test_net=None):
                 scale=scale, mirror=mirror, crop_size=crop,
                 mean_value=mean_vals, mean_image=mean_img,
             ), pid, seed=getattr(args, "seed", None))
-        return train_fn, db_stream(test_path, train=False)
+        return (_internalize(train_fn),
+                _internalize(db_stream(test_path, train=False)))
 
     if args.data == "synthetic":
         rs = np.random.RandomState(pid)
@@ -1638,6 +1662,13 @@ def main(argv=None) -> int:
                         help="compute dtype for the step (bf16 = mixed "
                         "precision: bf16 activations/matmuls, f32 params "
                         "and BN statistics; default f32)")
+        sp.add_argument("--layout", default="",
+                        choices=["", "nchw", "nhwc"],
+                        help="internal rank-4 activation layout (default "
+                        "nchw — Caffe blob order; nhwc runs the step "
+                        "channels-last, the MXU-preferred orientation — "
+                        "weights/checkpoints stay wire-order either way; "
+                        "SPARKNET_LAYOUT seeds the default)")
 
     sp = sub.add_parser("train", help="train a model")
     common(sp)
@@ -1854,6 +1885,7 @@ def main(argv=None) -> int:
         from sparknet_tpu.common import force_platform
 
         force_platform(args.platform)
+    overrides = {}
     if getattr(args, "dtype", ""):
         # one application point for every brew that takes --dtype
         # (train/test/time/bench): the global compute dtype must be set
@@ -1863,15 +1895,22 @@ def main(argv=None) -> int:
         # into the caller's global config)
         import jax.numpy as jnp
 
+        overrides["compute_dtype"] = (
+            jnp.bfloat16 if args.dtype in ("bf16", "bfloat16")
+            else jnp.float32)
+    if getattr(args, "layout", ""):
+        # same discipline for the internal layout knob (ops/layout.py):
+        # trace-time config, scoped to this brew
+        overrides["layout"] = args.layout
+    if overrides:
         from sparknet_tpu.common import get_config, set_config
 
-        prev_dtype = get_config().compute_dtype
-        set_config(compute_dtype=jnp.bfloat16
-                   if args.dtype in ("bf16", "bfloat16") else jnp.float32)
+        prev = {k: getattr(get_config(), k) for k in overrides}
+        set_config(**overrides)
         try:
             return args.fn(args)
         finally:
-            set_config(compute_dtype=prev_dtype)
+            set_config(**prev)
     return args.fn(args)
 
 

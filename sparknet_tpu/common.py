@@ -39,11 +39,25 @@ class Config:
     optimizer state stay in ``param_dtype`` (f32) — the mixed-precision
     scheme XLA fuses casts for.  Tests run f32/f32 on CPU for exact
     numerical gradient checks.
+
+    ``layout`` is the INTERNAL orientation of rank-4 image blobs inside
+    jitted programs: ``"nchw"`` (default — Caffe blob order, SURVEY §2.2)
+    or ``"nhwc"`` (channels-last, the MXU's preferred orientation; image
+    bytes arrive HWC off the wire so the feed link ships its natural
+    order with zero entry transpose).  Param blobs are layout-INVARIANT:
+    conv weights stay OIHW and fc weights stay (num_output, C·H·W) wire
+    order in both layouts, so checkpoints/sharding/PTQ never convert —
+    only activations and feed shapes move (``ops/layout.py``).  Like
+    every Config field this is read at TRACE time; the ``SPARKNET_LAYOUT``
+    env var seeds the default, ``tpunet --layout`` / ``set_config`` flip
+    it per run.  NCHW remains the default until the on-chip A/B clears
+    the repo's >5% promote rule (docs/BENCHMARKS.md "Layout").
     """
 
     seed: int = 1  # ref: common.cpp set_random_seed
     compute_dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
+    layout: str = os.environ.get("SPARKNET_LAYOUT", "nchw").lower()
     # Default mesh axis names: data parallelism over 'data', within-layer
     # (tensor) sharding over 'model', sequence/context parallelism over
     # 'seq' (ring / Ulysses attention).
@@ -86,6 +100,12 @@ def set_config(**overrides) -> Config:
     retrace on later ``set_config`` — set ``compute_dtype`` etc. before
     constructing/stepping a Solver, not between steps."""
     global _config
+    if "layout" in overrides:
+        lay = str(overrides["layout"]).lower()
+        if lay not in ("nchw", "nhwc"):
+            raise ValueError(f"layout must be 'nchw' or 'nhwc', got "
+                             f"{overrides['layout']!r}")
+        overrides = {**overrides, "layout": lay}
     with _lock:
         _config = dataclasses.replace(_config, **overrides)
     return _config

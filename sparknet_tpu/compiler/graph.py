@@ -174,15 +174,21 @@ class Network:
     # -- legacy net-level inputs (ref: net.cpp AppendTop "deprecated 4D input
     # dimensions" / input_shape) ------------------------------------------
     def _net_level_inputs(self) -> list[tuple[str, tuple[int, ...] | None]]:
+        # declared dims are canonical Caffe blob order; the feed contract
+        # is the INTERNAL orientation (Config.layout, ops/layout.py)
+        from sparknet_tpu.ops.layout import internal_shape
+
         names = [str(s) for s in self.net_param.get_all("input")]
         shapes: list[tuple[int, ...] | None] = []
         shape_msgs = self.net_param.get_all("input_shape")
         dims_flat = [int(d) for d in self.net_param.get_all("input_dim")]
         for i, _ in enumerate(names):
             if i < len(shape_msgs):
-                shapes.append(tuple(int(d) for d in shape_msgs[i].get_all("dim")))
+                shapes.append(internal_shape(
+                    tuple(int(d) for d in shape_msgs[i].get_all("dim"))))
             elif dims_flat:
-                shapes.append(tuple(dims_flat[4 * i : 4 * i + 4]))
+                shapes.append(internal_shape(
+                    tuple(dims_flat[4 * i : 4 * i + 4])))
             else:
                 shapes.append(None)
         return list(zip(names, shapes))

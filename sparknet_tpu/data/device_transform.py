@@ -25,8 +25,17 @@ from sparknet_tpu.data.transform import TransformConfig
 
 
 class DeviceAugment:
-    """jit-compatible batch transform: (N, C, H, W) uint8/float device
-    array + PRNG key → (N, C, crop, crop) float32.
+    """jit-compatible batch transform: uint8/float device array + PRNG
+    key → float32 crops, in the INTERNAL layout (``Config.layout``,
+    ``ops/layout.py``): (N, C, H, W) → (N, C, crop, crop) under nchw,
+    (N, H, W, C) → (N, crop, crop, C) under nhwc.
+
+    The nhwc path is where the data-formatting story closes end to end:
+    image bytes arrive HWC off the wire (JPEG decoders, the record DB,
+    ``data/minibatch.py``'s packers all see HWC first), so shipping
+    (N, H, W, C) uint8 is the feed link's NATURAL orientation — zero
+    entry transpose on either side of the link, and the augment fuses
+    into a step whose convs already run channels-last.
 
     Use inside a jitted step, or as the ``device_fn`` of a
     :class:`~sparknet_tpu.data.prefetch.DevicePrefetcher` (the worker
@@ -35,7 +44,9 @@ class DeviceAugment:
     the fat transfer).
     """
 
-    def __init__(self, config: TransformConfig):
+    def __init__(self, config: TransformConfig, layout: str | None = None):
+        from sparknet_tpu.ops.layout import active_layout, normalize
+
         if config.mean_image is not None and config.mean_value:
             raise ValueError("specify mean_image or mean_value, not both")
         if config.backend != "numpy":
@@ -44,21 +55,27 @@ class DeviceAugment:
                 "backend='numpy' (the default) and wrap it here"
             )
         self.config = config
-        self._mean = (
-            jnp.asarray(config.mean_image, jnp.float32)
-            if config.mean_image is not None
-            else None
-        )
+        self.layout = normalize(layout) if layout else active_layout()
+        mean = config.mean_image
+        if mean is not None:
+            mean = jnp.asarray(mean, jnp.float32)  # canonical (C, H, W)
+            if self.layout == "nhwc":
+                mean = mean.transpose(1, 2, 0)  # once, at construction
+        self._mean = mean
 
     def __call__(self, images, key, train: bool = True):
         cfg = self.config
+        nhwc = self.layout == "nhwc"
         x = jnp.asarray(images).astype(jnp.float32)
-        n, ch, h, w = x.shape
+        if nhwc:
+            n, h, w, ch = x.shape
+        else:
+            n, ch, h, w = x.shape
         if self._mean is not None:
             x = x - self._mean[None]
         elif cfg.mean_value:
             mv = jnp.asarray(cfg.mean_value, jnp.float32)
-            x = x - mv.reshape(1, -1, 1, 1)
+            x = x - mv.reshape((1, 1, 1, -1) if nhwc else (1, -1, 1, 1))
         k_h, k_w, k_flip = jax.random.split(key, 3)
         c = cfg.crop_size
         if c:
@@ -71,13 +88,18 @@ class DeviceAugment:
                 hos = jnp.full((n,), (h - c) // 2)
                 wos = jnp.full((n,), (w - c) // 2)
 
-            def one(img, ho, wo):
-                return jax.lax.dynamic_slice(img, (0, ho, wo), (ch, c, c))
+            if nhwc:
+                def one(img, ho, wo):
+                    return jax.lax.dynamic_slice(img, (ho, wo, 0), (c, c, ch))
+            else:
+                def one(img, ho, wo):
+                    return jax.lax.dynamic_slice(img, (0, ho, wo), (ch, c, c))
 
             x = jax.vmap(one)(x, hos, wos)
         if train and cfg.mirror:
             flip = jax.random.bernoulli(k_flip, 0.5, (n,))
-            x = jnp.where(flip[:, None, None, None], x[:, :, :, ::-1], x)
+            mirrored = x[:, :, ::-1, :] if nhwc else x[:, :, :, ::-1]
+            x = jnp.where(flip[:, None, None, None], mirrored, x)
         if cfg.scale != 1.0:
             x = x * cfg.scale
         return x

@@ -11,13 +11,20 @@ import jax.numpy as jnp
 import numpy as np
 
 from sparknet_tpu.common import get_config
-from sparknet_tpu.ops import fillers
+from sparknet_tpu.ops import fillers, layout
 from sparknet_tpu.ops.base import Layer, LayerOutput
 from sparknet_tpu.ops.registry import register
 
 
 def _canon_axis(axis: int, ndim: int) -> int:
     return axis + ndim if axis < 0 else axis
+
+
+def _canon_shape(shape) -> tuple:
+    """The canonical (NCHW blob-order) view of an internal shape — layer
+    parameters (axis, num_axes, blob dims) always speak canonical
+    coordinates regardless of ``Config.layout`` (ops/layout.py)."""
+    return layout.canonical_shape(shape)
 
 
 @register
@@ -40,8 +47,11 @@ class InnerProduct(Layer):
 
     def init(self, key, in_shapes):
         n_out, axis, bias, wf, bf = self._conf()
-        axis = _canon_axis(axis, len(in_shapes[0]))
-        dim = int(np.prod(in_shapes[0][axis:]))
+        # the weight's column order is the CANONICAL flatten (C*H*W for a
+        # 4D bottom) in every layout — that is the .caffemodel contract
+        cshape = _canon_shape(in_shapes[0])
+        axis = _canon_axis(axis, len(cshape))
+        dim = int(np.prod(cshape[axis:]))
         kw, kb = jax.random.split(key)
         dtype = get_config().param_dtype
         params = [fillers.fill(wf, kw, (n_out, dim), dtype)]
@@ -53,6 +63,8 @@ class InnerProduct(Layer):
         n_out, axis, bias, _, _ = self._conf()
         x = inputs[0]
         axis = _canon_axis(axis, x.ndim)
+        if x.ndim == 4 and layout.is_nhwc():
+            return self._apply_nhwc(params, x, n_out, axis, bias, train)
         lead = x.shape[:axis]
         flat = x.reshape((-1, int(np.prod(x.shape[axis:]))))
         if not train:
@@ -72,6 +84,49 @@ class InnerProduct(Layer):
             y = y + params[1].astype(x.dtype)
         return LayerOutput([y.reshape(lead + (n_out,))])
 
+    def _apply_nhwc(self, params, x, n_out, axis, bias, train):
+        """4D bottom under channels-last: the conv→fc boundary.
+
+        The weight stays (num_output, C·H·W) wire order; reshaped OIHW
+        (free) it IS the kernel of a full-map VALID convolution — the
+        classic fc-as-conv identity, so the contraction is element-exact
+        with the NCHW ``flat @ W.T`` path from the SAME bytes, and both
+        forward and backward lower through ``dimension_numbers`` alone:
+        zero layout transposes at the one place a naive NHWC flatten
+        would need one (the layout census pins this —
+        ``python -m sparknet_tpu.analysis graph``, family ``layout``).
+        Non-channel flatten axes fall back to a canonicalizing
+        transpose (no zoo model takes that path)."""
+        n, h, w, c = x.shape
+        if axis != 1:
+            xc = x.transpose(0, 3, 1, 2)
+            lead = xc.shape[:axis]
+            flat = xc.reshape((-1, int(np.prod(xc.shape[axis:]))))
+            y = flat @ params[0].astype(x.dtype).T
+            if bias:
+                y = y + params[1].astype(x.dtype)
+            return LayerOutput([y.reshape(lead + (n_out,))])
+        if not train:
+            from sparknet_tpu.quant import int8_matmul, layer_qparams
+
+            q = layer_qparams(self.name)
+            if q is not None:
+                # inference-only: canonicalize so the int8 weight's
+                # column order lines up (one transpose, deploy path)
+                flat = x.transpose(0, 3, 1, 2).reshape(n, -1)
+                y = int8_matmul(flat, q)
+                if bias:
+                    y = y + params[1].astype(y.dtype)
+                return LayerOutput([y.astype(x.dtype)])
+        w4 = params[0].astype(x.dtype).reshape(n_out, c, h, w)
+        y = jax.lax.conv_general_dilated(
+            x, w4, window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NHWC", "OIHW", "NHWC"),
+        ).reshape(n, n_out)
+        if bias:
+            y = y + params[1].astype(x.dtype)
+        return LayerOutput([y])
+
 
 @register
 class BatchNorm(Layer):
@@ -83,7 +138,11 @@ class BatchNorm(Layer):
     TYPE = "BatchNorm"
 
     def init(self, key, in_shapes):
-        ch = in_shapes[0][1] if len(in_shapes[0]) > 1 else 1
+        shape = in_shapes[0]
+        if len(shape) > 1:
+            ch = shape[layout.channel_axis(ndim=len(shape))]
+        else:
+            ch = 1
         dtype = get_config().param_dtype
         state = {
             "mean": jnp.zeros((ch,), dtype),
@@ -104,7 +163,10 @@ class BatchNorm(Layer):
         # Normalization-layer stats in f32 is the standard mixed-precision
         # contract; only the normalized output returns in x's dtype.
         xf = x.astype(jnp.float32)
-        axes = (0,) + tuple(range(2, x.ndim))
+        if x.ndim == 4 and layout.is_nhwc():
+            axes = (0, 1, 2)  # all but the trailing channel axis
+        else:
+            axes = (0,) + tuple(range(2, x.ndim))
         if use_global:
             scale = jnp.where(state["scale_factor"][0] == 0, 1.0, 1.0 / jnp.maximum(state["scale_factor"][0], 1e-30))
             mean = state["mean"].astype(jnp.float32) * scale
@@ -122,7 +184,7 @@ class BatchNorm(Layer):
                 "variance": state["variance"] * frac + var.astype(state["variance"].dtype),
                 "scale_factor": state["scale_factor"] * frac + 1.0,
             }
-        shape = (1, -1) + (1,) * (x.ndim - 2)
+        shape = layout.channel_bshape(x.ndim)
         # same clamp on the use site: global stats restored from a
         # checkpoint may carry the unclamped accumulation
         denom = jnp.sqrt(
@@ -131,22 +193,41 @@ class BatchNorm(Layer):
         return LayerOutput([y], new_state)
 
 
+def _broadcast_canon(vec, x, axis):
+    """Broadcast a canonical-ordered blob ``vec`` against internal ``x``
+    from canonical ``axis`` (Scale/Bias semantics).  Under nchw this is
+    the plain reshape; under nhwc on a 4D blob the broadcast shape is
+    permuted (and the tiny param transposed when it spans more than one
+    non-unit canonical axis) so the SAME blob bytes scale the same
+    logical elements in either layout."""
+    cb = (1,) * axis + tuple(vec.shape) + (1,) * (x.ndim - axis - vec.ndim)
+    v = vec.astype(x.dtype).reshape(cb)
+    if x.ndim == 4 and layout.is_nhwc():
+        if sum(int(d) > 1 for d in cb[1:]) > 1:
+            v = v.transpose(0, 2, 3, 1)
+        else:
+            v = v.reshape((cb[0], cb[2], cb[3], cb[1]))
+    return v
+
+
 @register
 class Scale(Layer):
     """Channel-wise scale (+ optional bias); companion of BatchNorm in
-    later zoo prototxts.  axis/num_axes control the broadcast shape."""
+    later zoo prototxts.  axis/num_axes control the broadcast shape
+    (canonical blob coordinates in every layout)."""
 
     TYPE = "Scale"
 
     def _shape(self, in_shapes):
         p = self.lp.get_msg("scale_param")
-        axis = _canon_axis(p.get_int("axis", 1), len(in_shapes[0]))
+        shape0 = _canon_shape(in_shapes[0])
+        axis = _canon_axis(p.get_int("axis", 1), len(shape0))
         num_axes = p.get_int("num_axes", 1)
         if len(in_shapes) > 1:
             return None, axis  # scale comes from second bottom
         if num_axes == -1:
-            return tuple(in_shapes[0][axis:]), axis
-        return tuple(in_shapes[0][axis : axis + num_axes]), axis
+            return tuple(shape0[axis:]), axis
+        return tuple(shape0[axis : axis + num_axes]), axis
 
     def init(self, key, in_shapes):
         p = self.lp.get_msg("scale_param")
@@ -176,10 +257,9 @@ class Scale(Layer):
             scale, bias = inputs[1], (params[0] if params else None)
         else:
             scale, bias = params[0], (params[1] if len(params) > 1 else None)
-        bshape = (1,) * axis + tuple(scale.shape) + (1,) * (x.ndim - axis - scale.ndim)
-        y = x * scale.astype(x.dtype).reshape(bshape)
+        y = x * _broadcast_canon(scale, x, axis)
         if bias is not None:
-            y = y + bias.astype(x.dtype).reshape(bshape)
+            y = y + _broadcast_canon(bias, x, axis)
         return LayerOutput([y])
 
 
@@ -193,9 +273,10 @@ class Bias(Layer):
         if len(in_shapes) > 1:
             return [], {}
         p = self.lp.get_msg("bias_param")
-        axis = _canon_axis(p.get_int("axis", 1), len(in_shapes[0]))
+        shape0 = _canon_shape(in_shapes[0])
+        axis = _canon_axis(p.get_int("axis", 1), len(shape0))
         num_axes = p.get_int("num_axes", 1)
-        shape = tuple(in_shapes[0][axis:]) if num_axes == -1 else tuple(in_shapes[0][axis : axis + num_axes])
+        shape = tuple(shape0[axis:]) if num_axes == -1 else tuple(shape0[axis : axis + num_axes])
         return [fillers.fill(p.get_msg("filler"), key, shape, get_config().param_dtype)], {}
 
     def apply(self, params, state, inputs, *, train, rng=None):
@@ -203,8 +284,7 @@ class Bias(Layer):
         p = self.lp.get_msg("bias_param")
         axis = _canon_axis(p.get_int("axis", 1), x.ndim)
         b = inputs[1] if len(inputs) > 1 else params[0]
-        bshape = (1,) * axis + tuple(b.shape) + (1,) * (x.ndim - axis - b.ndim)
-        return LayerOutput([x + b.astype(x.dtype).reshape(bshape)])
+        return LayerOutput([x + _broadcast_canon(b, x, axis)])
 
 
 @register
@@ -270,7 +350,9 @@ class Concat(Layer):
     def apply(self, params, state, inputs, *, train, rng=None):
         p = self.lp.get_msg("concat_param")
         axis = p.get_int("axis", p.get_int("concat_dim", 1))
-        return LayerOutput([jnp.concatenate(inputs, axis=_canon_axis(axis, inputs[0].ndim))])
+        axis = layout.internal_axis(
+            _canon_axis(axis, inputs[0].ndim), inputs[0].ndim)
+        return LayerOutput([jnp.concatenate(inputs, axis=axis)])
 
 
 @register
@@ -282,6 +364,7 @@ class Slice(Layer):
     def apply(self, params, state, inputs, *, train, rng=None):
         p = self.lp.get_msg("slice_param")
         axis = _canon_axis(p.get_int("axis", p.get_int("slice_dim", 1)), inputs[0].ndim)
+        axis = layout.internal_axis(axis, inputs[0].ndim)
         points = [int(s) for s in p.get_all("slice_point")]
         x = inputs[0]
         n_tops = len(self.tops)
@@ -313,6 +396,19 @@ class Flatten(Layer):
         x = inputs[0]
         axis = _canon_axis(p.get_int("axis", 1), x.ndim)
         end = _canon_axis(p.get_int("end_axis", -1), x.ndim)
+        if x.ndim == 4 and layout.is_nhwc() and end > axis:
+            # the flattened blob's element order is canonical C-major
+            # (what downstream fc weights index); a global-pooled head
+            # (H == W == 1, the zoo's only nhwc flatten) keeps that
+            # order for free, anything else pays one canonicalizing
+            # transpose
+            if not (x.shape[1] == 1 and x.shape[2] == 1):
+                x = x.transpose(0, 3, 1, 2)
+            else:
+                x = x.reshape(x.shape[0], x.shape[3], 1, 1)
+            mid = int(np.prod(x.shape[axis : end + 1]))
+            return LayerOutput(
+                [x.reshape(x.shape[:axis] + (mid,) + x.shape[end + 1 :])])
         mid = int(np.prod(x.shape[axis : end + 1]))
         return LayerOutput([x.reshape(x.shape[:axis] + (mid,) + x.shape[end + 1 :])])
 
@@ -328,6 +424,11 @@ class Reshape(Layer):
         shape_msg = p.get_msg("shape")
         dims = [int(d) for d in shape_msg.get_all("dim")]
         x = inputs[0]
+        nhwc4 = x.ndim == 4 and layout.is_nhwc()
+        if nhwc4:
+            # reshape dims speak canonical blob order: canonicalize in,
+            # re-orient a still-4D result back to internal below
+            x = x.transpose(0, 3, 1, 2)
         axis = _canon_axis(p.get_int("axis", 0), x.ndim)
         num_axes = p.get_int("num_axes", -1)
         end = x.ndim if num_axes == -1 else axis + num_axes
@@ -342,7 +443,10 @@ class Reshape(Layer):
             known = int(np.prod([d for d in out_mid if d != -1]))
             total = int(np.prod(mid_in)) if mid_in else 1
             out_mid[out_mid.index(-1)] = total // max(known, 1)
-        return LayerOutput([x.reshape(head + tuple(out_mid) + tail)])
+        y = x.reshape(head + tuple(out_mid) + tail)
+        if nhwc4 and y.ndim == 4:
+            y = y.transpose(0, 2, 3, 1)
+        return LayerOutput([y])
 
 
 @register
@@ -354,7 +458,8 @@ class Tile(Layer):
     def apply(self, params, state, inputs, *, train, rng=None):
         p = self.lp.get_msg("tile_param")
         x = inputs[0]
-        axis = _canon_axis(p.get_int("axis", 1), x.ndim)
+        axis = layout.internal_axis(
+            _canon_axis(p.get_int("axis", 1), x.ndim), x.ndim)
         tiles = p.get_int("tiles")
         reps = [1] * x.ndim
         reps[axis] = tiles
@@ -373,6 +478,9 @@ class ArgMax(Layer):
         top_k = p.get_int("top_k", 1)
         out_max_val = p.get_bool("out_max_val", False)
         x = inputs[0]
+        if x.ndim == 4 and layout.is_nhwc():
+            # returned INDICES address the canonical C*H*W flatten
+            x = x.transpose(0, 3, 1, 2)
         flat = x.reshape(x.shape[0], -1)
         vals, idxs = jax.lax.top_k(flat, top_k)
         idxs = idxs.astype(x.dtype)
@@ -404,6 +512,10 @@ class Reduction(Layer):
         op = p.get_str("operation", "SUM")
         coeff = p.get_float("coeff", 1.0)
         x = inputs[0]
+        if x.ndim == 4 and layout.is_nhwc():
+            # tail-flatten semantics are canonical; the reductions are
+            # permutation-invariant but the kept head axes are not
+            x = x.transpose(0, 3, 1, 2)
         axis = _canon_axis(p.get_int("axis", 0), x.ndim)
         flat = x.reshape(x.shape[:axis] + (-1,)) if axis < x.ndim else x[..., None]
         if op == "ASUM":
@@ -429,7 +541,10 @@ class MVN(Layer):
         norm_var = p.get_bool("normalize_variance", True)
         eps = p.get_float("eps", 1e-9)
         x = inputs[0]
-        axes = tuple(range(1, x.ndim)) if across else tuple(range(2, x.ndim))
+        if x.ndim == 4 and layout.is_nhwc() and not across:
+            axes: tuple = layout.spatial_axes()  # per-channel moments
+        else:
+            axes = tuple(range(1, x.ndim)) if across else tuple(range(2, x.ndim))
         mean = jnp.mean(x, axis=axes, keepdims=True)
         y = x - mean
         if norm_var:

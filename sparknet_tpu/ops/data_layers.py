@@ -14,12 +14,20 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from sparknet_tpu.ops import layout
 from sparknet_tpu.ops.base import Layer, LayerOutput
 from sparknet_tpu.ops.registry import register
 
 
 class InputLayer(Layer):
-    """Base for all source layers: tops are fed externally."""
+    """Base for all source layers: tops are fed externally.
+
+    Declared shapes speak canonical Caffe blob order — 4D always means
+    (N, C, H, W) in a prototxt — and ``blob_shapes`` reports the
+    INTERNAL orientation (``ops/layout.py``): under ``layout="nhwc"``
+    a declared (N, C, H, W) becomes a fed (N, H, W, C), which is the
+    natural HWC order image bytes arrive in off the wire — the nhwc
+    feed link ships with zero entry transpose."""
 
     IS_INPUT = True
 
@@ -65,7 +73,7 @@ class Data(InputLayer):
         if not n:
             return None
         chw = _transform_shape(self.lp, tuple(chw))
-        return [(n, *chw)] + [(n,)] * (len(self.tops) - 1)
+        return [layout.internal_shape((n, *chw))] + [(n,)] * (len(self.tops) - 1)
 
     def blob_shapes(self, batch_override=None):
         import os
@@ -104,7 +112,7 @@ class JavaData(InputLayer):
             dims = tuple(int(d) for d in s.get_all("dim"))
             if batch_override and dims:
                 dims = (batch_override,) + dims[1:]
-            shapes.append(dims)
+            shapes.append(layout.internal_shape(dims))
         return shapes or None
 
 
@@ -121,7 +129,7 @@ class MemoryData(InputLayer):
         p = self.lp.get_msg("memory_data_param")
         n = batch_override or p.get_int("batch_size")
         c, h, w = p.get_int("channels"), p.get_int("height"), p.get_int("width")
-        return [(n, c, h, w), (n,)]
+        return [layout.internal_shape((n, c, h, w)), (n,)]
 
 
 @register
@@ -151,7 +159,7 @@ class DummyData(InputLayer):
         # replicate last shape to cover all tops
         while len(shapes) < len(self.tops):
             shapes.append(shapes[-1])
-        return shapes
+        return [layout.internal_shape(s) for s in shapes]
 
     def constant_values(self):
         from sparknet_tpu.ops import fillers
@@ -212,7 +220,7 @@ class ImageData(InputLayer):
                 return None
         if not (n and h and w):
             return None
-        return [(n, c, h, w), (n,)]
+        return [layout.internal_shape((n, c, h, w)), (n,)]
 
 
 @register
@@ -262,7 +270,7 @@ class WindowData(InputLayer):
         crop = self.lp.get_msg("transform_param").get_int("crop_size", 0)
         if not (n and crop):
             return None
-        return [(n, 3, crop, crop), (n,)]
+        return [layout.internal_shape((n, 3, crop, crop)), (n,)]
 
 
 @register
@@ -277,7 +285,7 @@ class Input(InputLayer):
             dims = tuple(int(d) for d in s.get_all("dim"))
             if batch_override and dims:
                 dims = (batch_override,) + dims[1:]
-            shapes.append(dims)
+            shapes.append(layout.internal_shape(dims))
         return shapes or None
 
 

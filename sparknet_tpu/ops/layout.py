@@ -1,0 +1,165 @@
+"""Internal tensor-layout polymorphism for rank-4 image blobs.
+
+SparkNet inherits NCHW from Caffe's blob semantics (SURVEY §2.2 — the
+reference never had a choice: cuDNN fixed its layout), but the MXU
+prefers channels-last, and the banked AlexNet f32 trace attributes
+2.0 ms/step (7.5% of a bytes-bound step) to XLA ``data formatting`` —
+the NCHW→MXU-layout moves (docs/BENCHMARKS.md "Where AlexNet's residue
+physically sits").  This module makes the orientation a config-selected
+property (``Config.layout``: ``"nchw"`` default / ``"nhwc"``) instead of
+the hardcoded ``("NCHW", "OIHW", "NCHW")`` constant ``ops/vision.py``
+shipped with.
+
+Design contract (what moves and what must NOT):
+
+* **Activations move.** Rank-4 blobs run (N, H, W, C) internally under
+  nhwc; every other rank is layout-invariant.  Feed shapes follow
+  (``internal_shape``): image bytes arrive HWC off the wire, so the
+  nhwc feed link ships its natural orientation with zero entry
+  transpose.
+* **Params do NOT move.** Conv weights stay OIHW and InnerProduct
+  weights stay (num_output, C·H·W) Caffe wire order in BOTH layouts —
+  ``lax.conv_general_dilated`` takes the orientation through its
+  ``dimension_numbers`` (("NHWC", "OIHW", "NHWC") is a legal spec), and
+  the conv→fc boundary lowers as a full-map VALID convolution under
+  nhwc (the classic fc-as-conv identity), so the SAME weight bytes
+  produce the SAME math in either layout.  Consequences: checkpoints
+  (.caffemodel/HDF5/npz/orbax) are cross-loadable with zero conversion,
+  TP sharding specs (output-channel axis 0) and PTQ weight quantization
+  (channel axis 0) never change, and the NCHW↔NHWC equivalence tests
+  can demand exact loss/grad agreement from identical params.
+* **Axes in prototxt stay canonical.** ``axis: 1`` means channels in
+  every layer parameter regardless of internal layout;
+  ``internal_axis`` maps canonical NCHW axes to their internal
+  positions for rank-4 blobs.
+
+The off-path contract (same discipline as obs): with ``layout="nchw"``
+every helper returns the exact constants the pre-layout code used, so
+the default path lowers to bit-identical StableHLO — pinned by
+``tests/test_layout.py`` and the banked ``docs/graph_contracts/``
+manifests' ``stablehlo_sha256``.
+"""
+
+from __future__ import annotations
+
+from sparknet_tpu.common import get_config
+
+LAYOUTS = ("nchw", "nhwc")
+
+# canonical NCHW axis -> internal axis for rank-4 blobs under nhwc
+_NHWC_OF_CANON = {0: 0, 1: 3, 2: 1, 3: 2}
+
+
+def normalize(layout: str) -> str:
+    lay = str(layout).lower()
+    if lay not in LAYOUTS:
+        raise ValueError(f"unknown layout {layout!r} (nchw|nhwc)")
+    return lay
+
+
+def active_layout() -> str:
+    """The trace-time internal layout (``Config.layout``)."""
+    return normalize(get_config().layout)
+
+
+def is_nhwc(layout: str | None = None) -> bool:
+    return (normalize(layout) if layout else active_layout()) == "nhwc"
+
+
+def conv_dimnums(layout: str | None = None) -> tuple[str, str, str]:
+    """(lhs, rhs, out) dimension numbers for ``lax.conv_general_dilated``.
+    The rhs stays OIHW in both layouts — weights are layout-invariant."""
+    if is_nhwc(layout):
+        return ("NHWC", "OIHW", "NHWC")
+    return ("NCHW", "OIHW", "NCHW")
+
+
+def channel_axis(layout: str | None = None, ndim: int = 4) -> int:
+    """Channel axis of an internal activation (rank-4 only moves)."""
+    if ndim == 4 and is_nhwc(layout):
+        return 3
+    return 1
+
+
+def spatial_axes(layout: str | None = None) -> tuple[int, int]:
+    """(H, W) axes of an internal rank-4 activation."""
+    return (1, 2) if is_nhwc(layout) else (2, 3)
+
+
+def channel_bshape(ndim: int, layout: str | None = None) -> tuple:
+    """Broadcast shape for a per-channel vector (bias, BN stats, scale)."""
+    if ndim == 4 and is_nhwc(layout):
+        return (1, 1, 1, -1)
+    return (1, -1) + (1,) * (ndim - 2)
+
+
+def internal_axis(canon_axis: int, ndim: int,
+                  layout: str | None = None) -> int:
+    """Map a canonical (NCHW blob-order) axis to its internal position.
+    Identity for nchw and for every rank except 4."""
+    if ndim == 4 and is_nhwc(layout):
+        return _NHWC_OF_CANON[canon_axis]
+    return canon_axis
+
+
+def internal_shape(shape, layout: str | None = None) -> tuple:
+    """Map a canonical (N, C, H, W) declared shape to the internal one.
+    Non-rank-4 shapes pass through (only image blobs reorient)."""
+    shape = tuple(shape)
+    if len(shape) == 4 and is_nhwc(layout):
+        n, c, h, w = shape
+        return (n, h, w, c)
+    return shape
+
+
+def canonical_shape(shape, layout: str | None = None) -> tuple:
+    """Inverse of :func:`internal_shape`: the canonical (N, C, H, W)
+    view of an internal shape."""
+    shape = tuple(shape)
+    if len(shape) == 4 and is_nhwc(layout):
+        n, h, w, c = shape
+        return (n, c, h, w)
+    return shape
+
+
+def to_internal(x, layout: str | None = None):
+    """Canonical NCHW array -> internal orientation (host or device)."""
+    if getattr(x, "ndim", 0) == 4 and is_nhwc(layout):
+        return x.transpose(0, 2, 3, 1)
+    return x
+
+
+def from_internal(x, layout: str | None = None):
+    """Internal array -> canonical NCHW orientation."""
+    if getattr(x, "ndim", 0) == 4 and is_nhwc(layout):
+        return x.transpose(0, 3, 1, 2)
+    return x
+
+
+def feeds_to_internal(feeds: dict, layout: str | None = None) -> dict:
+    """Host-side adapter for canonical-NCHW data planes (DB cursors,
+    cifar readers, minibatch packers all emit blob order): transpose
+    rank-4 arrays to the internal layout before the device put.  A
+    no-op dict passthrough under nchw."""
+    if not is_nhwc(layout):
+        return feeds
+    return {k: to_internal(v, "nhwc") for k, v in feeds.items()}
+
+
+def pool_window(kernel: tuple[int, int], stride: tuple[int, int],
+                pad: tuple[int, int, int, int] | None = None,
+                layout: str | None = None):
+    """(window_dims, window_strides, padding) 4-tuples for a spatial
+    ``reduce_window`` in the internal layout.  ``pad`` is
+    (lo_h, hi_h, lo_w, hi_w)."""
+    kh, kw = kernel
+    sh, sw = stride
+    if is_nhwc(layout):
+        dims, strides = (1, kh, kw, 1), (1, sh, sw, 1)
+        padding = None if pad is None else (
+            (0, 0), (pad[0], pad[1]), (pad[2], pad[3]), (0, 0))
+    else:
+        dims, strides = (1, 1, kh, kw), (1, 1, sh, sw)
+        padding = None if pad is None else (
+            (0, 0), (0, 0), (pad[0], pad[1]), (pad[2], pad[3]))
+    return dims, strides, padding

@@ -9,6 +9,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sparknet_tpu.ops import layout
 from sparknet_tpu.ops.base import Layer, LayerOutput
 from sparknet_tpu.ops.registry import register
 
@@ -28,7 +29,10 @@ class Softmax(Layer):
 
     def apply(self, params, state, inputs, *, train, rng=None):
         axis = self.lp.get_msg("softmax_param").get_int("axis", 1)
-        return LayerOutput([_softmax(inputs[0], axis)])
+        x = inputs[0]
+        axis = layout.internal_axis(axis + x.ndim if axis < 0 else axis,
+                                    x.ndim)
+        return LayerOutput([_softmax(x, axis)])
 
 
 class _LossBase(Layer):
@@ -52,6 +56,11 @@ class SoftmaxWithLoss(_LossBase):
     def apply(self, params, state, inputs, *, train, rng=None):
         x, label = inputs[0], inputs[1]
         axis = self.lp.get_msg("softmax_param").get_int("axis", 1)
+        # class axis is canonical (NCHW blob order); on internal nhwc 4D
+        # blobs it sits last, where the label grid (N, H, W) already
+        # matches the moved probability block elementwise
+        axis = layout.internal_axis(axis + x.ndim if axis < 0 else axis,
+                                    x.ndim)
         ignore, normalize = self._loss_param()
         prob = _softmax(x, axis)
         lab = label.astype(jnp.int32)
@@ -206,7 +215,8 @@ class Accuracy(Layer):
         axis = p.get_int("axis", 1)
         ignore = p.get_int("ignore_label") if p.has("ignore_label") else None
         x, label = inputs[0], inputs[1]
-        axis = axis + x.ndim if axis < 0 else axis
+        axis = layout.internal_axis(axis + x.ndim if axis < 0 else axis,
+                                    x.ndim)
         scores = jnp.moveaxis(x, axis, -1)  # (..., classes)
         lab = label.astype(jnp.int32).reshape(scores.shape[:-1])
         gather_lab = jnp.where(lab == ignore, 0, lab) if ignore is not None else lab

@@ -9,7 +9,7 @@ import jax
 import jax.numpy as jnp
 
 from sparknet_tpu.common import get_config
-from sparknet_tpu.ops import fillers
+from sparknet_tpu.ops import fillers, layout
 from sparknet_tpu.ops.base import Layer, LayerOutput
 from sparknet_tpu.ops.registry import register
 
@@ -37,7 +37,8 @@ class PReLU(Layer):
     def init(self, key, in_shapes):
         p = self.lp.get_msg("prelu_param")
         shared = p.get_bool("channel_shared", False)
-        shape = (1,) if shared else (in_shapes[0][1],)
+        ch_ax = layout.channel_axis(ndim=len(in_shapes[0]))
+        shape = (1,) if shared else (in_shapes[0][ch_ax],)
         filler = p.get_msg("filler")
         if not filler.has("type"):
             filler = filler.copy()
@@ -47,7 +48,7 @@ class PReLU(Layer):
     def apply(self, params, state, inputs, *, train, rng=None):
         x = inputs[0]
         a = params[0].astype(x.dtype)
-        a = a.reshape((1, -1) + (1,) * (x.ndim - 2))
+        a = a.reshape(layout.channel_bshape(x.ndim))
         return LayerOutput([jnp.maximum(x, 0) + a * jnp.minimum(x, 0)])
 
 
@@ -100,7 +101,16 @@ class Dropout(Layer):
             return LayerOutput([x])
         assert rng is not None, f"Dropout layer {self.name} needs an rng in train mode"
         keep = 1.0 - ratio
-        mask = jax.random.bernoulli(rng, keep, x.shape)
+        if (x.ndim == 4 and layout.is_nhwc()
+                and (x.shape[1] > 1 or x.shape[2] > 1)):
+            # draw the mask in canonical blob order so the SAME key drops
+            # the SAME logical activations in either layout (the
+            # NCHW↔NHWC equivalence contract); spatial-1x1 blobs share
+            # the flat draw order already and skip the transpose
+            cshape = (x.shape[0], x.shape[3], x.shape[1], x.shape[2])
+            mask = jax.random.bernoulli(rng, keep, cshape).transpose(0, 2, 3, 1)
+        else:
+            mask = jax.random.bernoulli(rng, keep, x.shape)
         return LayerOutput([jnp.where(mask, x / keep, 0).astype(x.dtype)])
 
 

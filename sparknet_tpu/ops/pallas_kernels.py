@@ -79,15 +79,22 @@ def _lrn_pallas(x: jax.Array, size: int, alpha: float, beta: float, k: float,
     return out[:, :, :S].reshape(B, C, H, W)
 
 
-def lrn_across_channels_xla(x, size, alpha, beta, k):
-    """reduce_window fallback (identical math, ref: lrn_layer.cpp)."""
+def lrn_across_channels_xla(x, size, alpha, beta, k, channel_axis=1):
+    """reduce_window fallback (identical math, ref: lrn_layer.cpp).
+    ``channel_axis``: 1 for NCHW blobs (default), 3 for NHWC — where the
+    sliding window sits on the MINOR axis, the orientation the tiler
+    likes natively."""
     sq = x * x
     pad = (size - 1) // 2
+    dims = [1] * x.ndim
+    dims[channel_axis] = size
+    padding = [(0, 0)] * x.ndim
+    padding[channel_axis] = (pad, size - 1 - pad)
     summed = jax.lax.reduce_window(
         sq, 0.0, jax.lax.add,
-        window_dimensions=(1, size, 1, 1),
-        window_strides=(1, 1, 1, 1),
-        padding=((0, 0), (pad, size - 1 - pad), (0, 0), (0, 0)),
+        window_dimensions=tuple(dims),
+        window_strides=(1,) * x.ndim,
+        padding=tuple(padding),
     )
     return x * jnp.power(k + (alpha / size) * summed, -beta)
 
@@ -112,20 +119,28 @@ def _lrn_diff_bwd(size, alpha, beta, k, interpret, x, g):
 _lrn_diff.defvjp(_lrn_diff_fwd, _lrn_diff_bwd)
 
 
-def _windowed_channel_sum(sq, size):
-    """Sum over a symmetric ``size`` window on axis 1 as static shifted
+def _windowed_channel_sum(sq, size, axis=1):
+    """Sum over a symmetric ``size`` window on ``axis`` as static shifted
     adds (size-1 adds of sliced views) — the formulation the pallas
     kernel uses, expressed in HLO so XLA can fuse it with neighbors.
     reduce_window puts the window on a non-minor axis of NCHW, which the
     TPU tiler handles an order of magnitude below the bandwidth bound at
-    AlexNet's norm1 shape (measured: docs/pallas_shootout_r3.json)."""
+    AlexNet's norm1 shape (measured: docs/pallas_shootout_r3.json).
+    ``axis=3`` is the NHWC orientation (window already minor)."""
     pad = (size - 1) // 2
-    C = sq.shape[1]
+    C = sq.shape[axis]
     acc = sq
+    if axis == 1:
+        for off in range(1, min(pad, C - 1) + 1):
+            zeros = jnp.zeros_like(sq[:, :off])
+            acc = acc + jnp.concatenate([sq[:, off:], zeros], axis=1)
+            acc = acc + jnp.concatenate([zeros, sq[:, : C - off]], axis=1)
+        return acc
+    assert axis == sq.ndim - 1, "channel window must sit on axis 1 or last"
     for off in range(1, min(pad, C - 1) + 1):
-        zeros = jnp.zeros_like(sq[:, :off])
-        acc = acc + jnp.concatenate([sq[:, off:], zeros], axis=1)
-        acc = acc + jnp.concatenate([zeros, sq[:, : C - off]], axis=1)
+        zeros = jnp.zeros_like(sq[..., :off])
+        acc = acc + jnp.concatenate([sq[..., off:], zeros], axis=axis)
+        acc = acc + jnp.concatenate([zeros, sq[..., : C - off]], axis=axis)
     return acc
 
 
@@ -142,8 +157,8 @@ def _pow_neg(u, beta):
     return jnp.power(u, -beta)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
-def lrn_across_channels_fused(x, size, alpha, beta, k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
+def lrn_across_channels_fused(x, size, alpha, beta, k, channel_axis=1):
     """LRN with shifted-add window sums, rsqrt-formulated power, and a
     hand-derived VJP (ref: caffe/src/caffe/layers/lrn_layer.cpp:108
     CrossChannelForward_cpu, :180 CrossChannelBackward_cpu — same math,
@@ -154,34 +169,45 @@ def lrn_across_channels_fused(x, size, alpha, beta, k):
     (the window is symmetric, so the adjoint of wsum is wsum itself).
     The VJP recomputes scale from the saved x instead of storing it: the
     step is HBM-bound, so size-1 adds + a rsqrt chain are cheaper than a
-    297 MB residual round-trip at AlexNet's norm1 shape."""
-    scale = k + (alpha / size) * _windowed_channel_sum(x * x, size)
+    297 MB residual round-trip at AlexNet's norm1 shape.
+    ``channel_axis``: 1 (NCHW, default) or last (NHWC)."""
+    scale = k + (alpha / size) * _windowed_channel_sum(x * x, size,
+                                                       channel_axis)
     return x * _pow_neg(scale, beta)
 
 
-def _lrn_fused_fwd(x, size, alpha, beta, k):
-    return lrn_across_channels_fused(x, size, alpha, beta, k), x
+def _lrn_fused_fwd(x, size, alpha, beta, k, channel_axis):
+    return lrn_across_channels_fused(x, size, alpha, beta, k,
+                                     channel_axis), x
 
 
-def _lrn_fused_bwd(size, alpha, beta, k, x, g):
-    scale = k + (alpha / size) * _windowed_channel_sum(x * x, size)
+def _lrn_fused_bwd(size, alpha, beta, k, channel_axis, x, g):
+    scale = k + (alpha / size) * _windowed_channel_sum(x * x, size,
+                                                       channel_axis)
     p = _pow_neg(scale, beta)  # scale^-beta
     # y/scale = x * scale^(-beta-1); windowed sum is its own adjoint
-    w = _windowed_channel_sum(g * x * p / scale, size)
+    w = _windowed_channel_sum(g * x * p / scale, size, channel_axis)
     return (g * p - (2.0 * alpha * beta / size) * x * w,)
 
 
 lrn_across_channels_fused.defvjp(_lrn_fused_fwd, _lrn_fused_bwd)
 
 
-def lrn_across_channels(x, size, alpha, beta, k, force: str | None = None):
+def lrn_across_channels(x, size, alpha, beta, k, force: str | None = None,
+                        channel_axis: int = 1):
     """Cross-channel LRN; ``force`` = 'fused' | 'pallas' | 'interpret' |
     'xla' | None.
 
     None consults ``SPARKNET_LRN_IMPL`` (fused|pallas|xla); the default
     is the XLA formulation — flip the env var (or pass force=...) on TPU
     after a shootout validates the challenger on the target generation
-    (tools/pallas_bench.py).  Differentiable on every path."""
+    (tools/pallas_bench.py).  Differentiable on every path.
+
+    ``channel_axis``: 1 for NCHW blobs (default), 3 for NHWC
+    (``Config.layout = "nhwc"``).  The hand-written pallas kernel is
+    NCHW-tuned (it exists to move the window onto the minor axis, which
+    NHWC already has), so channels-last inputs route pallas/interpret
+    requests to the XLA formulation instead."""
     import os
 
     if size % 2 == 0:
@@ -189,9 +215,11 @@ def lrn_across_channels(x, size, alpha, beta, k, force: str | None = None):
     if force is None:
         force = os.environ.get("SPARKNET_LRN_IMPL", "xla")
     if force == "fused":
-        return lrn_across_channels_fused(x, size, alpha, beta, k)
-    if force == "xla" or not _HAS_PALLAS:
-        return lrn_across_channels_xla(x, size, alpha, beta, k)
+        return lrn_across_channels_fused(x, size, alpha, beta, k,
+                                         channel_axis)
+    if force == "xla" or not _HAS_PALLAS or channel_axis != 1:
+        return lrn_across_channels_xla(x, size, alpha, beta, k,
+                                       channel_axis)
     if force == "interpret":
         return _lrn_diff(x, size, alpha, beta, k, True)
     if force == "pallas" and x.ndim == 4:

@@ -3,8 +3,11 @@
 TPU mapping: where the reference lowers conv via im2col+GEMM or cuDNN
 (ref: caffe/src/caffe/layers/base_conv_layer.cpp, util/im2col.cu), we emit a
 single ``lax.conv_general_dilated`` and let XLA:TPU tile it onto the MXU.
-Blob layout is logical NCHW (OIHW weights) for Caffe weight-format parity;
-XLA chooses physical layouts.
+Blob layout is logical NCHW (OIHW weights) for Caffe weight-format parity
+by default; ``Config.layout = "nhwc"`` flips the internal activation
+orientation to channels-last (``ops/layout.py`` — weights stay OIHW in
+both layouts, the dimension numbers carry the orientation) and XLA
+chooses physical layouts either way.
 """
 
 from __future__ import annotations
@@ -16,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from sparknet_tpu.common import get_config
-from sparknet_tpu.ops import fillers
+from sparknet_tpu.ops import fillers, layout
 from sparknet_tpu.ops.base import (
     Layer,
     LayerOutput,
@@ -26,6 +29,8 @@ from sparknet_tpu.ops.base import (
 )
 from sparknet_tpu.ops.registry import register
 
+# the historical hardcoded orientation; kept for canonical-path callers —
+# layout-polymorphic code asks ops.layout.conv_dimnums() instead
 _DIMNUMS = ("NCHW", "OIHW", "NCHW")
 
 
@@ -58,7 +63,7 @@ class Convolution(Layer):
 
     def init(self, key, in_shapes):
         c = self._conf()
-        n, ch = in_shapes[0][0], in_shapes[0][1]
+        ch = in_shapes[0][layout.channel_axis(ndim=len(in_shapes[0]))]
         assert ch % c["group"] == 0, f"{self.name}: channels {ch} % group {c['group']}"
         wshape = (c["num_output"], ch // c["group"], *c["kernel"])
         kw, kb = jax.random.split(key)
@@ -72,6 +77,8 @@ class Convolution(Layer):
         c = self._conf()
         x = inputs[0]
         d = c["dilation"]
+        nhwc = layout.is_nhwc()
+        dn = layout.conv_dimnums()
         if not train:
             # int8 deploy path (sparknet_tpu.quant): active only inside a
             # quantized_inference() trace and only for calibrated layers
@@ -85,11 +92,15 @@ class Convolution(Layer):
                     padding=[(c["pad"][0], c["pad"][0]),
                              (c["pad"][1], c["pad"][1])],
                     rhs_dilation=(d, d),
-                    dimension_numbers=_DIMNUMS,
+                    dimension_numbers=dn,
                     feature_group_count=c["group"],
+                    out_channel_axis=3 if nhwc else 1,
                 )
                 if c["bias"]:
-                    y = y + params[1].astype(y.dtype)[None, :, None, None]
+                    if nhwc:
+                        y = y + params[1].astype(y.dtype)[None, None, None, :]
+                    else:
+                        y = y + params[1].astype(y.dtype)[None, :, None, None]
                 return LayerOutput([y.astype(x.dtype)])
         w = params[0].astype(x.dtype)
         y = jax.lax.conv_general_dilated(
@@ -98,11 +109,14 @@ class Convolution(Layer):
             window_strides=c["stride"],
             padding=[(c["pad"][0], c["pad"][0]), (c["pad"][1], c["pad"][1])],
             rhs_dilation=(d, d),
-            dimension_numbers=_DIMNUMS,
+            dimension_numbers=dn,
             feature_group_count=c["group"],
         )
         if c["bias"]:
-            y = y + params[1].astype(x.dtype)[None, :, None, None]
+            if nhwc:
+                y = y + params[1].astype(x.dtype)[None, None, None, :]
+            else:
+                y = y + params[1].astype(x.dtype)[None, :, None, None]
         return LayerOutput([y])
 
 
@@ -118,7 +132,7 @@ class Deconvolution(Convolution):
 
     def init(self, key, in_shapes):
         c = self._conf()
-        ch = in_shapes[0][1]
+        ch = in_shapes[0][layout.channel_axis(ndim=len(in_shapes[0]))]
         wshape = (ch, c["num_output"] // c["group"], *c["kernel"])
         kw, kb = jax.random.split(key)
         dtype = get_config().param_dtype
@@ -151,11 +165,14 @@ class Deconvolution(Convolution):
             ],
             lhs_dilation=c["stride"],
             rhs_dilation=(d, d),
-            dimension_numbers=_DIMNUMS,
+            dimension_numbers=layout.conv_dimnums(),
             feature_group_count=g,
         )
         if c["bias"]:
-            y = y + params[1].astype(x.dtype)[None, :, None, None]
+            if layout.is_nhwc():
+                y = y + params[1].astype(x.dtype)[None, None, None, :]
+            else:
+                y = y + params[1].astype(x.dtype)[None, :, None, None]
         return LayerOutput([y])
 
 
@@ -174,8 +191,11 @@ def _ave_pool_divisor(h: int, w: int, kh: int, kw: int, sh: int, sw: int, ph: in
 
 
 def caffe_avg_pool(x, kernel, stride, pad):
-    """Average pooling with Caffe's ceil shapes and padded-divisor rule."""
-    h, w = x.shape[2], x.shape[3]
+    """Average pooling with Caffe's ceil shapes and padded-divisor rule.
+    Layout-polymorphic: the spatial window rides the internal (H, W)
+    axes (``ops/layout.py``)."""
+    ha, wa = layout.spatial_axes()
+    h, w = x.shape[ha], x.shape[wa]
     kh, kw = kernel
     sh, sw = stride
     ph, pw = pad
@@ -184,22 +204,27 @@ def caffe_avg_pool(x, kernel, stride, pad):
     # Pad enough on the trailing edge for ceil-mode windows.
     extra_h = max(0, (oh - 1) * sh + kh - h - ph)
     extra_w = max(0, (ow - 1) * sw + kw - w - pw)
+    dims, strides, padding = layout.pool_window(
+        kernel, stride, (ph, extra_h, pw, extra_w))
     # NB: init must be a Python scalar, not an Array — an Array init value
     # breaks reverse-mode linearization under jit (jax 0.9).
     summed = jax.lax.reduce_window(
         x,
         0.0 if jnp.issubdtype(x.dtype, jnp.floating) else 0,
         jax.lax.add,
-        window_dimensions=(1, 1, kh, kw),
-        window_strides=(1, 1, sh, sw),
-        padding=((0, 0), (0, 0), (ph, extra_h), (pw, extra_w)),
+        window_dimensions=dims,
+        window_strides=strides,
+        padding=padding,
     )
     div = jnp.asarray(_ave_pool_divisor(h, w, kh, kw, sh, sw, ph, pw), x.dtype)
+    if layout.is_nhwc():
+        return summed / div[None, :, :, None]
     return summed / div[None, None]
 
 
 def caffe_max_pool(x, kernel, stride, pad):
-    h, w = x.shape[2], x.shape[3]
+    ha, wa = layout.spatial_axes()
+    h, w = x.shape[ha], x.shape[wa]
     kh, kw = kernel
     sh, sw = stride
     ph, pw = pad
@@ -207,28 +232,41 @@ def caffe_max_pool(x, kernel, stride, pad):
     ow = pool_out_dim(w, kw, pw, sw)
     extra_h = max(0, (oh - 1) * sh + kh - h - ph)
     extra_w = max(0, (ow - 1) * sw + kw - w - pw)
+    dims, strides, padding = layout.pool_window(
+        kernel, stride, (ph, extra_h, pw, extra_w))
     neg_inf = float("-inf") if jnp.issubdtype(x.dtype, jnp.floating) else int(jnp.iinfo(x.dtype).min)
     return jax.lax.reduce_window(
         x,
         neg_inf,
         jax.lax.max,
-        window_dimensions=(1, 1, kh, kw),
-        window_strides=(1, 1, sh, sw),
-        padding=((0, 0), (0, 0), (ph, extra_h), (pw, extra_w)),
+        window_dimensions=dims,
+        window_strides=strides,
+        padding=padding,
     )
 
 
 def _pool_patches(x, kernel, stride):
-    """(N, C, kh*kw, oh, ow) window patches with Caffe ceil-mode output
-    dims; edge-overhanging windows are zero-filled (zeros carry no
-    activation mass, matching the reference's hstart/hend clipping)."""
-    h, w = x.shape[2], x.shape[3]
+    """Window patches with Caffe ceil-mode output dims, the window axis
+    ready for per-window sampling: (N, C, kh*kw, oh, ow) under nchw,
+    (N, oh, ow, C, kh*kw) under nhwc (channel varies slowest in the
+    patch feature dim either way).  Edge-overhanging windows are
+    zero-filled (zeros carry no activation mass, matching the
+    reference's hstart/hend clipping)."""
+    ha, wa = layout.spatial_axes()
+    h, w = x.shape[ha], x.shape[wa]
     kh, kw = kernel
     sh, sw = stride
     oh = pool_out_dim(h, kh, 0, sh)
     ow = pool_out_dim(w, kw, 0, sw)
     extra_h = max(0, (oh - 1) * sh + kh - h)
     extra_w = max(0, (ow - 1) * sw + kw - w)
+    if layout.is_nhwc():
+        xp = jnp.pad(x, ((0, 0), (0, extra_h), (0, extra_w), (0, 0)))
+        patches = jax.lax.conv_general_dilated_patches(
+            xp, (kh, kw), (sh, sw), padding="VALID",
+            dimension_numbers=("NHWC", "OIHW", "NHWC"),
+        )
+        return patches.reshape(x.shape[0], oh, ow, x.shape[3], kh * kw)
     xp = jnp.pad(x, ((0, 0), (0, 0), (0, extra_h), (0, extra_w)))
     patches = jax.lax.conv_general_dilated_patches(
         xp, (kh, kw), (sh, sw), padding="VALID",
@@ -247,18 +285,25 @@ def caffe_stochastic_pool(x, kernel, stride, *, train, rng=None):
     Assumes non-negative activations (post-ReLU), as the reference does.
 
     TPU-first: one patch extraction + vectorized cumsum/argmax over the
-    window axis — no scalar loops, fuses under jit."""
+    window axis — no scalar loops, fuses under jit.  Under nhwc the
+    window axis sits last (draws are per logical window either way;
+    the sample mapping is distribution-identical, not bit-identical,
+    across layouts — like the train-mode host-vs-device RNG note in
+    data/device_transform.py)."""
     patches = _pool_patches(x, kernel, stride)
-    total = patches.sum(axis=2)
+    wax = 4 if layout.is_nhwc() else 2
+    total = patches.sum(axis=wax)
     if train:
         assert rng is not None, "stochastic pooling needs an rng in train mode"
         thres = jax.random.uniform(rng, total.shape, patches.dtype) * total
-        csum = jnp.cumsum(patches, axis=2)
+        csum = jnp.cumsum(patches, axis=wax)
         # first window position whose running sum crosses the threshold
-        idx = jnp.argmax(csum >= thres[:, :, None], axis=2)
-        y = jnp.take_along_axis(patches, idx[:, :, None], axis=2)[:, :, 0]
+        idx = jnp.argmax(csum >= jnp.expand_dims(thres, wax), axis=wax)
+        y = jnp.take_along_axis(
+            patches, jnp.expand_dims(idx, wax), axis=wax
+        ).squeeze(wax)
     else:
-        sq = (patches * patches).sum(axis=2)
+        sq = (patches * patches).sum(axis=wax)
         y = jnp.where(total > 0, sq / jnp.where(total > 0, total, 1), 0)
     return y.astype(x.dtype)
 
@@ -275,7 +320,8 @@ class Pooling(Layer):
     def _conf(self, in_shape):
         p = self.lp.get_msg("pooling_param")
         if p.get_bool("global_pooling", False):
-            kernel = (in_shape[2], in_shape[3])
+            ha, wa = layout.spatial_axes()
+            kernel = (in_shape[ha], in_shape[wa])
             stride, pad = (1, 1), (0, 0)
         else:
             kernel = hw_param(p, "kernel")
@@ -334,10 +380,14 @@ class LRN(Layer):
             return LayerOutput([y])
         # ACROSS_CHANNELS: sliding sum over the channel axis — XLA
         # reduce_window by default; SPARKNET_LRN_IMPL=pallas opts into the
-        # hand-written kernel (ops/pallas_kernels.py).
+        # hand-written kernel (ops/pallas_kernels.py).  Under nhwc the
+        # channel window sits on the MINOR axis (the orientation the
+        # NCHW pallas kernel exists to recover by hand).
         from sparknet_tpu.ops.pallas_kernels import lrn_across_channels
 
-        return LayerOutput([lrn_across_channels(x, size, alpha, beta, k)])
+        return LayerOutput([lrn_across_channels(
+            x, size, alpha, beta, k,
+            channel_axis=layout.channel_axis(ndim=x.ndim))])
 
 
 @register
@@ -354,6 +404,13 @@ class Im2col(Layer):
         sh, sw = hw_param(p, "stride", default=1)
         ph, pw = hw_param(p, "pad", default=0)
         x = inputs[0]
+        if layout.is_nhwc():
+            # the output's (C*kh*kw, OH, OW) blob order IS the layer's
+            # contract (consumers index the canonical patch layout);
+            # reorienting it has no parity meaning — run canonical
+            raise ValueError(
+                f"{self.name}: Im2col is a Caffe-parity layer with a "
+                "canonical-NCHW output contract; run under layout=nchw")
         n, c, h, w = x.shape
         oh = conv_out_dim(h, kh, ph, sh)
         ow = conv_out_dim(w, kw, pw, sw)
@@ -379,7 +436,8 @@ class SPP(Layer):
         levels = p.get_int("pyramid_height", 3)
         method = p.get_str("pool", "MAX")
         x = inputs[0]
-        n, c, h, w = x.shape
+        ha, wa = layout.spatial_axes()
+        n, h, w = x.shape[0], x.shape[ha], x.shape[wa]
         outs = []
         for level in range(levels):
             bins = 2**level
@@ -389,5 +447,10 @@ class SPP(Layer):
             pw = (kw * bins - w + 1) // 2
             pool = caffe_avg_pool if method == "AVE" else caffe_max_pool
             y = pool(x, (kh, kw), (sh, sw), (ph, pw))
+            if layout.is_nhwc():
+                # the flattened pyramid is a wire blob: keep the
+                # canonical (C, bins, bins) element order so downstream
+                # fc weights line up in either layout
+                y = y.transpose(0, 3, 1, 2)
             outs.append(y.reshape(n, -1))
         return LayerOutput([jnp.concatenate(outs, axis=1)])

@@ -19,7 +19,9 @@ TPU-first extensions: ``solo`` (no mesh — the negative control: any
 collective is a bug), ``dp``/``dp_bf16``/``mobilenet_dp`` (tau=1
 GSPMD sync SGD, ref: CifarApp.scala:95-136 degenerate case), ``tau``
 (the SparkNet tau-averaging round), ``easgd`` (elastic coupling),
-``tp`` (Megatron-style output-channel sharding), ``sp`` (Ulysses
+``solo_nhwc``/``dp_nhwc`` (the channels-last layout twins — identical
+comm contracts, plus the layout transpose census), ``tp``
+(Megatron-style output-channel sharding), ``sp`` (Ulysses
 all-to-all sequence parallelism — the ring impl is trace-broken under
 the pinned jax, see test_seq_parallel's seed state), ``gpipe``
 (pipeline ppermute), ``moe`` (expert all_to_all dispatch).
@@ -77,13 +79,17 @@ def _tree_bytes(tree) -> int:
 
 def _feeds_for(family, batch: int, rs: np.random.RandomState,
                tau: int = 0) -> dict:
-    """Synthetic feeds matching the family's RDD layer shapes; a
-    leading [tau] axis when the round carries tau local steps."""
+    """Synthetic feeds matching the family's RDD layer shapes (in the
+    active internal layout — ops/layout.py); a leading [tau] axis when
+    the round carries tau local steps."""
     if family.feed == "tokens":
         data = rs.randint(0, family.vocab, (batch, family.seq_len))
         data = data.astype(np.int32)
     else:
-        data = rs.randn(batch, *family.image_shape).astype(np.float32) * 10
+        from sparknet_tpu.ops.layout import internal_shape
+
+        shape = internal_shape((batch, *family.image_shape))
+        data = rs.randn(*shape).astype(np.float32) * 10
     label = rs.randint(0, family.num_classes, batch).astype(np.int32)
     if tau:
         data = np.stack([data] * tau)
@@ -93,10 +99,12 @@ def _feeds_for(family, batch: int, rs: np.random.RandomState,
 
 def _trainer_target(name: str, family_name: str, mesh, *, tau: int = 1,
                     elastic_alpha: float = 0.0, per_device_batch: int = 2,
-                    rules=None, compute_dtype=None,
+                    rules=None, compute_dtype=None, layout=None,
                     expects_sharded_params: bool = False) -> TraceTarget:
     """The shared trainer-mode factory: construct Solver+ParallelTrainer
-    exactly as the dryrun does, stop at the jitted round function."""
+    exactly as the dryrun does, stop at the jitted round function.
+    ``layout``: internal activation layout for the whole build+trace
+    (None = leave the global config alone)."""
     from sparknet_tpu.common import get_config, set_config
     from sparknet_tpu.models.zoo import GRAPH_SWEEP_FAMILIES
     from sparknet_tpu.parallel.trainer import ParallelTrainer
@@ -109,15 +117,20 @@ def _trainer_target(name: str, family_name: str, mesh, *, tau: int = 1,
 
     @contextlib.contextmanager
     def dtype_ctx():
-        if compute_dtype is None:
+        overrides = {}
+        if compute_dtype is not None:
+            overrides["compute_dtype"] = compute_dtype
+        if layout is not None:
+            overrides["layout"] = layout
+        if not overrides:
             yield
             return
-        prior = get_config().compute_dtype
-        set_config(compute_dtype=compute_dtype)
+        prior = {k: getattr(get_config(), k) for k in overrides}
+        set_config(**overrides)
         try:
             yield
         finally:
-            set_config(compute_dtype=prior)
+            set_config(**prior)
 
     with dtype_ctx():
         # tau/EASGD rounds run per-worker replicas: the solver's own
@@ -164,6 +177,7 @@ def _trainer_target(name: str, family_name: str, mesh, *, tau: int = 1,
             "elastic_alpha": elastic_alpha,
             "batch": B_global,
             "dtype": "bf16" if compute_dtype == jnp.bfloat16 else "f32",
+            "layout": layout or "nchw",
         },
         # model sizes for the comm model come from the SOLVER's (single-
         # replica) tree: tau/EASGD trainers stack a worker axis, but the
@@ -182,30 +196,49 @@ def _trainer_target(name: str, family_name: str, mesh, *, tau: int = 1,
 # ---------------------------------------------------------------------------
 
 
-def _mode_solo(devices) -> TraceTarget:
+def _mode_solo(devices, layout: str | None = None,
+               name: str = "solo") -> TraceTarget:
     """Single-chip Solver step — the negative control (no mesh, so the
     lowered program must contain ZERO collectives) and the donation
     audit's original catch: ``Solver._train_step`` shipped undonated
-    until this audit flagged the 2x params+slots HBM bloat."""
+    until this audit flagged the 2x params+slots HBM bloat.
+    ``layout="nhwc"`` builds the channels-last twin (mode solo_nhwc),
+    whose manifest pins the zero-interior-transpose layout contract."""
+    from sparknet_tpu.common import get_config, set_config
     from sparknet_tpu.models.zoo import GRAPH_SWEEP_FAMILIES
     from sparknet_tpu.solvers.solver import Solver
 
     family = GRAPH_SWEEP_FAMILIES["cifar10_quick"]
     B = 16
-    solver = Solver(family.solver(), family.net(B))
-    rs = np.random.RandomState(0)
-    feeds = {k: jnp.asarray(v)
-             for k, v in _feeds_for(family, B, rs).items()}
+
+    @contextlib.contextmanager
+    def lay_ctx():
+        if layout is None:
+            yield
+            return
+        prior = get_config().layout
+        set_config(layout=layout)
+        try:
+            yield
+        finally:
+            set_config(layout=prior)
+
+    with lay_ctx():
+        solver = Solver(family.solver(), family.net(B))
+        rs = np.random.RandomState(0)
+        feeds = {k: jnp.asarray(v)
+                 for k, v in _feeds_for(family, B, rs).items()}
     args = (solver.variables, solver.slots, 0, feeds, solver._key)
     carry_out = sum(len(jax.tree_util.tree_leaves(t)) for t in args[:2])
     return TraceTarget(
-        name="solo", fn=solver._train_step, args=args,
+        name=name, fn=solver._train_step, args=args,
         alt_args=args[:2] + (1,) + args[3:],
         meta={"family": "cifar10_quick", "mesh": {}, "tau": 1,
-              "batch": B, "dtype": "f32"},
+              "batch": B, "dtype": "f32", "layout": layout or "nchw"},
         param_bytes=_tree_bytes(solver.variables.params),
         state_bytes=_tree_bytes(solver.variables.state),
         carry_argnums=(0, 1), carry_out_leaves=carry_out,
+        trace_context=lay_ctx,
     )
 
 
@@ -217,6 +250,19 @@ def _data_mesh(devices):
 
 def _mode_dp(devices) -> TraceTarget:
     return _trainer_target("dp", "cifar10_quick", _data_mesh(devices))
+
+
+def _mode_solo_nhwc(devices) -> TraceTarget:
+    return _mode_solo(devices, layout="nhwc", name="solo_nhwc")
+
+
+def _mode_dp_nhwc(devices) -> TraceTarget:
+    """tau=1 GSPMD DP with channels-last activations: same comm contract
+    as dp (weights never reorient, so the grad all-reduce budget is
+    byte-identical), plus the layout census pinning zero interior
+    rank-4 transposes in the lowered step."""
+    return _trainer_target("dp_nhwc", "cifar10_quick",
+                           _data_mesh(devices), layout="nhwc")
 
 
 def _mode_dp_bf16(devices) -> TraceTarget:
@@ -310,7 +356,9 @@ def _mode_moe(devices) -> TraceTarget:
 
 MODES: dict[str, Callable] = {
     "solo": _mode_solo,
+    "solo_nhwc": _mode_solo_nhwc,
     "dp": _mode_dp,
+    "dp_nhwc": _mode_dp_nhwc,
     "dp_bf16": _mode_dp_bf16,
     "tau": _mode_tau,
     "easgd": _mode_easgd,

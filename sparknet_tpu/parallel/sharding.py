@@ -14,6 +14,16 @@ Rules implemented:
   the 'model' axis when divisible; biases shard the same way; everything
   else replicates.  XLA's sharding propagation then splits the activations
   and inserts the all-gathers/reduce-scatters on ICI.
+
+Layout note (``Config.layout``, ops/layout.py): these specs are
+layout-INVARIANT by construction.  Param blobs keep Caffe wire order in
+both internal layouts — conv weights OIHW, fc weights (num_output, dim)
+— so the TP output-channel axis stays axis 0 and nothing here moves
+when the activation layout flips to nhwc; the batch axis of every feed
+stays axis 0 too (only the interior H/W/C positions of rank-4 feeds
+change, and GSPMD shards those by the batch spec regardless).  The
+nhwc graphcheck modes (solo_nhwc/dp_nhwc) pin this: their manifests
+must show the same sharding block as their nchw twins.
 """
 
 from __future__ import annotations

@@ -148,10 +148,12 @@ def calibrate(net, variables, feeds_iter, *, num_batches: int = 4,
 
 
 def int8_conv(x, q, *, stride, padding, rhs_dilation, dimension_numbers,
-              feature_group_count):
+              feature_group_count, out_channel_axis: int = 1):
     """int8 x int8 -> int32 convolution + float dequant.  ``q["w_scale"]``
-    is (Cout, 1, 1, 1) from quantize_weight; output channels sit at NCHW
-    axis 1."""
+    is (Cout, 1, 1, 1) from quantize_weight (weights are OIHW in every
+    layout); ``out_channel_axis`` says where the output channels sit in
+    the INTERNAL activation orientation — 1 for NCHW (default), 3 for
+    NHWC (``Config.layout``, ops/layout.py)."""
     x_q = quantize_activation(x, q["x_scale"])
     y = jax.lax.conv_general_dilated(
         x_q, q["w_q"],
@@ -163,6 +165,8 @@ def int8_conv(x, q, *, stride, padding, rhs_dilation, dimension_numbers,
         preferred_element_type=jnp.int32,
     )
     scale = (q["x_scale"] * q["w_scale"].reshape(-1)).astype(jnp.float32)
+    if out_channel_axis == 3:
+        return y.astype(jnp.float32) * scale[None, None, None, :]
     return y.astype(jnp.float32) * scale[None, :, None, None]
 
 

@@ -752,8 +752,15 @@ class Solver:
         path = f"{prefix}.solverstate.npz"
         self._export_model_pair(prefix)
         flat: dict[str, np.ndarray] = {"__iter__": np.asarray(self.iter)}
+        # `layout` is provenance, not a compatibility gate: params and
+        # state are layout-INVARIANT (conv OIHW, fc wire-order — see
+        # ops/layout.py), so a snapshot written under either layout
+        # restores exactly into a solver running the other.
+        from sparknet_tpu.common import get_config as _gc
+
         flat["__meta__"] = np.frombuffer(
-            json.dumps({"solver_type": self.config.solver_type}).encode(), dtype=np.uint8
+            json.dumps({"solver_type": self.config.solver_type,
+                        "layout": _gc().layout}).encode(), dtype=np.uint8
         )
         for lname, plist in self.variables.params.items():
             for i, p in enumerate(plist):

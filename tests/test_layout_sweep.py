@@ -1,0 +1,125 @@
+"""Slow-tier NCHW↔NHWC equivalence sweep over every zoo conv model.
+
+The smoke twin (tests/test_layout.py) gates the headline shape
+(zoo:alexnet); this sweep demands the same contract from the whole conv
+zoo — same seeded params (layout-invariant wire order), same canonical
+feed bytes, one SGD step per layout, loss AND post-step params allclose.
+Covers every layer family the layout touches: grouped + depthwise
+convs, LRN (ACROSS and WITHIN channel), BatchNorm/Scale, global and
+ceil-mode pooling, Slice/Concat DAGs (siamese, inception, fire), the
+fc-as-conv boundary, and dropout's canonical-order mask.
+
+BN models accumulate their batch moments over a permuted axis order
+under nhwc, so their tolerance is loose-ish (f32 summation order);
+everything else matches near-exactly.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.common import get_config, set_config
+from sparknet_tpu.models import zoo
+from sparknet_tpu.ops.layout import to_internal
+from sparknet_tpu.solvers.solver import Solver
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(autouse=True)
+def _restore_layout():
+    prior = get_config().layout
+    yield
+    set_config(layout=prior)
+
+
+def _lr(solver_cfg, lr=1e-3):
+    return dataclasses.replace(solver_cfg, base_lr=lr)
+
+
+# name -> (net(B), solver_cfg(), feed builder, rtol)
+CASES = {
+    "lenet": (
+        lambda B: zoo.lenet(B), zoo.lenet_solver, (1, 28, 28), 10, 1e-5),
+    "cifar10_quick": (
+        lambda B: zoo.cifar10_quick(B), zoo.cifar10_quick_solver,
+        (3, 32, 32), 10, 1e-5),
+    # WITHIN_CHANNEL LRN + ACROSS both live here
+    "cifar10_full": (
+        lambda B: zoo.cifar10_full(B), zoo.cifar10_full_solver,
+        (3, 32, 32), 10, 1e-5),
+    "alexnet": (
+        lambda B: zoo.alexnet(B, 10, crop=63),
+        zoo.alexnet_solver, (3, 63, 63), 10, 1e-5),
+    "caffenet": (
+        lambda B: zoo.caffenet(B, 10, crop=63),
+        zoo.caffenet_solver, (3, 63, 63), 10, 1e-5),
+    "vgg16": (
+        lambda B: zoo.vgg16(B, 5, crop=32, msra_init=True),
+        lambda: _lr(zoo.vgg16_solver()), (3, 32, 32), 5, 1e-5),
+    "squeezenet": (
+        lambda B: zoo.squeezenet(B, 5, crop=64, msra_init=True),
+        lambda: _lr(zoo.squeezenet_solver()), (3, 64, 64), 5, 1e-5),
+    # depthwise group conv + BN/Scale chains
+    "mobilenet": (
+        lambda B: zoo.mobilenet(batch=B, num_classes=5, crop=64),
+        lambda: _lr(zoo.mobilenet_solver()), (3, 64, 64), 5, 5e-4),
+    # bottleneck BN everywhere
+    "resnet50": (
+        lambda B: zoo.resnet50(batch=B, num_classes=5, crop=64),
+        lambda: _lr(zoo.resnet50_solver()), (3, 64, 64), 5, 5e-4),
+    # published geometry only: the aux heads' 5x5/3 pools and the final
+    # 7x7 pool pin the 224 crop
+    "googlenet": (
+        lambda B: zoo.googlenet(B, 10, crop=224),
+        zoo.googlenet_solver, (3, 224, 224), 10, 1e-5),
+}
+
+
+def _one_step(lay, make_net, make_cfg, feeds, B):
+    set_config(layout=lay)
+    solver = Solver(make_cfg(), make_net(B))
+    internal = {k: to_internal(v) for k, v in feeds.items()}
+    loss = solver.step(1, lambda it: internal)
+    return loss, solver
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_zoo_conv_model_layout_equivalence(name):
+    make_net, make_cfg, shape, ncls, rtol = CASES[name]
+    B = 1 if name == "googlenet" else 2
+    rs = np.random.RandomState(11)
+    feeds = {
+        "data": (rs.randn(B, *shape) * 10).astype(np.float32),
+        "label": rs.randint(0, ncls, B).astype(np.int32),
+    }
+    loss_c, solver_c = _one_step("nchw", make_net, make_cfg, feeds, B)
+    loss_h, solver_h = _one_step("nhwc", make_net, make_cfg, feeds, B)
+    assert np.allclose(loss_c, loss_h, rtol=rtol, atol=rtol), (
+        name, loss_c, loss_h)
+    for lname, plist in solver_c.variables.params.items():
+        for i, (p_c, p_h) in enumerate(
+                zip(plist, solver_h.variables.params[lname])):
+            np.testing.assert_allclose(
+                np.asarray(p_c), np.asarray(p_h), rtol=rtol, atol=rtol,
+                err_msg=f"{name}: post-step params diverge at "
+                        f"{lname}[{i}]")
+
+
+def test_siamese_slice_dag_layout_equivalence():
+    """mnist_siamese: the pair blob is rank-4 with channel=2 pairs —
+    Slice on canonical axis 1 must cut the internal channel axis."""
+    B = 4
+    rs = np.random.RandomState(11)
+    feeds = {
+        "pair_data": rs.randn(B, 2, 28, 28).astype(np.float32),
+        "sim": rs.randint(0, 2, B).astype(np.float32),
+    }
+    out = {}
+    for lay in ("nchw", "nhwc"):
+        set_config(layout=lay)
+        solver = Solver(zoo.mnist_siamese_solver(), zoo.mnist_siamese(B))
+        internal = {k: to_internal(v) for k, v in feeds.items()}
+        out[lay] = solver.step(1, lambda it: internal)
+    assert np.allclose(out["nchw"], out["nhwc"], rtol=1e-5, atol=1e-6), out
