@@ -6,13 +6,19 @@ platform pinning, evidence banking (SparkNet's equivalent contracts were
 enforced by Spark around the native solver; ref: PAPER.md, Moritz et
 al., arXiv:1511.06051 — here the system must check them itself).
 
-Two engines share this package and one findings schema:
+Three engines share this package and one findings schema:
 
 * graftlint (``core``/``rules``) — AST lint of the SOURCE contracts;
 * graphcheck (``graphcheck``/``comm_model``) — static analysis of the
   LOWERED graphs: each parallel mode's train step is lowered on the
   virtual 8-device CPU mesh and audited for comm budget, sharding,
-  dtype, and donation against banked manifests (docs/graph_contracts/).
+  dtype, and donation against banked manifests (docs/graph_contracts/);
+* memcheck (``memcheck``/``mem_model``) — static analysis of what the
+  same lowerings hold in MEMORY: an analytic jaxpr-liveness model of
+  peak per-device HBM cross-checked against XLA's
+  ``memory_analysis()``, pallas-kernel VMEM bounds, banked manifests
+  (docs/mem_contracts/), and the batch-fit table the window runner's
+  queue pre-flight prices jobs against.
 
 Usage:
 
@@ -20,6 +26,7 @@ Usage:
     python -m sparknet_tpu.analysis tools bench.py --format json
     python -m sparknet_tpu.analysis --list-rules
     python -m sparknet_tpu.analysis graph [--mode dp] [--json] [--update]
+    python -m sparknet_tpu.analysis mem [--mode M] [--json] [--update] [--fit]
 
 Library API: ``lint_paths`` / ``lint_source`` return ``Finding``
 records; CI asserts ``not [f for f in findings if not f.suppressed]``

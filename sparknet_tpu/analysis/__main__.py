@@ -1,6 +1,6 @@
-"""Analysis CLI: ``python -m sparknet_tpu.analysis [lint|graph] ...``.
+"""Analysis CLI: ``python -m sparknet_tpu.analysis [lint|graph|mem] ...``.
 
-Two engines share one front door and one findings schema:
+Three engines share one front door and one findings schema:
 
 * ``lint``  — graftlint, the AST source-contract linter (the default:
   a bare invocation or one starting with paths/flags lints, so every
@@ -9,8 +9,13 @@ Two engines share one front door and one findings schema:
   analysis (lowers each parallel mode on the virtual CPU mesh and
   audits comm budget, sharding, dtype, donation against the banked
   manifests in docs/graph_contracts/).
+* ``mem``   — memcheck, the static HBM/VMEM footprint analysis (same
+  CPU-mesh lowerings, cross-checking an analytic jaxpr-liveness model
+  against XLA's ``memory_analysis()``, banking docs/mem_contracts/;
+  ``--fit`` runs the batch-fit solver the window runner's queue
+  pre-flight consults).
 
-Exit codes (both subcommands): 0 clean (or suppressed-only), 1
+Exit codes (all subcommands): 0 clean (or suppressed-only), 1
 unsuppressed findings, 2 usage error.  ``--json`` (or the legacy
 ``--format json``) emits the shared schema: ``{"findings": [{rule,
 path, line, message, suppressed}...], "unsuppressed": N,
@@ -21,6 +26,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import re
 import sys
 
 from sparknet_tpu.analysis import (
@@ -158,10 +164,116 @@ def graph_main(argv: list[str] | None = None) -> int:
     return 1 if any(not f.suppressed for f in findings) else 0
 
 
+def _parse_bytes(text: str) -> int:
+    """'16GiB' / '8g' / '123456789' -> bytes (usage errors raise
+    ValueError for the caller's rc-2 path)."""
+    m = re.fullmatch(
+        r"\s*(\d+(?:\.\d+)?)\s*([kmgt]i?b?)?\s*", text, re.IGNORECASE)
+    if not m:
+        raise ValueError(f"cannot parse byte size {text!r} "
+                         "(want e.g. 16GiB, 8g, or a plain byte count)")
+    scale = {"": 1, "k": 2**10, "m": 2**20, "g": 2**30, "t": 2**40}
+    unit = (m.group(2) or "").lower().rstrip("b").rstrip("i")
+    return int(float(m.group(1)) * scale[unit])
+
+
+def mem_main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m sparknet_tpu.analysis mem",
+        description="memcheck: statically predict each parallel mode's "
+        "per-device HBM footprint on the virtual CPU mesh (analytic "
+        "jaxpr-liveness model cross-checked against XLA's "
+        "memory_analysis()), audit pallas-kernel VMEM bounds, and diff "
+        "against the banked manifests (docs/mem_contracts/) — zero chip "
+        "time.  --fit solves max safe batch per zoo family x dtype x "
+        "mode (the table the window runner's queue pre-flight consults)",
+    )
+    ap.add_argument("--mode", action="append", default=[],
+                    help="check only this mode (repeatable; default all "
+                    "modes + the 'kernels' VMEM audit)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--json", action="store_true",
+                    help="shorthand for --format json")
+    ap.add_argument("--update", action="store_true",
+                    help="rewrite the banked manifests (and SOURCES.json "
+                    "on a full run) instead of diffing against them")
+    ap.add_argument("--fit", action="store_true",
+                    help="run the batch-fit solver instead of the "
+                    "per-mode audit (banks docs/mem_contracts/"
+                    "batch_fit.json with --update)")
+    ap.add_argument("--hbm", default=None, metavar="SIZE",
+                    help="accelerator HBM to fit against (e.g. 16GiB; "
+                    "default: the v5e's 16 GiB)")
+    ap.add_argument("--family", action="append", default=[],
+                    help="--fit: solve only this zoo family (repeatable)")
+    ap.add_argument("--show-suppressed", action="store_true")
+    ap.add_argument("--list-modes", action="store_true",
+                    help="print the mode registry (+ 'kernels') and exit")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the memory-rule catalog and exit")
+    ap.add_argument("--devices", type=int, default=8,
+                    help="virtual CPU mesh width (default 8, the test "
+                    "harness mesh)")
+    args = ap.parse_args(argv)
+
+    from sparknet_tpu.analysis import mem_model, memcheck
+
+    if args.list_rules:
+        for rule_id, summary in memcheck.iter_rules():
+            print(f"{rule_id}: {summary}")
+        return 0
+    if args.list_modes:
+        from sparknet_tpu.parallel.modes import list_modes
+
+        for name in list_modes() + ["kernels"]:
+            print(name)
+        return 0
+
+    hbm = mem_model.V5E_HBM_BYTES
+    if args.hbm:
+        try:
+            hbm = _parse_bytes(args.hbm)
+        except ValueError as e:
+            print(e, file=sys.stderr)
+            return 2
+
+    as_json = args.json or args.format == "json"
+    try:
+        if args.fit:
+            progress = None if as_json else (
+                lambda f: print(f"memcheck: fitting {f} ...",
+                                file=sys.stderr))
+            findings, _ = memcheck.run_batch_fit(
+                hbm_bytes=hbm, update=args.update,
+                families=args.family or None, n_devices=args.devices,
+                progress=progress)
+        else:
+            progress = None if as_json else (
+                lambda m: print(f"memcheck: tracing {m} ...",
+                                file=sys.stderr))
+            findings, _ = memcheck.run_memcheck(
+                args.mode or None, update=args.update,
+                n_devices=args.devices, progress=progress)
+    except KeyError as e:
+        print(e.args[0], file=sys.stderr)
+        return 2
+    if as_json:
+        print(render_json(findings))
+    else:
+        print(render_text(findings, show_suppressed=args.show_suppressed,
+                          label="memcheck"))
+        if args.update:
+            print(f"memcheck: manifests updated in "
+                  f"{os.path.relpath(memcheck.MANIFEST_DIR)}")
+    return 1 if any(not f.suppressed for f in findings) else 0
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "graph":
         return graph_main(argv[1:])
+    if argv and argv[0] == "mem":
+        return mem_main(argv[1:])
     if argv and argv[0] == "lint":
         return lint_main(argv[1:])
     # legacy invocation: bare paths/flags mean lint

@@ -531,6 +531,180 @@ def check_obs_fenced_span(ctx: ModuleContext) -> Iterator[tuple[int, str]]:
                 )
 
 
+# ---------------------------------------------------------------------------
+# mem-manifest-fresh
+# ---------------------------------------------------------------------------
+
+# the memory-contract source surface: editing any of these changes what
+# memcheck traces (layer geometry, optimizer slot counts, donation,
+# sharding divisors, pallas tiling) so the banked docs/mem_contracts/
+# manifests must be regenerated in the same PR (kept in sync with
+# memcheck.MEM_SOURCE_PATTERNS — spelled out here too so this module
+# stays importable without memcheck)
+_MEM_SOURCE_DIR = "sparknet_tpu/parallel/"
+_MEM_SOURCE_FILES = (
+    "sparknet_tpu/models/zoo.py",
+    "sparknet_tpu/ops/pallas_kernels.py",
+    "sparknet_tpu/ops/layout.py",
+    "sparknet_tpu/solvers/solver.py",
+    "sparknet_tpu/solvers/updates.py",
+    "sparknet_tpu/analysis/memcheck.py",
+    "sparknet_tpu/analysis/mem_model.py",
+)
+_MEM_REGEN = ("regenerate with `python -m sparknet_tpu.analysis mem "
+              "--update` (+ `--fit --update` for the batch-fit table)")
+
+
+def _mem_source_rel(path: str) -> tuple[str, str] | None:
+    """(repo_root, repo_relative_path) when ``path`` is part of the
+    memory-contract source surface, else None."""
+    norm = os.path.abspath(path).replace(os.sep, "/")
+    idx = norm.rfind("/sparknet_tpu/")
+    if idx < 0:
+        return None
+    root, rel = norm[:idx], norm[idx + 1:]
+    if rel.startswith(_MEM_SOURCE_DIR) or rel in _MEM_SOURCE_FILES:
+        return root, rel
+    return None
+
+
+@rule(
+    "mem-manifest-fresh",
+    "a PR touching parallel/, models/zoo.py, ops/pallas_kernels.py, "
+    "solvers/, or memcheck itself must regenerate the "
+    "docs/mem_contracts/ manifests",
+)
+def check_mem_manifest_fresh(ctx: ModuleContext) -> Iterator[tuple[int, str]]:
+    """The memory manifests predict what a queue job will hold in HBM;
+    the window runner's pre-flight refuses jobs off the banked batch-fit
+    table.  A stale table is worse than none — it would veto (or wave
+    through) jobs against a model that no longer exists.  ``memcheck
+    --update`` banks a sha256 per source file in
+    ``docs/mem_contracts/SOURCES.json``; this rule re-hashes the linted
+    source and flags any mismatch, exactly like ``graph-manifest-fresh``
+    does for the graph contracts.  Blind spot: an edit that reverts to
+    the banked bytes passes (correctly — the traced programs are the
+    banked ones again).
+    """
+    hit = _mem_source_rel(ctx.path)
+    if hit is None:
+        return
+    root, rel = hit
+    src = os.path.join(root, "docs", "mem_contracts", "SOURCES.json")
+    if not os.path.exists(src):
+        yield (1, f"{rel} is memory-contract source but no manifests are "
+                  f"banked (docs/mem_contracts/SOURCES.json missing) "
+                  f"— {_MEM_REGEN}")
+        return
+    try:
+        with open(src, encoding="utf-8") as f:
+            recorded = json.load(f)
+    except (OSError, ValueError):
+        yield (1, f"docs/mem_contracts/SOURCES.json unreadable — {_MEM_REGEN}")
+        return
+    want = recorded.get(rel)
+    digest = hashlib.sha256(ctx.source.encode("utf-8")).hexdigest()
+    if want is None:
+        yield (1, f"{rel} is new memory-contract source not covered by "
+                  f"the banked manifests — {_MEM_REGEN}")
+    elif want != digest:
+        yield (1, f"{rel} changed since the memory manifests were banked "
+                  f"— {_MEM_REGEN}")
+
+
+# ---------------------------------------------------------------------------
+# queue-job-hygiene
+# ---------------------------------------------------------------------------
+
+# Queue files that predate the round-4/5 operational learnings this rule
+# codifies.  They are historical evidence of what actually ran — editing
+# them to satisfy the rule would falsify the record — so they are
+# excused EXPLICITLY here, never silently (the obs schema's
+# LEGACY_ALLOWLIST move).
+_LEGACY_QUEUES = frozenset({"tpu_queue_r3.json", "tpu_queue_r4.json"})
+
+# tools whose queue jobs burn chip minutes on measurements: they must
+# stream output unbuffered and arm the measured-or-die contract (kept
+# in sync with mem_model._BENCH_ARGV + tools/pallas_bench.py)
+_QUEUE_BENCH_TOOLS = ("bench.py", "int8_bench.py", "layout_ab.py",
+                      "scaling_bench.py", "feed_bench.py",
+                      "pallas_bench.py")
+
+
+def _is_trace_job(job: dict) -> bool:
+    argv = [str(a) for a in job.get("argv", [])]
+    return "--trace" in argv or str(job.get("name", "")).startswith("trace")
+
+
+def _queue_job_problems(fname: str, spec: dict) -> Iterator[str]:
+    """The per-queue checks, factored for fixture tests: yields one
+    message per violation in one parsed queue spec."""
+    jobs = spec.get("jobs", [])
+    seen_trace = False
+    for job in jobs:
+        name = str(job.get("name", "?"))
+        argv = [str(a) for a in job.get("argv", [])]
+        blob = " ".join(argv)
+        is_bench = any(t in blob for t in _QUEUE_BENCH_TOOLS)
+        if argv and argv[0].endswith("python") and "-u" not in argv[:3]:
+            yield (f"{fname}: job {name!r} runs python without -u — a "
+                   "deadline-killed job loses ALL buffered stdout "
+                   "(round-4 leg1: zero evidence banked)")
+        if is_bench and job.get("env", {}).get(
+                "SPARKNET_BENCH_REQUIRE_MEASURED") != "1":
+            yield (f"{fname}: bench/A-B job {name!r} does not arm "
+                   "SPARKNET_BENCH_REQUIRE_MEASURED=1 — a wedge "
+                   "mid-window would mark the job done with no "
+                   "measurement (round-5 learning; only the '1' value "
+                   "arms bench.py's contract)")
+        if _is_trace_job(job):
+            seen_trace = True
+        elif seen_trace:
+            yield (f"{fname}: job {name!r} is queued after a trace job — "
+                   "traces go LAST (2-for-2 correlated with window "
+                   "wedges in r1/r3)")
+
+
+@rule(
+    "queue-job-hygiene",
+    "tools/tpu_queue_*.json jobs must use python -u, arm "
+    "SPARKNET_BENCH_REQUIRE_MEASURED=1 on bench/A-B jobs, and queue "
+    "traces last",
+)
+def check_queue_job_hygiene(ctx: ModuleContext) -> Iterator[tuple[int, str]]:
+    """The window-runner queue contract, previously CLAUDE.md prose
+    (round-4/5 operational learnings), machine-checked.  Queue files are
+    JSON, not Python, so the rule anchors on the runner that consumes
+    them: linting ``tools/tpu_window_runner.py`` audits every sibling
+    ``tpu_queue_*.json``.  Legacy queues (already-run rounds, i.e.
+    historical evidence) are excused via ``_LEGACY_QUEUES`` explicitly.
+    Blind spot: a queue file living outside tools/ is not seen — the
+    runner's own docs point every round's queue at tools/.
+    """
+    base = os.path.basename(ctx.path)
+    if base != "tpu_window_runner.py":
+        return
+    tools_dir = os.path.dirname(os.path.abspath(ctx.path))
+    try:
+        queues = sorted(f for f in os.listdir(tools_dir)
+                        if re.fullmatch(r"tpu_queue_.*\.json", f))
+    except OSError:
+        return
+    for fname in queues:
+        if fname in _LEGACY_QUEUES:
+            continue
+        try:
+            with open(os.path.join(tools_dir, fname),
+                      encoding="utf-8") as f:
+                spec = json.load(f)
+        except (OSError, ValueError) as e:
+            yield (1, f"{fname}: unreadable queue file ({e}) — the "
+                      "runner's first read would crash at dial time")
+            continue
+        for msg in _queue_job_problems(fname, spec):
+            yield (1, msg)
+
+
 @rule(
     "no-pkill-self",
     "pkill -f matches the calling shell's own command line (exit 144); "

@@ -78,6 +78,16 @@ EVENTS: dict[str, tuple[dict, dict]] = {
         {"window_death": bool, "setup": bool},
     ),
     "queue_reload_failed": ({"error": str}, {}),
+    # the memcheck queue pre-flight refused a job whose predicted
+    # per-device footprint exceeds the chip (analysis/mem_model
+    # preflight_job against docs/mem_contracts/batch_fit.json): the job
+    # is marked dead WITHOUT burning a dial — the refusal, not a 25-min
+    # OOM-then-wedge, is the round's record of it
+    "preflight_oom": (
+        {"job": str, "model": str, "batch": int, "dtype": str,
+         "predicted_bytes": int, "budget_bytes": int},
+        {"note": str},
+    ),
     "setup_failed": ({"job": str, "note": str}, {}),
     "runner_done": ({"reason": str}, {"blocked_jobs": list}),
     # -- sparknet_tpu/obs Recorder (runtime telemetry) ------------------

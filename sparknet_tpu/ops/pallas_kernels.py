@@ -347,6 +347,57 @@ def _flash_diff_bwd(causal, interpret, res, g):
 _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
 
 
+def lrn_vmem_bytes(channels: int, itemsize: int = 4) -> int:
+    """Static VMEM bound for one ``_lrn_pallas`` grid cell at a given
+    channel-fiber depth.  Reads the kernel's actual tile constant so a
+    retuned ``_TILE`` moves the bound (and trips the banked memory
+    manifest) automatically.  Terms: the [1, C, _TILE] input and output
+    blocks, double-buffered by the pallas pipeline (x2 each), plus the
+    kernel's three fiber-sized temporaries (``sq``, the shifted-add
+    ``acc``, ``scale``)."""
+    fiber = channels * _TILE * itemsize
+    return (2 + 2 + 3) * fiber
+
+
+def flash_vmem_bytes(seq_len: int, head_dim: int, itemsize: int = 4) -> int:
+    """Static VMEM bound for one ``_flash_pallas`` grid cell.  The K/V
+    BlockSpecs keep the FULL [1, S, D] fiber resident (the kernel's
+    design: K is walked in ``_BK`` steps but never re-fetched), so the
+    bound is linear in sequence length — this formula is where the
+    kernel's long-context ceiling becomes arithmetic.  Terms: K+V full
+    fibers and Q+O ``_BQ`` blocks (each double-buffered, x2), plus the
+    f32 compute temporaries (q/o_acc [BQ, D], s/p [BQ, BK], the per-step
+    K/V f32 casts [BK, D], and the m/l running stats)."""
+    sk = seq_len + (-seq_len) % _BK
+    blocks = 2 * (2 * sk * head_dim) + 2 * (2 * _BQ * head_dim)
+    temps = 4 * (2 * _BQ * head_dim + 2 * _BQ * _BK
+                 + 2 * _BK * head_dim + 4 * _BQ)
+    return blocks * itemsize + temps
+
+
+def vmem_audit_points() -> list:
+    """The shapes the static VMEM audit (``analysis/memcheck.py``)
+    prices against the v5e budget: every pallas kernel at the largest
+    fiber any zoo family feeds it, plus a long-context planning point
+    for the flash kernel's full-fiber K/V residency.  Pure arithmetic —
+    importable and evaluable with zero chip time."""
+    return [
+        {"kernel": "lrn", "note": "alexnet/caffenet norm2 fiber (C=256, "
+                                  "f32, worst zoo LRN depth)",
+         "bytes": lrn_vmem_bytes(256)},
+        {"kernel": "lrn", "note": "googlenet conv2/norm2 fiber (C=192, "
+                                  "f32)",
+         "bytes": lrn_vmem_bytes(192)},
+        {"kernel": "flash", "note": "charlm default (S=128, D=16 per "
+                                    "head, f32)",
+         "bytes": flash_vmem_bytes(128, 16)},
+        {"kernel": "flash", "note": "long-context planning point "
+                                    "(S=8192, D=64, f32): the full-"
+                                    "fiber K/V BlockSpec's ceiling",
+         "bytes": flash_vmem_bytes(8192, 64)},
+    ]
+
+
 def flash_attention(q, k, v, causal: bool = False, force: str | None = None):
     """Blocked attention for [B, H, S, D]; ``force`` = 'pallas' |
     'interpret' | 'xla' | None (None consults ``SPARKNET_ATTN_IMPL``,

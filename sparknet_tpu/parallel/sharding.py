@@ -93,6 +93,23 @@ def _blob_spec(
     return P()
 
 
+def blob_shard_degree(
+    layer_type: str,
+    shape: tuple[int, ...],
+    model_size: int,
+    rules: ShardingRules | None = None,
+) -> int:
+    """How many ways one param blob actually splits under Megatron TP:
+    ``model_size`` when :func:`_blob_spec` shards its output-channel
+    axis, else 1 (replicated).  The single source for per-device
+    params+slots byte accounting (analysis/memcheck's batch-fit solver)
+    — pricing TP memory from the mesh width alone would credit the
+    min_tp_dim floor's replicated blobs with savings they don't have."""
+    rules = rules or ShardingRules()
+    spec = _blob_spec(layer_type, shape, model_size, rules)
+    return model_size if len(spec) else 1
+
+
 def param_shardings(
     net: Network,
     variables: NetVars,

@@ -158,6 +158,98 @@ def load_solver_net(solver_msg: Message, root: str = "") -> Message:
 DataFn = Callable[[int], dict[str, Any]]  # iteration -> feed dict
 
 
+def build_train_step(cfg: SolverConfig, net: Network, specs,
+                     debug: bool = False):
+    """The fused train step as a module-level builder:
+    ``step(variables, slots, it, feeds, key) -> (variables, slots,
+    loss)`` (plus a stats dict in debug mode).
+
+    Factored out of :class:`Solver` so consumers that must not
+    materialize a training state can build the SAME program the Solver
+    jits — the memcheck batch-fit solver traces this abstractly
+    (``jax.make_jaxpr`` over :func:`abstract_train_state` structs, no
+    arrays) to price a family's memory footprint, and its donation
+    accounting credits exactly the argnums-(0, 1) carry the Solver
+    donates below.  ``debug=None`` is not accepted here: the Solver
+    wrapper owns the config-following default."""
+
+    def loss_fn(params, state, feeds, rng):
+        # execution-time capture only in debug mode: the reductions
+        # are cheap but extra outputs would defeat fusion otherwise
+        sink: dict = {} if debug else None
+        _, new_state, loss = net.apply(
+            NetVars(params=params, state=state), feeds, rng=rng,
+            debug_sink=sink,
+        )
+        return loss, (new_state, sink if debug else {})
+
+    if cfg.remat:
+        loss_fn = jax.checkpoint(loss_fn)
+
+    def train_step(variables, slots, it, feeds, key):
+        rng = step_key(key, it)
+        if cfg.iter_size > 1:
+            # scan over micro-batches accumulating grads (ref: iter_size
+            # accumulation, solver.cpp:221-224 + Normalize)
+            def body(carry, micro):
+                gsum, state, lsum, k = carry
+                (loss, (new_state, fwd)), g = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(variables.params, state, micro, k)
+                gsum = jax.tree_util.tree_map(jnp.add, gsum, g)
+                return (
+                    (gsum, new_state, lsum + loss, jax.random.fold_in(k, 1)),
+                    fwd,  # debug: per-micro-batch means, last one shown
+                )
+
+            zero_g = jax.tree_util.tree_map(jnp.zeros_like, variables.params)
+            (grads, new_state, loss_sum, _), fwd_seq = jax.lax.scan(
+                body, (zero_g, variables.state, 0.0, rng), feeds
+            )
+            loss = loss_sum / cfg.iter_size
+            fwd = jax.tree_util.tree_map(lambda a: a[-1], fwd_seq)
+        else:
+            (loss, (new_state, fwd)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(variables.params, variables.state, feeds, rng)
+        rate = learning_rate(cfg, it)
+        new_params, new_slots = apply_update(
+            cfg, variables.params, grads, slots, specs, rate, it
+        )
+        out = NetVars(params=new_params, state=new_state), new_slots, loss
+        if not debug:
+            return out
+        stats = {
+            "forward": fwd,
+            "param": {
+                f"{ln}[{i}]": jnp.mean(jnp.abs(p))
+                for ln, plist in variables.params.items()
+                for i, p in enumerate(plist) if p.size
+            },
+            "diff": {
+                f"{ln}[{i}]": jnp.mean(jnp.abs(g))
+                for ln, glist in grads.items()
+                for i, g in enumerate(glist) if g.size
+            },
+        }
+        return (*out, stats)
+
+    return train_step
+
+
+def abstract_train_state(cfg: SolverConfig, net: Network):
+    """``(variables, slots)`` of a fresh training state as
+    ``ShapeDtypeStruct`` pytrees — ``jax.eval_shape`` over the same
+    ``net.init`` + ``init_slots`` path the Solver runs, so nothing
+    materializes (vgg16's half-gigabyte of params stays abstract).  The
+    memcheck batch-fit solver builds its footprint model from these."""
+    key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    variables = jax.eval_shape(net.init, key)
+    slots = jax.eval_shape(
+        lambda p: init_slots(cfg.solver_type, p), variables.params)
+    return variables, slots
+
+
 class Solver:
     """Drives training/eval of a prototxt-defined net.
 

@@ -13,6 +13,7 @@ All smoke-marked: the analyzer is stdlib-AST only, no jax dispatch.
 # graftlint: disable-file=no-pkill-self -- PKILL_BAD/PKILL_GOOD are this rule's own fixture strings
 
 import json
+import os
 
 import pytest
 
@@ -30,6 +31,8 @@ EXPECTED_RULES = {
     "stale-args-dispatch",
     "no-pkill-self",
     "graph-manifest-fresh",
+    "mem-manifest-fresh",
+    "queue-job-hygiene",
     "obs-fenced-span",
 }
 
@@ -478,6 +481,156 @@ def test_graph_manifest_fresh_ignores_non_contract_files(tmp_path):
     assert not hits(FRESH_SRC, "graph-manifest-fresh", path=str(other))
     # and plain fixture paths (no sparknet_tpu/ segment) never fire
     assert not hits(FRESH_SRC, "graph-manifest-fresh")
+
+
+# -- mem-manifest-fresh -----------------------------------------------------
+
+
+def _mem_tree(tmp_path, rel="sparknet_tpu/solvers/solver.py",
+              src=FRESH_SRC, record=True, stale=False):
+    """A fake repo: one memory-contract source file (+ optional
+    docs/mem_contracts/SOURCES.json recording its hash)."""
+    import hashlib
+    import json as _json
+
+    mod = tmp_path / rel
+    mod.parent.mkdir(parents=True)
+    mod.write_text(src)
+    if record:
+        digest = hashlib.sha256(src.encode()).hexdigest()
+        if stale:
+            digest = "0" * 64
+        cdir = tmp_path / "docs" / "mem_contracts"
+        cdir.mkdir(parents=True)
+        (cdir / "SOURCES.json").write_text(_json.dumps({rel: digest}))
+    return str(mod)
+
+
+def test_mem_manifest_fresh_positive_on_stale_hash(tmp_path):
+    path = _mem_tree(tmp_path, stale=True)
+    found = hits(FRESH_SRC, "mem-manifest-fresh", path=path)
+    assert len(found) == 1
+    assert "mem --update" in found[0].message
+
+
+def test_mem_manifest_fresh_positive_when_never_banked(tmp_path):
+    path = _mem_tree(tmp_path, rel="sparknet_tpu/ops/pallas_kernels.py",
+                     record=False)
+    found = hits(FRESH_SRC, "mem-manifest-fresh", path=path)
+    assert len(found) == 1
+    assert "SOURCES.json missing" in found[0].message
+
+
+def test_mem_manifest_fresh_suppressed(tmp_path):
+    path = _mem_tree(tmp_path, stale=True)
+    src = ("# graftlint: disable-file=mem-manifest-fresh -- "
+           "manifest regen follows in this PR\n" + FRESH_SRC)
+    assert not hits(src, "mem-manifest-fresh", path=path)
+    assert suppressed_hits(src, "mem-manifest-fresh", path=path)
+
+
+def test_mem_manifest_fresh_clean_when_hash_matches(tmp_path):
+    path = _mem_tree(tmp_path)
+    assert not hits(FRESH_SRC, "mem-manifest-fresh", path=path)
+
+
+def test_mem_manifest_fresh_ignores_non_contract_files(tmp_path):
+    # ops/vision.py changes the math, not the memory contract surface
+    other = tmp_path / "sparknet_tpu" / "ops" / "vision.py"
+    other.parent.mkdir(parents=True)
+    other.write_text(FRESH_SRC)
+    assert not hits(FRESH_SRC, "mem-manifest-fresh", path=str(other))
+    assert not hits(FRESH_SRC, "mem-manifest-fresh")
+
+
+# -- queue-job-hygiene ------------------------------------------------------
+
+RUNNER_SRC = "def main():\n    return 0\n"
+
+
+def _runner_tree(tmp_path, queues):
+    """A fake tools/ dir: the runner + queue JSON files beside it."""
+    import json as _json
+
+    tools = tmp_path / "tools"
+    tools.mkdir()
+    runner = tools / "tpu_window_runner.py"
+    runner.write_text(RUNNER_SRC)
+    for fname, spec in queues.items():
+        body = spec if isinstance(spec, str) else _json.dumps(spec)
+        (tools / fname).write_text(body)
+    return str(runner)
+
+
+def _bench_job(name, u=True, rm=True):
+    j = {"name": name,
+         "argv": ["python"] + (["-u"] if u else []) + ["bench.py"],
+         "deadline_s": 60}
+    if rm:
+        j["env"] = {"SPARKNET_BENCH_REQUIRE_MEASURED": "1"}
+    return j
+
+
+def _trace_job(name):
+    return {"name": name,
+            "argv": ["python", "-u", "-m", "sparknet_tpu.cli", "time",
+                     "--trace"],
+            "deadline_s": 60}
+
+
+def test_queue_hygiene_flags_all_three_contracts(tmp_path):
+    path = _runner_tree(tmp_path, {"tpu_queue_r9.json": {"jobs": [
+        _bench_job("no_unbuffered", u=False),
+        _bench_job("no_measured", rm=False),
+        _trace_job("trace_early"),
+        _bench_job("after_trace"),
+    ]}})
+    found = hits(RUNNER_SRC, "queue-job-hygiene", path=path)
+    msgs = "\n".join(f.message for f in found)
+    assert len(found) == 3
+    assert "no_unbuffered" in msgs and "without -u" in msgs
+    assert "no_measured" in msgs and "REQUIRE_MEASURED" in msgs
+    assert "after_trace" in msgs and "LAST" in msgs
+
+
+def test_queue_hygiene_legacy_queues_excused(tmp_path):
+    bad = {"jobs": [_bench_job("no_measured", rm=False)]}
+    path = _runner_tree(tmp_path, {"tpu_queue_r3.json": bad,
+                                   "tpu_queue_r4.json": bad})
+    assert not hits(RUNNER_SRC, "queue-job-hygiene", path=path)
+
+
+def test_queue_hygiene_unreadable_queue_is_flagged(tmp_path):
+    path = _runner_tree(tmp_path, {"tpu_queue_r9.json": "{not json"})
+    found = hits(RUNNER_SRC, "queue-job-hygiene", path=path)
+    assert len(found) == 1
+    assert "unreadable" in found[0].message
+
+
+def test_queue_hygiene_clean_queue_passes(tmp_path):
+    path = _runner_tree(tmp_path, {"tpu_queue_r9.json": {
+        "jobs": [_bench_job("headline"), _trace_job("trace_last")],
+        "setup": [{"name": "fixture", "argv": ["python", "x.py"]}],
+    }})
+    assert not hits(RUNNER_SRC, "queue-job-hygiene", path=path)
+
+
+def test_queue_hygiene_only_fires_from_the_runner(tmp_path):
+    """Another tool in the same dir must not re-report every queue."""
+    path = _runner_tree(tmp_path, {"tpu_queue_r9.json": {"jobs": [
+        _bench_job("no_measured", rm=False)]}})
+    other = os.path.join(os.path.dirname(path), "tunnel_log.py")
+    assert hits(RUNNER_SRC, "queue-job-hygiene", path=path)
+    assert not hits(RUNNER_SRC, "queue-job-hygiene", path=other)
+
+
+def test_queue_hygiene_suppressible(tmp_path):
+    path = _runner_tree(tmp_path, {"tpu_queue_r9.json": {"jobs": [
+        _bench_job("no_measured", rm=False)]}})
+    src = ("# graftlint: disable-file=queue-job-hygiene -- "
+           "fixture queue under construction\n" + RUNNER_SRC)
+    assert not hits(src, "queue-job-hygiene", path=path)
+    assert suppressed_hits(src, "queue-job-hygiene", path=path)
 
 
 # -- obs-fenced-span --------------------------------------------------------

@@ -1,0 +1,61 @@
+"""The full memcheck mode sweep + batch-fit verification vs the bank.
+
+Slow-marked twin of tests/test_memcheck.py's solo+dp smoke gate: every
+registered parallel mode (plus the ``kernels`` VMEM audit) is traced on
+the virtual 8-device mesh and diffed against ``docs/mem_contracts/``,
+and the batch-fit solver re-derives a representative family slice
+(cheap vehicle, conv family with TP-shardable fc blobs, the
+sequence-parallel transformer row) against the banked table.  CLI
+equivalents: ``python -m sparknet_tpu.analysis mem`` / ``mem --fit``
+(regenerate with ``--update``).
+"""
+
+import pytest
+
+from sparknet_tpu.analysis.mem_model import (
+    HBM_USABLE_FRAC,
+    PEAK_RATIO_WINDOW,
+    RESIDENCY_TOL_BYTES,
+    V5E_HBM_BYTES,
+)
+from sparknet_tpu.analysis.memcheck import run_batch_fit, run_memcheck
+from sparknet_tpu.parallel.modes import list_modes
+
+pytestmark = pytest.mark.slow
+
+
+def test_memcheck_full_sweep_is_clean():
+    findings, manifests = run_memcheck()
+    bad = [f for f in findings if not f.suppressed]
+    assert not bad, "\n".join(
+        f"{f.path}: [{f.rule}] {f.message}" for f in bad)
+    assert set(manifests) == set(list_modes()) | {"kernels"}
+    budget = int(V5E_HBM_BYTES * HBM_USABLE_FRAC)
+    lo, hi = PEAK_RATIO_WINDOW
+    for mode, manifest in manifests.items():
+        if mode == "kernels":
+            assert all(p["fits"] for p in manifest["contract"]["points"])
+            continue
+        c = manifest["contract"]
+        assert c["residency_delta_bytes"] <= RESIDENCY_TOL_BYTES, mode
+        assert lo <= c["peak_ratio"] <= hi, mode
+        assert max(c["analytic"]["peak_bytes"],
+                   c["xla"]["peak_bytes"]) < budget, mode
+
+
+def test_batch_fit_representative_families_match_bank():
+    """Re-deriving a slice of the banked table must diff clean — the
+    pre-flight's pricing source is reproducible, not a stale artifact."""
+    findings, table = run_batch_fit(
+        families=["cifar10_quick", "alexnet", "transformer"])
+    bad = [f for f in findings if not f.suppressed]
+    assert not bad, "\n".join(
+        f"{f.path}: [{f.rule}] {f.message}" for f in bad)
+    alex = table["families"]["alexnet"]
+    # TP must actually shave the fc-heavy params+slots on alexnet
+    for dtype in ("f32", "bf16"):
+        assert (alex[dtype]["tp_params_slots_bytes"]
+                < alex[dtype]["params_slots_bytes"])
+    # the sequence-parallel divisor only prices the transformer row
+    assert "sp" in table["families"]["transformer"]["f32"]["max_batch"]
+    assert "sp" not in alex["f32"]["max_batch"]

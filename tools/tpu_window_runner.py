@@ -67,6 +67,11 @@ if REPO not in sys.path:  # standalone invocation: tools/ is not a package
 # the ledger and its readers (tunnel_log, the obs report, the judge's
 # validator) can never drift apart again
 from sparknet_tpu.obs import schema  # noqa: E402
+# queue pre-flight: predicted-OOM jobs are refused before any dial
+# (mem_model is stdlib-only by contract — importing it here can never
+# initialize a backend; the fit table it prices against is banked by
+# `python -m sparknet_tpu.analysis mem --fit --update`)
+from sparknet_tpu.analysis import mem_model  # noqa: E402
 # Overridden from the queue spec's "evidence_dir" in main().  The module
 # default stays evidence_r3 for backward compatibility: the r3 queue file
 # predates the key, and changing its journal location would break resume
@@ -75,6 +80,20 @@ EVIDENCE_DIR = os.path.join(REPO, "docs", "evidence_r3")
 JOURNAL = os.path.join(EVIDENCE_DIR, "journal.jsonl")
 
 DIAL_CODE = "import jax; print(jax.devices()[0].platform)"
+
+# the banked batch-fit table the pre-flight prices queue jobs against;
+# absent table = pre-flight passes everything (it exists to SAVE dials,
+# never to block jobs it cannot price)
+FIT_TABLE_PATH = os.path.join(REPO, "docs", "mem_contracts",
+                              "batch_fit.json")
+
+
+def load_fit_table() -> dict:
+    try:
+        with open(FIT_TABLE_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
 
 # A failed dial normally takes ~25 min (the axon client's own retry
 # budget) and is therefore its own backoff; but a FAST failure (plugin
@@ -286,13 +305,46 @@ def main() -> int:
                          "windows and exhaust max_attempts; fix the setup "
                          "script and restart the runner"})
 
+    # Queue pre-flight (memcheck): a job whose predicted per-device
+    # footprint exceeds the chip is refused OUTRIGHT — journaled as
+    # preflight_oom and marked dead without ever dialing (an OOM job in
+    # a healthy window burns the whole window for nothing; VERDICT r5
+    # counted 2 healthy windows in 22 dials).  The journal seed keeps a
+    # restarted runner from re-journaling refusals it already recorded;
+    # the verdict itself is always recomputed, so re-banking the fit
+    # table un-refuses a job with no journal surgery.
+    refused_logged: set[str] = set()
+    for ev in schema.iter_events(JOURNAL, "preflight_oom"):
+        refused_logged.add(ev.get("job", ""))
+
+    def preflight_ok(job: dict, fit_table: dict) -> bool:
+        """True = dispatchable; False = predicted OOM (journaled once)."""
+        verdict = mem_model.preflight_job(job, fit_table)
+        if verdict is None or verdict["fits"]:
+            return True
+        if job["name"] not in refused_logged:
+            refused_logged.add(job["name"])
+            log({"event": "preflight_oom", "job": job["name"],
+                 "model": verdict["model"], "batch": verdict["batch"],
+                 "dtype": verdict["dtype"],
+                 "predicted_bytes": verdict["predicted_bytes"],
+                 "budget_bytes": verdict["budget_bytes"],
+                 "note": "refused before dial; re-bank docs/"
+                         "mem_contracts/batch_fit.json or shrink the "
+                         "job's batch to requeue"})
+        return False
+
     def next_pending(spec: dict, skip: set[str] = frozenset()):
         """(job, blocked): the next runnable job, plus the set of non-green
-        jobs that can never run again — exhausted attempts, a 'needs'
-        naming a job not in the queue, or (transitively) a dead dependency.
-        With that fixpoint, runnable=None and blocked=[] together mean
-        every job is green."""
+        jobs that can never run again — exhausted attempts, a predicted
+        OOM (pre-flight refusal), a 'needs' naming a job not in the
+        queue, or (transitively) a dead dependency.  With that fixpoint,
+        runnable=None and blocked=[] together mean every job is green."""
         max_attempts = int(spec.get("max_attempts", 3))
+        # re-read like the queue itself: a fit table re-banked mid-round
+        # (after shrinking a refused job's batch) is picked up without a
+        # runner restart
+        fit_table = load_fit_table()
         # deadline kills don't count as failures (the window closed, not
         # the job), but a job that hangs over and over even so gets its
         # own, more generous cap — otherwise one pathological hang could
@@ -312,6 +364,7 @@ def main() -> int:
                 need = j.get("needs")
                 if (state.get(n, 0) >= max_attempts
                         or timeouts.get(n, 0) >= max_timeouts
+                        or not preflight_ok(j, fit_table)
                         or (need and (need not in names or need in dead))):
                     dead.add(n)
                     changed = True
