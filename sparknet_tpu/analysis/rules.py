@@ -705,6 +705,81 @@ def check_queue_job_hygiene(ctx: ModuleContext) -> Iterator[tuple[int, str]]:
             yield (1, msg)
 
 
+# ---------------------------------------------------------------------------
+# feed-shm-cleanup
+# ---------------------------------------------------------------------------
+
+# function names that count as a cleanup path: unlink() reached from any
+# of these always runs on teardown (finally-block unlinks qualify too)
+_SHM_CLEANUP_SCOPES = frozenset(
+    {"close", "unlink", "cleanup", "_cleanup", "__exit__", "__del__",
+     "teardown", "tearDown"})
+
+
+def _creates_shared_memory(call: ast.Call) -> bool:
+    if call_name(call) != "SharedMemory":
+        return False
+    return any(kw.arg == "create" and isinstance(kw.value, ast.Constant)
+               and kw.value.value is True for kw in call.keywords)
+
+
+def _has_finally_unlink(tree: ast.AST) -> bool:
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Try):
+            for stmt in n.finalbody:
+                for sub in ast.walk(stmt):
+                    if (isinstance(sub, ast.Call)
+                            and call_name(sub) == "unlink"):
+                        return True
+    return False
+
+
+def _has_cleanup_scope_unlink(ctx: ModuleContext) -> bool:
+    for scope in ctx.scopes():
+        if scope.name not in _SHM_CLEANUP_SCOPES:
+            continue
+        if any(call_name(c) == "unlink" for c in scope.calls()):
+            return True
+    return False
+
+
+@rule(
+    "feed-shm-cleanup",
+    "SharedMemory(create=True) must be paired with an unlink() on a "
+    "finally/close teardown path — /dev/shm segments outlive the "
+    "process and leak host RAM",
+)
+def check_feed_shm_cleanup(ctx: ModuleContext) -> Iterator[tuple[int, str]]:
+    """A shared-memory ring that dies without ``unlink`` leaves its
+    segment pinned in ``/dev/shm`` until reboot — on the evidence box
+    that is training-batch-sized host RAM gone per leaked run, invisible
+    until allocation fails mid-window.  Any module that calls
+    ``SharedMemory(create=True)`` must also call ``unlink()`` somewhere
+    teardown-shaped: inside a ``finally`` block, or inside a function
+    named like a cleanup path (``close``/``unlink``/``cleanup``/
+    ``__exit__``/``__del__``/``teardown``).  Attach-side opens
+    (``SharedMemory(name=...)``, no ``create=True``) are exempt — the
+    creator owns the lifetime (``data/pipeline.py`` contract).
+
+    Blind spot: an unlink inside an ordinary helper the teardown calls
+    indirectly is not recognized — route it through a conventionally
+    named cleanup method (which is also where readers look for it).
+    """
+    has_cleanup = (_has_finally_unlink(ctx.tree)
+                   or _has_cleanup_scope_unlink(ctx))
+    for n in ast.walk(ctx.tree):
+        if isinstance(n, ast.Call) and _creates_shared_memory(n):
+            if not has_cleanup:
+                yield (
+                    n.lineno,
+                    "SharedMemory(create=True) with no unlink() on any "
+                    "finally/close teardown path in this module — the "
+                    "segment outlives the process in /dev/shm; pair "
+                    "creation with unlink in a close()/finally path "
+                    "(see data/pipeline.py ProcessPipeline.close)",
+                )
+
+
 @rule(
     "no-pkill-self",
     "pkill -f matches the calling shell's own command line (exit 144); "

@@ -171,7 +171,10 @@ def _internalize(fn):
     cursors, listfile sources — every real data plane emits blob order)
     arrive in the INTERNAL layout (``Config.layout``, ops/layout.py).
     A passthrough under nchw; preserves an attached ``device_fn``
-    (whose DeviceAugment already speaks the internal layout)."""
+    (whose DeviceAugment already speaks the internal layout) and
+    ``pipeline_factory`` (whose sources produce the internal layout
+    NATIVELY — the process feed never pays this per-batch transpose,
+    which is the wire half of the nhwc zero-transpose contract)."""
     from sparknet_tpu.ops.layout import feeds_to_internal, is_nhwc
 
     if fn is None or not is_nhwc():
@@ -180,39 +183,40 @@ def _internalize(fn):
     def wrapped(it):
         return feeds_to_internal(fn(it))
 
-    if hasattr(fn, "device_fn"):
-        wrapped.device_fn = fn.device_fn
+    for attr in ("device_fn", "pipeline_factory"):
+        if hasattr(fn, attr):
+            setattr(wrapped, attr, getattr(fn, attr))
     return wrapped
 
 
 def _attach_device_augment(train_fn, cfg, pid, seed=None):
-    """Attach the in-XLA transform as the prefetcher's ``device_fn`` —
-    one key policy for every source (deterministic per process, like the
-    host transformer's ``seed=1234 + pid``; hosts decorrelate by pid,
-    and ``--seed`` offsets the whole family so reruns can decorrelate)."""
-    import jax as _jax
-
+    """Attach the in-XLA transform as the async feed's ``device_fn`` —
+    the key policy lives in :meth:`DeviceAugment.device_fn`, shared by
+    the threaded prefetcher and the process pipeline's device stage."""
     from sparknet_tpu.data import DeviceAugment
 
     try:
         aug = DeviceAugment(cfg)
     except ValueError as e:
         raise SystemExit(f"transform_param: {e}") from None
-    base_key = _jax.random.key(1234 + pid + (seed or 0))
-    train_fn.device_fn = lambda feeds, it: {
-        **feeds,
-        "data": aug(feeds["data"], _jax.random.fold_in(base_key, it)),
-    }
+    train_fn.device_fn = aug.device_fn(pid, seed)
     return train_fn
+
+
+def _feed_mode() -> str:
+    """The run's host feed architecture (``Config.feed``)."""
+    from sparknet_tpu.common import get_config
+
+    return get_config().feed
 
 
 def _device_augment_guards(args):
     """Shared preconditions for --augment device (any source)."""
-    if getattr(args, "prefetch", 0) <= 0:
+    if getattr(args, "prefetch", 0) <= 0 and _feed_mode() != "process":
         raise SystemExit(
             "--augment device rides the async feed: pass --prefetch N "
-            "(the DeviceAugment dispatch belongs on the prefetch thread, "
-            "not the step loop)")
+            "or --feed process (the DeviceAugment dispatch belongs on "
+            "the feed's device stage, not the step loop)")
     if (getattr(args, "tau", 1) > 1
             or getattr(args, "distributed", False)
             or getattr(args, "elastic_alpha", 0.0) > 0):
@@ -345,6 +349,43 @@ def _data_fns(args, net, test_net=None):
             raise SystemExit(
                 f"--batch {batch} exceeds dataset size {min(len(ytr), len(yte))}")
 
+        def _cifar_pipeline_factory(transform_cfg):
+            """Process-feed twin of the threaded cifar stream: raw batch
+            slices are index-pure (same modulo walk as the thread path),
+            the host transform — when any — runs IN the workers, and the
+            wire is reoriented ONCE at source build under nhwc (the
+            per-batch `_internalize` transpose never happens)."""
+
+            def factory(num_batches, start_index=0, workers=None):
+                from sparknet_tpu.data.pipeline import (
+                    DataFnSource,
+                    ProcessPipeline,
+                    TransformStage,
+                )
+                from sparknet_tpu.ops.layout import is_nhwc
+
+                lay = "nhwc" if is_nhwc() else "nchw"
+                xs = (np.ascontiguousarray(xtr.transpose(0, 2, 3, 1))
+                      if lay == "nhwc" else xtr)
+
+                def raw_fn(it):
+                    lo = ((it * nproc + pid) * batch) % (len(ytr) - batch + 1)
+                    return {
+                        "data": xs[lo : lo + batch],
+                        "label": ytr[lo : lo + batch].astype(np.int32),
+                    }
+
+                stage = None
+                if transform_cfg is not None:
+                    stage = TransformStage(transform_cfg, train=True,
+                                           layout=lay)
+                return ProcessPipeline(
+                    DataFnSource(raw_fn), stage, num_batches=num_batches,
+                    start_index=start_index, workers=workers,
+                    name="feed.cifar")
+
+            return factory
+
         if getattr(args, "augment", "host") == "device":
             # ship raw uint8 over the feed link; mean-subtract runs
             # in-graph via DeviceAugment in the prefetcher's device_fn
@@ -360,6 +401,7 @@ def _data_fns(args, net, test_net=None):
 
             _attach_device_augment(train_fn, xform_cfg, pid,
                                    seed=getattr(args, "seed", None))
+            train_fn.pipeline_factory = _cifar_pipeline_factory(None)
         else:
             def train_fn(it):
                 lo = ((it * nproc + pid) * batch) % (len(ytr) - batch + 1)
@@ -367,6 +409,8 @@ def _data_fns(args, net, test_net=None):
                     "data": xform(xtr[lo : lo + batch], True),
                     "label": ytr[lo : lo + batch].astype(np.int32),
                 }
+
+            train_fn.pipeline_factory = _cifar_pipeline_factory(xform_cfg)
 
         def test_fn(b):
             # eval streams stay IDENTICAL across processes (only training
@@ -588,6 +632,33 @@ def _data_fns(args, net, test_net=None):
                 "label": rs2.randint(0, num_classes, batch).astype(np.int32),
             }
 
+        def _synth_pipeline_factory(num_batches, start_index=0,
+                                    workers=None):
+            """Process-feed twin: per-INDEX stateless seeding (workers
+            cannot share synth_train's sequential RandomState; synthetic
+            batches carry no identity worth preserving, and determinism
+            per (pid, index) keeps the worker assignment pure).
+            ``data_shape`` is already the INTERNAL layout — synthesis IS
+            the wire, zero transposes in either orientation."""
+            from sparknet_tpu.data.pipeline import (
+                DataFnSource,
+                ProcessPipeline,
+            )
+
+            def indexed(it):
+                rs2 = np.random.RandomState(
+                    (pid * 1_000_003 + it) & 0x7FFFFFFF)
+                return {
+                    "data": (rs2.randn(*data_shape) * 50).astype(np.float32),
+                    "label": rs2.randint(0, num_classes, batch).astype(np.int32),
+                }
+
+            return ProcessPipeline(
+                DataFnSource(indexed), num_batches=num_batches,
+                start_index=start_index, workers=workers,
+                name="feed.synthetic")
+
+        synth_train.pipeline_factory = _synth_pipeline_factory
         return synth_train, synth_test
 
     raise SystemExit(f"unknown --data source {args.data!r}")
@@ -630,6 +701,48 @@ def _load_weights_into(
 
 
 # ---------------------------------------------------------------------------
+def _process_feed(train_fn, num_batches, start_index, args, log,
+                  device_stage=True):
+    """``Config.feed == "process"``: swap the thread feed for the
+    shared-memory pipeline (``data/pipeline.py``).  Returns
+    ``(context, data_fn)`` — the context owns the ring + (optionally)
+    the double-buffered device-put stage and must wrap the train loop;
+    the data_fn serves the solver's feed contract.
+
+    ``device_stage=False`` keeps feeds HOST-side (the ParallelTrainer
+    packs tau/global batches itself and owns its own device_put)."""
+    import contextlib
+
+    factory = getattr(train_fn, "pipeline_factory", None)
+    if factory is None:
+        raise SystemExit(
+            "--feed process is wired to the synthetic and cifar: sources "
+            "(index-addressable streams a worker process can re-produce "
+            "deterministically); db:/proto cursors are stateful — keep "
+            "--feed threaded there")
+    stack = contextlib.ExitStack()
+    pipe = stack.enter_context(factory(
+        num_batches=num_batches, start_index=start_index,
+        workers=getattr(args, "feed_workers", 0) or None))
+    if device_stage:
+        from sparknet_tpu.data.pipeline import device_feed
+
+        pf = stack.enter_context(device_feed(
+            pipe, depth=max(getattr(args, "prefetch", 0), 2),
+            device_fn=getattr(train_fn, "device_fn", None)))
+        it = iter(pf)
+        fn = lambda _it: next(it)  # noqa: E731 — the solver feed contract
+    else:
+        # trainer feeds stay host-side; _stack_tau/_widen_batch consume
+        # via np.concatenate before the next call, inside the ring's
+        # view-lifetime window
+        fn = pipe.as_data_fn()
+    log(f"feed: process pipeline ({pipe.workers} worker(s), "
+        f"{pipe.slots} slots x {pipe.spec.slot_bytes:,} B"
+        f"{', device stage' if device_stage else ''})")
+    return stack, fn
+
+
 def cmd_train(args) -> int:
     """ref: caffe.cpp:153-218 train()."""
     import jax
@@ -717,10 +830,19 @@ def cmd_train(args) -> int:
                 solver, tau=args.tau, elastic_alpha=args.elastic_alpha
             )
             outer = -(-iters // max(args.tau, 1))  # ceil: run >= requested
+            feed_ctx = contextlib.nullcontext()
+            if _feed_mode() == "process":
+                # one host-side pipeline feeds the whole tau round; the
+                # trainer keeps packing + device_put (its feeds carry
+                # the [tau, B*workers] contract, not per-batch puts)
+                feed_ctx, train_fn = _process_feed(
+                    train_fn,
+                    outer * max(args.tau, 1) * trainer.num_local_workers,
+                    0, args, log, device_stage=False)
             tau_fn = _stack_tau(train_fn, args.tau, trainer.num_local_workers)
             wide_fn = _widen_batch(train_fn, trainer.num_local_workers)
             scan_n = max(getattr(args, "scan", 1), 1)
-            with SignalHandler() as sig:
+            with feed_ctx, SignalHandler() as sig:
                 o = 0
                 while o < outer:
                     if args.tau > 1 or elastic:
@@ -756,7 +878,13 @@ def cmd_train(args) -> int:
             import contextlib
 
             pf_ctx = contextlib.nullcontext()
-            if getattr(args, "prefetch", 0) > 0:
+            if _feed_mode() == "process":
+                # multi-process shared-memory feed + double-buffered
+                # device stage (data/pipeline.py); streams from
+                # solver.iter so snapshot resume continues the sequence
+                pf_ctx, train_fn = _process_feed(
+                    train_fn, iters, solver.iter, args, log)
+            elif getattr(args, "prefetch", 0) > 0:
                 # async host->HBM feed (the BasePrefetchingDataLayer role):
                 # the worker thread transforms + device_puts ahead of the
                 # step.  Streams from solver.iter so snapshot resume
@@ -1679,6 +1807,17 @@ def main(argv=None) -> int:
     sp.add_argument("--prefetch", type=int, default=0,
                     help="async device-feed queue depth (0 = off; the "
                     "reference's PREFETCH_COUNT is 3)")
+    sp.add_argument("--feed", default="",
+                    choices=["", "threaded", "process"],
+                    help="host feed architecture (Config.feed): threaded "
+                    "(default — daemon-thread prefetcher, bit-identical "
+                    "legacy path) or process (multi-process shared-memory "
+                    "ring, data/pipeline.py: decode+transform escape the "
+                    "GIL; synthetic and cifar: sources; SPARKNET_FEED "
+                    "seeds the default)")
+    sp.add_argument("--feed-workers", type=int, default=0,
+                    help="process-feed worker count (0 = auto: "
+                    "SPARKNET_FEED_WORKERS or min(cpus, 4))")
     sp.add_argument("--augment", choices=["host", "device"], default="host",
                     help="where the data transform runs: host (numpy/C++ "
                     "DataTransformer) or device (ship uint8, "
@@ -1902,6 +2041,9 @@ def main(argv=None) -> int:
         # same discipline for the internal layout knob (ops/layout.py):
         # trace-time config, scoped to this brew
         overrides["layout"] = args.layout
+    if getattr(args, "feed", ""):
+        # host feed architecture (data/pipeline.py) — scoped like layout
+        overrides["feed"] = args.feed
     if overrides:
         from sparknet_tpu.common import get_config, set_config
 

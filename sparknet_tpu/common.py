@@ -58,6 +58,14 @@ class Config:
     compute_dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
     layout: str = os.environ.get("SPARKNET_LAYOUT", "nchw").lower()
+    # Host feed architecture: ``"threaded"`` (default — the daemon-thread
+    # DevicePrefetcher, bit-identical to the pre-pipeline feed) or
+    # ``"process"`` (multi-process shared-memory ring, ``data/pipeline.py``
+    # — decode/transform escape the GIL; opt-in until the A/B clears the
+    # promote rule).  Like ``layout``, read where feeds are BUILT (the CLI
+    # and app loops), not inside jitted programs; ``SPARKNET_FEED`` seeds
+    # the default, ``tpunet train --feed`` flips it per run.
+    feed: str = os.environ.get("SPARKNET_FEED", "threaded").lower()
     # Default mesh axis names: data parallelism over 'data', within-layer
     # (tensor) sharding over 'model', sequence/context parallelism over
     # 'seq' (ring / Ulysses attention).
@@ -106,6 +114,12 @@ def set_config(**overrides) -> Config:
             raise ValueError(f"layout must be 'nchw' or 'nhwc', got "
                              f"{overrides['layout']!r}")
         overrides = {**overrides, "layout": lay}
+    if "feed" in overrides:
+        feed = str(overrides["feed"]).lower()
+        if feed not in ("threaded", "process"):
+            raise ValueError(f"feed must be 'threaded' or 'process', got "
+                             f"{overrides['feed']!r}")
+        overrides = {**overrides, "feed": feed}
     with _lock:
         _config = dataclasses.replace(_config, **overrides)
     return _config

@@ -25,3 +25,14 @@ from sparknet_tpu.data.minibatch import (  # noqa: F401
 )
 from sparknet_tpu.data.archive import ImageNetLoader, list_archive_samples  # noqa: F401
 from sparknet_tpu.data.prefetch import DevicePrefetcher  # noqa: F401
+from sparknet_tpu.data.pipeline import (  # noqa: F401
+    ArraySource,
+    BatchSource,
+    DataFnSource,
+    FeedSpec,
+    PrestagedSource,
+    ProcessPipeline,
+    SyntheticImageSource,
+    TransformStage,
+    device_feed,
+)

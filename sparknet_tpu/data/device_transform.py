@@ -103,3 +103,24 @@ class DeviceAugment:
         if cfg.scale != 1.0:
             x = x * cfg.scale
         return x
+
+    def device_fn(self, pid: int = 0, seed: int | None = None,
+                  key_name: str = "data"):
+        """The async-feed adapter: a ``device_fn(feeds, it)`` for the
+        threaded prefetcher (:class:`~sparknet_tpu.data.prefetch.
+        DevicePrefetcher`) or the process pipeline's device stage
+        (:func:`~sparknet_tpu.data.pipeline.device_feed`) — one key
+        policy for every source and both feed architectures
+        (deterministic per process like the host transformer's
+        ``seed=1234 + pid``; hosts decorrelate by pid, ``seed`` offsets
+        the whole family so reruns can decorrelate)."""
+        import jax
+
+        base_key = jax.random.key(1234 + pid + (seed or 0))
+
+        def fn(feeds, it):
+            return {**feeds,
+                    key_name: self(feeds[key_name],
+                                   jax.random.fold_in(base_key, it))}
+
+        return fn

@@ -21,15 +21,22 @@ from typing import Iterable, Iterator
 import numpy as np
 
 
-def decode_jpeg(data: bytes, height: int, width: int) -> np.ndarray | None:
-    """Decode + force-resize to (3, height, width) uint8; None if broken
-    (the reference drops undecodable images, ScaleAndConvert.scala:19-26)."""
+def decode_jpeg(data: bytes, height: int, width: int,
+                layout: str = "nchw") -> np.ndarray | None:
+    """Decode + force-resize to uint8 — (3, height, width) under nchw,
+    (height, width, 3) under nhwc; None if broken (the reference drops
+    undecodable images, ScaleAndConvert.scala:19-26).
+
+    Decoders produce HWC: the nhwc wire order is the decoder's NATIVE
+    output and skips the per-image transpose entirely — the host half of
+    the zero-transpose channels-last feed (``ops/layout.py`` contract)."""
     from PIL import Image  # outside the guard: a missing dep must fail loud
 
     try:
         img = Image.open(io.BytesIO(data)).convert("RGB")
         img = img.resize((width, height))  # force-resize, no aspect keep
-        return np.asarray(img, np.uint8).transpose(2, 0, 1)
+        arr = np.asarray(img, np.uint8)
+        return arr if layout == "nhwc" else arr.transpose(2, 0, 1)
     except Exception:
         return None
 
@@ -50,32 +57,38 @@ def decode_workers(cap: int = 8) -> int:
     return min(_os.cpu_count() or 1, cap)
 
 
-def _decoded_pairs(samples, height, width, workers, chunk):
-    """(decoded_or_None, label) stream; ``workers`` > 1 decodes each
-    ``chunk``-sized run of samples through a thread pool (PIL's C decode
-    path releases the GIL — the multi-core TPU-VM analog of the
-    reference's per-executor decode parallelism).  Order is preserved
-    either way; time-to-first-pair buffers at most ``chunk`` samples."""
+def _decoded_pairs(samples, height, width, workers, chunk,
+                   layout="nchw"):
+    """(decoded_or_None, label) stream; ``workers`` > 1 decodes through a
+    thread pool (PIL's C decode path releases the GIL — the multi-core
+    TPU-VM analog of the reference's per-executor decode parallelism).
+
+    The pool stage is PIPELINED: up to ``chunk`` decodes stay in flight
+    ahead of the consumer, refilled one-for-one as results are yielded —
+    the pre-fix version flushed ``pool.map`` a batch at a time, so every
+    chunk boundary drained the pool and serialized decode against
+    iteration.  Output order is identical to the serial path either way
+    (FIFO completion window); time-to-first-pair still buffers at most
+    ``chunk`` samples."""
     if workers <= 1:
         for data, label in samples:
-            yield decode_jpeg(data, height, width), label
+            yield decode_jpeg(data, height, width, layout), label
         return
+    from collections import deque
     from concurrent.futures import ThreadPoolExecutor
 
     with ThreadPoolExecutor(workers, thread_name_prefix="decode") as pool:
-        buf: list = []
-
-        def flush(buf):
-            arrs = pool.map(lambda s: decode_jpeg(s[0], height, width), buf)
-            yield from zip(arrs, (label for _, label in buf))
-
-        for s in samples:
-            buf.append(s)
-            if len(buf) >= chunk:
-                yield from flush(buf)
-                buf = []
-        if buf:
-            yield from flush(buf)
+        window: deque = deque()  # (future, label), submission order
+        for data, label in samples:
+            window.append(
+                (pool.submit(decode_jpeg, data, height, width, layout),
+                 label))
+            if len(window) >= chunk:
+                fut, lbl = window.popleft()  # blocks only on the OLDEST
+                yield fut.result(), lbl
+        while window:
+            fut, lbl = window.popleft()
+            yield fut.result(), lbl
 
 
 def make_minibatches_compressed(
@@ -84,16 +97,19 @@ def make_minibatches_compressed(
     height: int,
     width: int,
     workers: int = 0,
+    layout: str = "nchw",
 ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
-    """(jpeg_bytes, label) stream -> (images NCHW uint8, labels) minibatches;
-    broken images and the ragged tail dropped (ref:
-    ScaleAndConvert.scala:45-70).  ``workers``: 0 = ``decode_workers()``,
-    1 = serial, >1 = thread-pooled decode (identical output)."""
+    """(jpeg_bytes, label) stream -> (images uint8, labels) minibatches in
+    the wire ``layout`` (NCHW default; nhwc packs the decoder's native
+    HWC with no transpose); broken images and the ragged tail dropped
+    (ref: ScaleAndConvert.scala:45-70).  ``workers``: 0 =
+    ``decode_workers()``, 1 = serial, >1 = thread-pooled decode
+    (identical output)."""
     if workers == 0:
         workers = decode_workers()
     imgs, labels = [], []
     for arr, label in _decoded_pairs(samples, height, width, workers,
-                                     chunk=batch_size):
+                                     chunk=batch_size, layout=layout):
         if arr is None:
             continue
         imgs.append(arr)

@@ -72,8 +72,28 @@ class TransformConfig:
 
 
 class DataTransformer:
-    def __init__(self, config: TransformConfig):
+    """``layout`` is the WIRE orientation of the batches this instance
+    transforms: ``"nchw"`` (default, Caffe blob order — byte-identical
+    to the pre-layout code) or ``"nhwc"`` (channels-last, the order
+    image bytes arrive off the wire; the process feed's workers run the
+    transform in this orientation so a channels-last run never
+    transposes on the host).  Like DeviceAugment, the mean image is
+    declared canonical (C, H, W) and reoriented ONCE at construction."""
+
+    def __init__(self, config: TransformConfig, layout: str = "nchw"):
+        if layout not in ("nchw", "nhwc"):
+            raise ValueError(f"unknown layout {layout!r} (nchw|nhwc)")
+        if layout == "nhwc" and config.backend == "native":
+            raise ValueError(
+                "the native transform backend is NCHW-only; use the "
+                "numpy backend for channels-last wire batches")
         self.config = config
+        self.layout = layout
+        self._mean = config.mean_image
+        if self._mean is not None and layout == "nhwc":
+            # canonical (C, H, W) declaration -> (H, W, C) wire order
+            self._mean = np.ascontiguousarray(
+                self._mean.transpose(1, 2, 0))
         self._rs = np.random.RandomState(config.seed)
         if config.mean_image is not None and config.mean_value:
             raise ValueError("specify mean_image or mean_value, not both")
@@ -97,8 +117,10 @@ class DataTransformer:
 
     # ------------------------------------------------------------------
     def __call__(self, images: np.ndarray, train: bool) -> np.ndarray:
-        """images: (N, C, H, W) uint8/float -> float32 transformed batch."""
+        """images: wire-layout uint8/float -> float32 transformed batch
+        ((N, C, H, W) under nchw, (N, H, W, C) under nhwc)."""
         cfg = self.config
+        nhwc = self.layout == "nhwc"
         if cfg.backend == "native" and np.asarray(images).dtype == np.uint8:
             from sparknet_tpu.native import transform_batch
 
@@ -114,16 +136,19 @@ class DataTransformer:
                 seed=(self._native_calls << 32) | self._native_base,
             )
         x = images.astype(np.float32, copy=True)
-        if cfg.mean_image is not None:
-            x -= cfg.mean_image[None]
+        if self._mean is not None:
+            x -= self._mean[None]
         elif cfg.mean_value:
             mv = np.asarray(cfg.mean_value, np.float32)
-            x -= mv.reshape(1, -1, 1, 1)
+            x -= mv.reshape((1, 1, 1, -1) if nhwc else (1, -1, 1, 1))
         if cfg.crop_size:
             x = self._crop(x, train)
         if train and cfg.mirror:
             flip = self._rs.randint(0, 2, len(x)).astype(bool)
-            x[flip] = x[flip, :, :, ::-1]
+            if nhwc:
+                x[flip] = x[flip, :, ::-1, :]
+            else:
+                x[flip] = x[flip, :, :, ::-1]
         if cfg.scale != 1.0:
             x *= cfg.scale
         return x
@@ -131,19 +156,31 @@ class DataTransformer:
     # ------------------------------------------------------------------
     def _crop(self, x: np.ndarray, train: bool) -> np.ndarray:
         """TRAIN: per-sample random crop; TEST: center crop (ref:
-        data_transformer.cpp:49,83)."""
+        data_transformer.cpp:49,83).  The RNG draw order (per-sample H
+        offsets then W offsets) is identical in both layouts, so the
+        same seed crops the same windows regardless of wire order."""
         c = self.config.crop_size
-        n, ch, h, w = x.shape
+        nhwc = self.layout == "nhwc"
+        if nhwc:
+            n, h, w, ch = x.shape
+        else:
+            n, ch, h, w = x.shape
         if h < c or w < c:
             raise ValueError(f"crop {c} larger than image {h}x{w}")
         if not train:
             ho, wo = (h - c) // 2, (w - c) // 2
+            if nhwc:
+                return x[:, ho : ho + c, wo : wo + c, :]
             return x[:, :, ho : ho + c, wo : wo + c]
         hos = self._rs.randint(0, h - c + 1, n)
         wos = self._rs.randint(0, w - c + 1, n)
         # gather per-sample windows via advanced indexing (no python loop)
         rows = hos[:, None] + np.arange(c)[None]  # (N, c)
         cols = wos[:, None] + np.arange(c)[None]
+        if nhwc:
+            return x[np.arange(n)[:, None, None],
+                     rows[:, :, None],
+                     cols[:, None, :]]
         return x[np.arange(n)[:, None, None, None],
                  np.arange(ch)[None, :, None, None],
                  rows[:, None, :, None],

@@ -83,6 +83,29 @@ def _span_rows(spans: list[dict]) -> list[str]:
     return lines
 
 
+def _feed_rows(feeds: list[dict]) -> list[str]:
+    """Per-stage feed telemetry (host-side walls — no fence applies;
+    the table's value is ATTRIBUTION: which stage ate the wall)."""
+    stage_names = ["slot_wait", "source", "transform", "write", "put"]
+    lines = [
+        "| feed | batches | images | wall s | img/s | "
+        + " | ".join(f"{s} s" for s in stage_names) + " |",
+        "|---|---|---|---|---|" + "---|" * len(stage_names),
+    ]
+    for ev in feeds:
+        stages = ev.get("stages") or {}
+        ips = ev.get("images_per_sec")
+        ips_cell = f"{ips:,.1f}" if isinstance(ips, (int, float)) else "—"
+        cells = " | ".join(
+            f"{stages[s]:.3f}" if isinstance(stages.get(s), (int, float))
+            else "—" for s in stage_names)
+        lines.append(
+            f"| {ev.get('name', '?')} | {ev.get('batches', '?')} "
+            f"| {ev.get('images', '?')} | {ev.get('wall_s', 0):.3f} "
+            f"| {ips_cell} | {cells} |")
+    return lines
+
+
 def _bench_lines(benches: list[dict]) -> list[str]:
     lines = []
     for ev in benches:
@@ -156,8 +179,8 @@ def render(events: list[dict], source: str = "journal") -> str:
         if run_id not in by_run:
             runs.append(run_id)
             by_run[run_id] = {"start": [], "round": [], "span": [],
-                              "recompile": [], "bench": [], "bank": [],
-                              "end": []}
+                              "feed": [], "recompile": [], "bench": [],
+                              "bank": [], "end": []}
         kind = ev.get("event")
         key = {"run_start": "start", "run_end": "end"}.get(kind, kind)
         if key in by_run[run_id]:
@@ -178,6 +201,9 @@ def render(events: list[dict], source: str = "journal") -> str:
         if group["span"]:
             lines += ["", "### spans", ""]
             lines += _span_rows(group["span"])
+        if group["feed"]:
+            lines += ["", "### feed stages (host-side)", ""]
+            lines += _feed_rows(group["feed"])
         if group["recompile"]:
             lines += ["", "### recompiles", ""]
             for ev in group["recompile"]:

@@ -115,6 +115,18 @@ EVENTS: dict[str, tuple[dict, dict]] = {
         {"run_id": str, "count": int, "total": int},
         {"where": str, "expected": bool},
     ),
+    # per-stage host-feed telemetry (data/pipeline.py): one aggregated
+    # record per reporting window, ``stages`` mapping a stage name from
+    # the docs/OBSERVABILITY.md "Feed stages" vocabulary (slot_wait /
+    # source / transform / write / put) to its summed wall seconds.
+    # Entirely HOST-side work — feed walls carry span ``host`` semantics
+    # (no fence stamp exists or is needed), and a feed stall in the
+    # journal is attributable to exactly one stage.
+    "feed": (
+        {"run_id": str, "name": str, "batches": int, "images": int,
+         "wall_s": _NUM, "stages": dict},
+        {"images_per_sec": _NUM, "workers": int, "note": str},
+    ),
     # a bench.py measurement, embedded whole under ``record`` (the
     # record's own keys are bench.py's contract, not re-specified here)
     "bench": (

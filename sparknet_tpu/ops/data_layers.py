@@ -19,6 +19,32 @@ from sparknet_tpu.ops.base import Layer, LayerOutput
 from sparknet_tpu.ops.registry import register
 
 
+def wire_spec(feed_shapes: dict, raw: bool = False) -> dict:
+    """``{top: (internal_shape, numpy_dtype_str)}`` — the host feed
+    ring's slot geometry straight from a net's declared inputs
+    (``Network.feed_shapes()``, already in the INTERNAL layout via
+    :func:`layout.internal_shape`, so an nhwc net sizes channels-last
+    slots with no transposition anywhere between wire and graph).
+
+    ``raw=True`` keeps rank-4 image blobs uint8 — the thin-wire recipe
+    where DeviceAugment converts in-graph (``data/device_transform.py``);
+    default float32 matches the host-transformed feed contract.  Rank-1
+    tops are int32 labels (the db record convention).  Consumed by
+    ``data/pipeline.py`` to allocate fixed-size shared-memory slots.
+    """
+    spec = {}
+    for top, shape in feed_shapes.items():
+        shape = tuple(int(d) for d in shape)
+        if len(shape) == 4:
+            dtype = "|u1" if raw else "<f4"
+        elif len(shape) == 1:
+            dtype = "<i4"
+        else:
+            dtype = "<f4"
+        spec[top] = (shape, dtype)
+    return spec
+
+
 class InputLayer(Layer):
     """Base for all source layers: tops are fed externally.
 

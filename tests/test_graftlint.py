@@ -34,6 +34,7 @@ EXPECTED_RULES = {
     "mem-manifest-fresh",
     "queue-job-hygiene",
     "obs-fenced-span",
+    "feed-shm-cleanup",
 }
 
 
@@ -631,6 +632,80 @@ def test_queue_hygiene_suppressible(tmp_path):
            "fixture queue under construction\n" + RUNNER_SRC)
     assert not hits(src, "queue-job-hygiene", path=path)
     assert suppressed_hits(src, "queue-job-hygiene", path=path)
+
+
+# -- feed-shm-cleanup -------------------------------------------------------
+
+SHM_BAD = """
+from multiprocessing import shared_memory
+
+def build_ring(nbytes):
+    shm = shared_memory.SharedMemory(create=True, size=nbytes)
+    return shm
+"""
+
+SHM_GOOD_FINALLY = """
+from multiprocessing import shared_memory
+
+def run(nbytes):
+    shm = shared_memory.SharedMemory(create=True, size=nbytes)
+    try:
+        work(shm)
+    finally:
+        shm.close()
+        shm.unlink()
+"""
+
+SHM_GOOD_CLOSE_METHOD = """
+from multiprocessing import shared_memory
+
+class Ring:
+    def __init__(self, nbytes):
+        self.shm = shared_memory.SharedMemory(create=True, size=nbytes)
+
+    def close(self):
+        self.shm.close()
+        self.shm.unlink()
+"""
+
+SHM_ATTACH_ONLY = """
+from multiprocessing import shared_memory
+
+def attach(name):
+    return shared_memory.SharedMemory(name=name)
+"""
+
+
+def test_shm_cleanup_positive_without_unlink():
+    assert hits(SHM_BAD, "feed-shm-cleanup")
+
+
+def test_shm_cleanup_clean_with_finally_unlink():
+    assert not hits(SHM_GOOD_FINALLY, "feed-shm-cleanup")
+
+
+def test_shm_cleanup_clean_with_close_method():
+    assert not hits(SHM_GOOD_CLOSE_METHOD, "feed-shm-cleanup")
+
+
+def test_shm_cleanup_attach_side_exempt():
+    assert not hits(SHM_ATTACH_ONLY, "feed-shm-cleanup")
+
+
+def test_shm_cleanup_unlink_in_ordinary_helper_still_flagged():
+    """An unlink buried in a non-teardown-named helper is the rule's
+    documented blind-spot boundary: still a finding."""
+    assert hits(SHM_BAD + "\ndef helper(shm):\n    shm.unlink()\n",
+                "feed-shm-cleanup")
+
+
+def test_shm_cleanup_suppressible():
+    src = SHM_BAD.replace(
+        "create=True, size=nbytes)",
+        "create=True, size=nbytes)  # graftlint: disable=feed-shm-cleanup"
+        " -- fixture: lifetime owned by the caller")
+    assert not hits(src, "feed-shm-cleanup")
+    assert suppressed_hits(src, "feed-shm-cleanup")
 
 
 # -- obs-fenced-span --------------------------------------------------------

@@ -9,24 +9,46 @@ same batch shape, numpy and multithreaded C++ backends, plus the
 prefetcher's overlap — and prints one JSON line per variant:
 
     python tools/feed_bench.py [--batch 256] [--iters 20]
+    python tools/feed_bench.py --pipeline [--bank]   # process-feed arms
+
+``--pipeline`` benches the multi-process shared-memory feed
+(``data/pipeline.py``) against the headline ingest gate: AlexNet wire
+shapes (b256 uint8 227x227), PURE ingest (prestaged batches, the
+workers' only per-batch work is the slot memcpy — the ring transport
+itself), sustained over >= 64 batches, vs the banked r5 headline
+12,290 img/s (docs/BENCHMARKS.md).  A threaded twin (same work on the
+legacy daemon-thread feed) and a decode+transform attribution arm print
+alongside; ``--bank`` routes the gate record through
+``common.bank_guard`` to docs/feed_bench_last.json.  Honors
+SPARKNET_BENCH_REQUIRE_MEASURED (rc 4 if armed and nothing measured).
 
 Timing-contract note (graftlint audit): every timed loop here is
-HOST-side — numpy/PIL transforms and the prefetcher's queue — so
-repeating identical args really does the work each call and no value
-fence is needed; nothing in this module dispatches to a device inside
-a timing window (the stale-args-dispatch rule is scoped to
-jax-importing modules for exactly this distinction).
+HOST-side — numpy/PIL transforms, the prefetcher's queue, and the
+pipeline's shared-memory ring — so repeating identical args really does
+the work each call and no value fence is needed; nothing in this module
+dispatches to a device inside a timing window (the stale-args-dispatch
+rule is scoped to jax-importing modules for exactly this distinction).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
 import time
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 REF_MS_PER_BATCH = 1200.0  # the reference's measured cost per 256-IMAGE batch
+
+# The ingest gate: the banked r5 AlexNet headline (probe-16 re-bank,
+# docs/bench_last_good.json) — the feed must sustain at least what the
+# chip consumes, or the pipeline is the new bottleneck.
+HEADLINE_IMG_S = 12290.0
+LAST_PATH = "docs/feed_bench_last.json"
 
 
 def bench_transform(backend: str, batch: int, iters: int) -> dict:
@@ -116,10 +138,269 @@ def bench_prefetch(batch: int, iters: int) -> dict:
     }
 
 
+def _wire_batch(batch: int, side: int = 227) -> dict:
+    """One AlexNet-wire batch: uint8 channels-last (the decoder's native
+    HWC order — ops/layout.py wire contract) + int32 labels."""
+    rs = np.random.RandomState(0)
+    return {
+        "data": rs.randint(0, 256, (batch, side, side, 3), dtype=np.uint8),
+        "label": rs.randint(0, 1000, batch).astype(np.int32),
+    }
+
+
+def _consume(feeds: dict) -> int:
+    """The consumer's per-batch touch: one byte per array proves the
+    views are live without re-reading the whole slot (ingest delivers
+    bytes; the step, not the feed, streams them)."""
+    return sum(int(np.asarray(v).flat[0]) for v in feeds.values())
+
+
+def bench_pipeline_ingest(batch: int, batches: int,
+                          workers: int | None = None) -> dict:
+    """Sustained pure-ingest img/s through the process pipeline:
+    prestaged wire batches, worker work = slot memcpy only."""
+    from sparknet_tpu.data.pipeline import PrestagedSource, ProcessPipeline
+
+    feeds = _wire_batch(batch)
+    warm = 8
+    with ProcessPipeline(PrestagedSource(feeds), num_batches=batches + warm,
+                         workers=workers, name="feed.ingest") as pipe:
+        it = pipe.batches()
+        for _ in range(warm):
+            _consume(next(it))
+        t0 = time.perf_counter()
+        for _ in range(batches):
+            _consume(next(it))
+        dt = time.perf_counter() - t0
+        stats = dict(pipe.stats)
+        nworkers = pipe.workers
+    img_s = batch * batches / dt
+    n = max(int(stats.get("batches", 1)), 1)
+    return {
+        "metric": "feed_pipeline_ingest_img_s",
+        "value": round(img_s, 1),
+        "unit": f"img/s (b{batch} uint8 227x227 pure ingest, "
+                f"{batches} batches sustained)",
+        "workers": nworkers,
+        "stages_ms_per_batch": {
+            k: round(v / n * 1e3, 3) for k, v in stats.items()
+            if k != "batches"},
+    }
+
+
+def bench_threaded_ingest(batch: int, batches: int) -> dict:
+    """The threaded twin of the ingest arm: the SAME slot-memcpy work
+    (copy into a ring of preallocated buffers) on the legacy
+    daemon-thread feed — what the pipeline replaces, doing what the
+    pipeline does, GIL and all."""
+    import queue as q
+    import threading
+
+    feeds = _wire_batch(batch)
+    slots = [{k: np.empty_like(v) for k, v in feeds.items()}
+             for _ in range(4)]
+    free: q.Queue = q.Queue()
+    full: q.Queue = q.Queue()
+    for s in range(len(slots)):
+        free.put(s)
+    warm = 8
+    total = batches + warm
+
+    def producer():
+        for _ in range(total):
+            s = free.get()
+            for k in slots[s]:
+                np.copyto(slots[s][k], feeds[k])
+            full.put(s)
+
+    th = threading.Thread(target=producer, daemon=True)
+    th.start()
+    for _ in range(warm):
+        s = full.get()
+        _consume(slots[s])
+        free.put(s)
+    t0 = time.perf_counter()
+    for _ in range(batches):
+        s = full.get()
+        _consume(slots[s])
+        free.put(s)
+    dt = time.perf_counter() - t0
+    th.join(timeout=5.0)
+    return {
+        "metric": "feed_threaded_ingest_img_s",
+        "value": round(batch * batches / dt, 1),
+        "unit": f"img/s (b{batch} uint8 227x227 pure ingest, "
+                "daemon-thread feed twin)",
+    }
+
+
+def bench_pipeline_transform(batch: int, batches: int,
+                             workers: int | None = None) -> dict:
+    """The end-to-end attribution arm: synthetic 256px wire batches,
+    DataTransformer (227 crop + mirror + mean) IN the workers, uint8
+    slots — per-stage walls say where a real feed's time goes."""
+    from sparknet_tpu.data.pipeline import (
+        ProcessPipeline,
+        SyntheticImageSource,
+        TransformStage,
+    )
+    from sparknet_tpu.data.transform import TransformConfig
+
+    rs = np.random.RandomState(1)
+    mean = (rs.rand(3, 256, 256).astype(np.float32) * 255)
+    stage = TransformStage(
+        TransformConfig(mean_image=mean, crop_size=227, mirror=True,
+                        seed=1),
+        train=True, layout="nhwc", out_dtype="<f4")
+    src = SyntheticImageSource(batch, (3, 256, 256), seed=3,
+                               layout="nhwc")
+    with ProcessPipeline(src, stage, num_batches=batches,
+                         workers=workers, name="feed.e2e") as pipe:
+        t0 = time.perf_counter()
+        for feeds in pipe.batches():
+            _consume(feeds)
+        dt = time.perf_counter() - t0
+        stats = dict(pipe.stats)
+        nworkers = pipe.workers
+    n = max(int(stats.get("batches", 1)), 1)
+    return {
+        "metric": "feed_pipeline_e2e_img_s",
+        "value": round(batch * batches / dt, 1),
+        "unit": f"img/s (b{batch} 256px synth -> crop227+mirror+mean f32,"
+                " in-worker transform)",
+        "workers": nworkers,
+        "stages_ms_per_batch": {
+            k: round(v / n * 1e3, 3) for k, v in stats.items()
+            if k != "batches"},
+    }
+
+
+def host_roofline(batch: int) -> dict:
+    """The box's physical ingest ceiling: one straight memcpy of the
+    wire batch into a preallocated buffer — no ring, no queues, no
+    second process.  Any pipeline number above this is a measurement
+    bug; the gap below it is the transport's true overhead."""
+    feeds = _wire_batch(batch)
+    dst = {k: np.empty_like(v) for k, v in feeds.items()}
+    for k in dst:
+        np.copyto(dst[k], feeds[k])  # warm (page faults)
+    best = float("inf")
+    for _ in range(30):
+        t0 = time.perf_counter()
+        for k in dst:
+            np.copyto(dst[k], feeds[k])
+        best = min(best, time.perf_counter() - t0)
+    return {
+        # BEST-iteration memcpy rate: a genuine upper bound (no
+        # sustained ring number may exceed the fastest bare copy the
+        # box produced — the no-value-above-its-roofline house rule)
+        "roofline_img_s_upper_bound": round(batch / best, 1),
+        "roofline_basis": "best-of-30 single memcpy of the wire batch "
+                          "(one writer pass; the ring adds a bounded-"
+                          "queue round trip and cross-process "
+                          "scheduling on top)",
+        "cores": os.cpu_count() or 1,
+    }
+
+
+def run_pipeline_arms(args) -> int:
+    """The --pipeline mode: ingest gate + threaded twin + attribution,
+    one JSON line each, then the combined gate record (banked via
+    common.bank_guard under --bank)."""
+    batches = max(args.iters, 64)  # "sustained" floor for the gate
+    # median of 5 interleaved trials per arm: single-core scheduling
+    # noise swings either twin ~20% run to run; one trial could crown
+    # either architecture by luck
+    ingest_trials, threaded_trials = [], []
+    for _ in range(5):
+        ingest_trials.append(bench_pipeline_ingest(
+            args.batch, batches, workers=args.workers or None))
+        threaded_trials.append(bench_threaded_ingest(args.batch, batches))
+    ingest = sorted(ingest_trials, key=lambda r: r["value"])[2]
+    threaded = sorted(threaded_trials, key=lambda r: r["value"])[2]
+    ingest = {**ingest,
+              "trials_img_s": [r["value"] for r in ingest_trials]}
+    threaded = {**threaded,
+                "trials_img_s": [r["value"] for r in threaded_trials]}
+    print(json.dumps(ingest))
+    print(json.dumps(threaded))
+    e2e = bench_pipeline_transform(args.batch, max(batches // 8, 4),
+                                   workers=args.workers or None)
+    print(json.dumps(e2e))
+    roof = host_roofline(args.batch)
+
+    met = ingest["value"] >= HEADLINE_IMG_S
+    record = {
+        "metric": "feed_pipeline_gate",
+        "value": ingest["value"],
+        "unit": f"img/s (b{args.batch} uint8 227x227 pure ingest)",
+        "target_img_s": HEADLINE_IMG_S,
+        "met_target": met,
+        "trials_img_s": ingest["trials_img_s"],
+        "threaded_img_s": threaded["value"],
+        "threaded_trials_img_s": threaded["trials_img_s"],
+        "process_beats_threaded": ingest["value"] > threaded["value"],
+        "process_vs_threaded": round(
+            ingest["value"] / max(threaded["value"], 1.0), 3),
+        "e2e_img_s": e2e["value"],
+        "workers": ingest["workers"],
+        "stages_ms_per_batch": ingest["stages_ms_per_batch"],
+        "e2e_stages_ms_per_batch": e2e["stages_ms_per_batch"],
+        **roof,
+        # host-side measurement: real walls on this box, no chip involved
+        "measured": True,
+        "host_side": True,
+    }
+    bound = roof["roofline_img_s_upper_bound"]
+    if record["value"] > bound:
+        # never print/bank a throughput above its own stated roofline
+        # (CLAUDE.md house rule; the obs report refuses such records)
+        record["bound_inconsistency"] = (
+            f"sustained {record['value']:,} img/s exceeds the best "
+            f"bare-memcpy bound {bound:,} img/s — measurement bug, "
+            "not evidence")
+        record["met_target"] = False
+    if not record["met_target"] or (roof["cores"] == 1
+                                    and not record["process_beats_threaded"]):
+        # the documented-roofline arm: name the physical limit.  On one
+        # core the two architectures do the SAME serialized memcpy work
+        # — transport parity is the physical outcome (the process feed's
+        # win condition, GIL-free parallel decode/transform, needs
+        # cores > 1; the e2e stage walls show what it would parallelize)
+        record["attribution"] = (
+            f"{roof['cores']} core(s): producer and consumer serialize "
+            f"on the same CPU, so process-vs-threaded = "
+            f"{record['process_vs_threaded']} is scheduling noise "
+            f"around transport parity; ingest wall is the slot memcpy "
+            f"itself (per-stage ms {ingest['stages_ms_per_batch']}, "
+            f"bare-memcpy bound {bound:,.0f} img/s); the parallel win "
+            f"needs cores > 1 where the e2e transform stage "
+            f"({e2e['stages_ms_per_batch'].get('transform', 0):.0f} "
+            f"ms/batch) leaves the consumer's GIL")
+    print(json.dumps(record))
+    if args.bank:
+        from sparknet_tpu.common import bank_guard
+
+        bank_guard(LAST_PATH, record, measured=record["measured"])
+    if (os.environ.get("SPARKNET_BENCH_REQUIRE_MEASURED") == "1"
+            and not record["measured"]):
+        return 4  # the queue-runner contract: unmeasured = retryable
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--pipeline", action="store_true",
+                    help="bench the process feed (data/pipeline.py): "
+                    "pure-ingest gate vs the 12,290 img/s headline, "
+                    "threaded twin, per-stage attribution")
+    ap.add_argument("--workers", type=int, default=0,
+                    help="pipeline worker processes (0 = auto)")
+    ap.add_argument("--bank", action="store_true",
+                    help="bank the --pipeline gate record to "
+                    f"{LAST_PATH} via common.bank_guard")
     ap.add_argument("--platform", default="",
                     help="force a jax platform for the prefetch leg (the "
                     "config route wins over JAX_PLATFORMS site pins)")
@@ -128,6 +409,8 @@ def main() -> int:
         from sparknet_tpu.common import force_platform
 
         force_platform(args.platform)
+    if args.pipeline:
+        return run_pipeline_arms(args)
 
     print(json.dumps(bench_transform("numpy", args.batch, args.iters)))
     from sparknet_tpu import native
