@@ -92,13 +92,16 @@ def _window(model_bytes: int, state_bytes: int = 0) -> tuple:
     return (lo, hi)
 
 
-def expected_comm(mode: str, *, param_bytes: int,
-                  state_bytes: int = 0) -> CommExpectation:
+def expected_comm(mode: str, *, param_bytes: int, state_bytes: int = 0,
+                  padded_param_bytes: int | None = None) -> CommExpectation:
     """The analytic expectation for ``mode`` given the actual model
-    sizes.  Raises KeyError for unknown modes — a new parallel mode
-    must state its communication contract here before it can bank a
-    manifest."""
-    if mode in ("solo", "solo_nhwc"):
+    sizes.  ``padded_param_bytes``: the fused modes' flat-arena size
+    (params padded to the kernel tile) — widens only the hi bound,
+    since GSPMD may place the grad all-reduce on the concatenated
+    arena instead of the per-blob grads.  Raises KeyError for unknown
+    modes — a new parallel mode must state its communication contract
+    here before it can bank a manifest."""
+    if mode in ("solo", "solo_nhwc", "solo_fused"):
         return CommExpectation(
             required={},
             forbidden=COLLECTIVE_KINDS,
@@ -113,6 +116,23 @@ def expected_comm(mode: str, *, param_bytes: int,
             forbidden=("all-to-all", "collective-permute", "all-gather"),
             note="tau=1 sync SGD: one grad-sized all-reduce per step; "
                  "an all-gather here means a param got resharded",
+        )
+    if mode == "dp_fused":
+        # dp's contract with one refinement: the fused step
+        # differentiates w.r.t. the flat arena, so the grad sync may be
+        # lowered per-blob (= exactly param bytes) OR post-concat on
+        # the padded arena; the window brackets both placements.  The
+        # update kernel itself never communicates.
+        padded = padded_param_bytes or param_bytes
+        lo = int(_LO_FRAC * param_bytes)
+        hi = int(_HI_FRAC * padded + 8 * state_bytes + _SLACK_BYTES)
+        return CommExpectation(
+            required={"all-reduce": (lo, hi)},
+            forbidden=("all-to-all", "collective-permute", "all-gather"),
+            note="tau=1 sync SGD + fused arena update: one grad-sized "
+                 "all-reduce per step (per-blob or on the padded flat "
+                 "arena); an all-gather here means a param got "
+                 "resharded",
         )
     if mode == "tau":
         return CommExpectation(

@@ -793,7 +793,12 @@ def cmd_train(args) -> int:
             solver, args.weights, strict_shapes=False, require_match=False
         )
         print(json.dumps({"finetune_from": args.weights, "layers_loaded": loaded}))
-    log = EventLogger(".", prefix="tpunet_train")
+    # Default "." mirrors the reference (logs land where you run), but
+    # ad-hoc runs from the repo root kept littering checkouts with
+    # tpunet_train_<ts>.txt (gitignored since PR 6; six deleted across
+    # two PRs) — SPARKNET_TRAIN_LOG_DIR reroutes the whole class.
+    log = EventLogger(os.environ.get("SPARKNET_TRAIN_LOG_DIR", "."),
+                      prefix="tpunet_train")
     train_fn, test_fn = _data_fns(args, solver.train_net,
                                   test_net=solver.test_net)
     if args.data == "proto":

@@ -66,6 +66,24 @@ class Config:
     # and app loops), not inside jitted programs; ``SPARKNET_FEED`` seeds
     # the default, ``tpunet train --feed`` flips it per run.
     feed: str = os.environ.get("SPARKNET_FEED", "threaded").lower()
+    # One-pass optimizer update: ``True`` routes the Solver's update
+    # through the fused flat-arena kernel (``solvers/arena.py`` +
+    # ``ops/pallas_kernels.fused_update``) — params/grads/slots viewed
+    # as contiguous flat arenas and the full Caffe update (normalize/
+    # regularize/clip/rule) applied in ONE read-modify-write sweep.
+    # ``False`` (default) keeps the per-blob ``solvers/updates.py``
+    # chain, bit-identical to every banked manifest.  Read at Solver
+    # CONSTRUCTION time (like every Config field: trace-time, no
+    # retrace on later set_config); ``SPARKNET_FUSED_UPDATE`` seeds it,
+    # the bench A/B flips it via ``SPARKNET_BENCH_FUSED``.
+    fused_update: bool = os.environ.get("SPARKNET_FUSED_UPDATE", "0") == "1"
+    # Storage dtype of the fused arenas: "f32" (default) or "bf16" —
+    # the bf16-params+slots lever rebuilt on a vehicle that cannot lose
+    # the bytes win to XLA re-materialization: arenas live in bf16, the
+    # kernel computes in f32 registers, one cast at each boundary.
+    # Only consulted when ``fused_update`` is on; checkpoints stay
+    # blob-wise in the net's param dtype either way (dtype-invariant).
+    storage_dtype: str = os.environ.get("SPARKNET_STORAGE_DTYPE", "f32").lower()
     # Default mesh axis names: data parallelism over 'data', within-layer
     # (tensor) sharding over 'model', sequence/context parallelism over
     # 'seq' (ring / Ulysses attention).
@@ -120,6 +138,13 @@ def set_config(**overrides) -> Config:
             raise ValueError(f"feed must be 'threaded' or 'process', got "
                              f"{overrides['feed']!r}")
         overrides = {**overrides, "feed": feed}
+    if "storage_dtype" in overrides:
+        sd = str(overrides["storage_dtype"]).lower()
+        sd = {"bfloat16": "bf16", "float32": "f32"}.get(sd, sd)
+        if sd not in ("f32", "bf16"):
+            raise ValueError(f"storage_dtype must be 'f32' or 'bf16', got "
+                             f"{overrides['storage_dtype']!r}")
+        overrides = {**overrides, "storage_dtype": sd}
     with _lock:
         _config = dataclasses.replace(_config, **overrides)
     return _config

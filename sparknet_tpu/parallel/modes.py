@@ -20,7 +20,10 @@ collective is a bug), ``dp``/``dp_bf16``/``mobilenet_dp`` (tau=1
 GSPMD sync SGD, ref: CifarApp.scala:95-136 degenerate case), ``tau``
 (the SparkNet tau-averaging round), ``easgd`` (elastic coupling),
 ``solo_nhwc``/``dp_nhwc`` (the channels-last layout twins — identical
-comm contracts, plus the layout transpose census), ``tp``
+comm contracts, plus the layout transpose census),
+``solo_fused``/``dp_fused`` (the one-pass-optimizer twins —
+``Config.fused_update`` arena update, identical comm contracts plus
+the fused ``update`` block), ``tp``
 (Megatron-style output-channel sharding), ``sp`` (Ulysses
 all-to-all sequence parallelism — the ring impl is trace-broken under
 the pinned jax, see test_seq_parallel's seed state), ``gpipe``
@@ -69,6 +72,10 @@ class TraceTarget:
     trace_context: Callable[[], Any] = contextlib.nullcontext
     # tp/moe-style modes declare that at least one param MUST be sharded
     expects_sharded_params: bool = False
+    # fused-update modes attach a thunk producing extra contract fields
+    # (the TPU-export custom-call census + arena traffic model); merged
+    # into the manifest contract as its "update" block by graphcheck
+    extra_contract: Callable[[], dict] | None = None
 
 
 def _tree_bytes(tree) -> int:
@@ -97,14 +104,49 @@ def _feeds_for(family, batch: int, rs: np.random.RandomState,
     return {"data": data, "label": label}
 
 
+def _fused_update_block(layout) -> dict:
+    """The manifest ``update`` block for a fused mode: arena geometry,
+    the kernel's analytic single-pass traffic (one read + one write per
+    param/slot arena byte + one grad read — guaranteed by the pallas
+    path's input/output aliasing), and the TPU-export custom-call
+    census pinning 'the whole update chain is ONE custom call' with
+    zero chip time (jax.export lowers Mosaic host-side)."""
+    from sparknet_tpu.ops.pallas_kernels import (
+        fused_update_hbm_bytes,
+        fused_update_tpu_custom_calls,
+    )
+
+    try:
+        calls = fused_update_tpu_custom_calls(
+            rule=layout.rule, n_slots=layout.n_slots)
+    except Exception:  # export API drift: a failure to pin, not a pass
+        calls = None
+    ab = layout.total_bytes
+    return {
+        "rule": layout.rule,
+        "n_slots": layout.n_slots,
+        "storage_dtype": layout.storage_dtype,
+        "arena_bytes": ab,
+        "arena_padded_frac": round(layout.padded_frac(), 4),
+        "params_slots_read_bytes": ab * (1 + layout.n_slots),
+        "params_slots_write_bytes": ab * (1 + layout.n_slots),
+        "grad_read_bytes": ab,
+        "single_pass_hbm_bytes": fused_update_hbm_bytes(
+            ab, layout.n_slots),
+        "tpu_custom_calls": calls,
+    }
+
+
 def _trainer_target(name: str, family_name: str, mesh, *, tau: int = 1,
                     elastic_alpha: float = 0.0, per_device_batch: int = 2,
                     rules=None, compute_dtype=None, layout=None,
+                    fused: bool = False,
                     expects_sharded_params: bool = False) -> TraceTarget:
     """The shared trainer-mode factory: construct Solver+ParallelTrainer
     exactly as the dryrun does, stop at the jitted round function.
     ``layout``: internal activation layout for the whole build+trace
-    (None = leave the global config alone)."""
+    (None = leave the global config alone).  ``fused``: build the
+    Solver with the one-pass arena update (Config.fused_update)."""
     from sparknet_tpu.common import get_config, set_config
     from sparknet_tpu.models.zoo import GRAPH_SWEEP_FAMILIES
     from sparknet_tpu.parallel.trainer import ParallelTrainer
@@ -122,6 +164,8 @@ def _trainer_target(name: str, family_name: str, mesh, *, tau: int = 1,
             overrides["compute_dtype"] = compute_dtype
         if layout is not None:
             overrides["layout"] = layout
+        if fused:
+            overrides["fused_update"] = True
         if not overrides:
             yield
             return
@@ -165,20 +209,31 @@ def _trainer_target(name: str, family_name: str, mesh, *, tau: int = 1,
             with trainer._sp_context():
                 yield
 
+    meta = {
+        "family": family_name,
+        "mesh": dict(mesh.shape),
+        "tau": trainer.tau,
+        "elastic_alpha": elastic_alpha,
+        "batch": B_global,
+        "dtype": "bf16" if compute_dtype == jnp.bfloat16 else "f32",
+        "layout": layout or "nchw",
+    }
+    if fused:
+        meta["fused"] = True
+        # the comm model's hi bound prices the PADDED arena (GSPMD may
+        # place the grad all-reduce post-concat on the flat grad arena)
+        meta["padded_param_bytes"] = solver._arena.total_bytes
+        meta["arena_bytes"] = solver._arena.total_bytes
+        meta["n_slots"] = solver._arena.n_slots
     return TraceTarget(
         name=name,
         fn=trainer._train,
         args=args,
         alt_args=alt,
-        meta={
-            "family": family_name,
-            "mesh": dict(mesh.shape),
-            "tau": trainer.tau,
-            "elastic_alpha": elastic_alpha,
-            "batch": B_global,
-            "dtype": "bf16" if compute_dtype == jnp.bfloat16 else "f32",
-            "layout": layout or "nchw",
-        },
+        meta=meta,
+        extra_contract=(
+            (lambda lay=solver._arena: _fused_update_block(lay))
+            if fused else None),
         # model sizes for the comm model come from the SOLVER's (single-
         # replica) tree: tau/EASGD trainers stack a worker axis, but the
         # pmean still moves one model's bytes per chip per round
@@ -197,13 +252,15 @@ def _trainer_target(name: str, family_name: str, mesh, *, tau: int = 1,
 
 
 def _mode_solo(devices, layout: str | None = None,
-               name: str = "solo") -> TraceTarget:
+               name: str = "solo", fused: bool = False) -> TraceTarget:
     """Single-chip Solver step — the negative control (no mesh, so the
     lowered program must contain ZERO collectives) and the donation
     audit's original catch: ``Solver._train_step`` shipped undonated
     until this audit flagged the 2x params+slots HBM bloat.
     ``layout="nhwc"`` builds the channels-last twin (mode solo_nhwc),
-    whose manifest pins the zero-interior-transpose layout contract."""
+    whose manifest pins the zero-interior-transpose layout contract;
+    ``fused=True`` builds the one-pass-update twin (mode solo_fused),
+    whose manifest pins the arena update block."""
     from sparknet_tpu.common import get_config, set_config
     from sparknet_tpu.models.zoo import GRAPH_SWEEP_FAMILIES
     from sparknet_tpu.solvers.solver import Solver
@@ -213,15 +270,20 @@ def _mode_solo(devices, layout: str | None = None,
 
     @contextlib.contextmanager
     def lay_ctx():
-        if layout is None:
+        overrides: dict = {}
+        if layout is not None:
+            overrides["layout"] = layout
+        if fused:
+            overrides["fused_update"] = True
+        if not overrides:
             yield
             return
-        prior = get_config().layout
-        set_config(layout=layout)
+        prior = {k: getattr(get_config(), k) for k in overrides}
+        set_config(**overrides)
         try:
             yield
         finally:
-            set_config(layout=prior)
+            set_config(**prior)
 
     with lay_ctx():
         solver = Solver(family.solver(), family.net(B))
@@ -230,15 +292,23 @@ def _mode_solo(devices, layout: str | None = None,
                  for k, v in _feeds_for(family, B, rs).items()}
     args = (solver.variables, solver.slots, 0, feeds, solver._key)
     carry_out = sum(len(jax.tree_util.tree_leaves(t)) for t in args[:2])
+    meta = {"family": "cifar10_quick", "mesh": {}, "tau": 1,
+            "batch": B, "dtype": "f32", "layout": layout or "nchw"}
+    if fused:
+        meta["fused"] = True
+        meta["arena_bytes"] = solver._arena.total_bytes
+        meta["n_slots"] = solver._arena.n_slots
     return TraceTarget(
         name=name, fn=solver._train_step, args=args,
         alt_args=args[:2] + (1,) + args[3:],
-        meta={"family": "cifar10_quick", "mesh": {}, "tau": 1,
-              "batch": B, "dtype": "f32", "layout": layout or "nchw"},
+        meta=meta,
         param_bytes=_tree_bytes(solver.variables.params),
         state_bytes=_tree_bytes(solver.variables.state),
         carry_argnums=(0, 1), carry_out_leaves=carry_out,
         trace_context=lay_ctx,
+        extra_contract=(
+            (lambda lay=solver._arena: _fused_update_block(lay))
+            if fused else None),
     )
 
 
@@ -268,6 +338,24 @@ def _mode_dp_nhwc(devices) -> TraceTarget:
 def _mode_dp_bf16(devices) -> TraceTarget:
     return _trainer_target("dp_bf16", "cifar10_quick", _data_mesh(devices),
                            compute_dtype=jnp.bfloat16)
+
+
+def _mode_solo_fused(devices) -> TraceTarget:
+    """The one-pass-update twin of solo: same family/batch/layout, the
+    optimizer update routed through the fused arena sweep.  Manifest
+    pins the ``update`` block (one TPU custom call, single-pass arena
+    traffic) on top of solo's zero-collective contract."""
+    return _mode_solo(devices, name="solo_fused", fused=True)
+
+
+def _mode_dp_fused(devices) -> TraceTarget:
+    """tau=1 GSPMD DP with the fused arena update: the comm contract is
+    dp's (one grad-sized all-reduce per step — the update kernel never
+    communicates; only the reduce's placement may move onto the padded
+    flat grad arena, priced by the comm window's hi bound), plus the
+    same ``update`` block as solo_fused."""
+    return _trainer_target("dp_fused", "cifar10_quick",
+                           _data_mesh(devices), fused=True)
 
 
 def _mode_mobilenet_dp(devices) -> TraceTarget:
@@ -357,8 +445,10 @@ def _mode_moe(devices) -> TraceTarget:
 MODES: dict[str, Callable] = {
     "solo": _mode_solo,
     "solo_nhwc": _mode_solo_nhwc,
+    "solo_fused": _mode_solo_fused,
     "dp": _mode_dp,
     "dp_nhwc": _mode_dp_nhwc,
+    "dp_fused": _mode_dp_fused,
     "dp_bf16": _mode_dp_bf16,
     "tau": _mode_tau,
     "easgd": _mode_easgd,

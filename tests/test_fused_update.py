@@ -1,0 +1,339 @@
+"""One-pass fused optimizer update: equivalence, arenas, contracts.
+
+The fused path (``Config.fused_update``: ``solvers/arena.py`` flat
+arenas + ``ops/pallas_kernels.fused_update``) must be a pure
+re-layout of ``solvers/updates.apply_update`` — same Caffe semantics,
+different memory traffic.  Pinned here from every side:
+
+* all six solver rules x {f32, bf16-storage} x {xla, interpret} match
+  the per-blob chain at one REAL zoo step's geometry and gradients
+  (exact — bitwise up to signed zeros — for SGD/Nesterov f32 on the
+  xla formulation, allclose elsewhere);
+* the fused Solver step / scan path reproduce the unfused trajectory;
+* checkpoints round-trip through the arena index map (a fused run's
+  snapshot restores into an UNFUSED solver and continues identically
+  — snapshots stay blob-wise and storage-dtype-invariant);
+* the kernel's static VMEM bounds fit the v5e budget and the TPU
+  cross-export collapses the whole update chain to ONE custom call
+  (zero chip time — jax.export lowers Mosaic host-side).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparknet_tpu import models
+from sparknet_tpu.common import Phase, get_config, set_config
+from sparknet_tpu.compiler.graph import Network
+from sparknet_tpu.solvers import arena, updates
+from sparknet_tpu.solvers.solver import Solver
+
+B = 8
+
+
+@pytest.fixture
+def zoo_step_state():
+    """One real cifar10_quick geometry + REAL gradients (one actual
+    backward at init), shared by the rule-sweep tests — one compile
+    total instead of one per rule."""
+    rs = np.random.RandomState(0)
+    net = Network(models.cifar10_quick(B), Phase.TRAIN)
+    variables = net.init(jax.random.PRNGKey(0))
+    specs = net.param_specs_for(variables)
+    feeds = {
+        "data": jnp.asarray(rs.randn(B, 3, 32, 32) * 40, jnp.float32),
+        "label": jnp.asarray(rs.randint(0, 10, B), jnp.int32),
+    }
+
+    def loss_fn(params):
+        _, _, loss = net.apply(
+            dataclasses.replace(variables, params=params), feeds,
+            rng=jax.random.PRNGKey(1))
+        return loss
+
+    grads = jax.grad(loss_fn)(variables.params)
+    return variables.params, grads, specs
+
+
+def _fixture_feed(rs):
+    return {"data": (rs.randn(B, 3, 32, 32) * 40).astype(np.float32),
+            "label": rs.randint(0, 10, B).astype(np.int32)}
+
+
+@pytest.fixture
+def fused_off():
+    """Restore the default config after any fused-arm test."""
+    yield
+    set_config(fused_update=False, storage_dtype="f32")
+
+
+# -- the six-rule equivalence sweep -----------------------------------------
+
+
+@pytest.mark.smoke
+@pytest.mark.parametrize("rule", list(updates.OPTIMIZERS))
+def test_rule_fused_matches_updates_at_zoo_step(zoo_step_state, rule):
+    """All six rules, f32 + bf16 storage, xla + interpret impls, vs
+    the per-blob chain on one real zoo step's params/grads; exact for
+    SGD/Nesterov in f32 (same op sequence, same rounding)."""
+    params, grads, specs = zoo_step_state
+    cfg = dataclasses.replace(models.cifar10_quick_solver(),
+                              solver_type=rule)
+    slots = updates.init_slots(rule, params)
+    # second-step shape: nonzero histories exercise every rule term
+    slots = jax.tree_util.tree_map(lambda h: h + 0.01, slots)
+    rate, it = jnp.float32(cfg.base_lr), jnp.int32(2)
+    ref_p, ref_s = updates.apply_update(cfg, params, grads, slots,
+                                        specs, rate, it)
+    for storage in ("f32", "bf16"):
+        layout = arena.build_layout(params, specs, cfg,
+                                    storage_dtype=storage)
+        P = arena.pack(layout, params)
+        G = arena.pack(layout, grads)
+        S = arena.pack_slots(layout, slots)
+        for impl in ("xla", "interpret"):
+            P2, S2 = arena.arena_apply_update(cfg, layout, P, G, S,
+                                              rate, it, force=impl)
+            got_p = arena.unpack(layout, P2)
+            got_s = arena.unpack_slots(layout, S2)
+            tol = 1e-6 if storage == "f32" else 4e-2
+            for a, b in zip(jax.tree_util.tree_leaves(ref_p),
+                            jax.tree_util.tree_leaves(got_p)):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=tol, atol=tol)
+            for a, b in zip(jax.tree_util.tree_leaves(ref_s),
+                            jax.tree_util.tree_leaves(got_s)):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=tol, atol=tol)
+            if storage == "f32" and impl == "xla" \
+                    and rule in ("SGD", "Nesterov"):
+                for a, b in zip(jax.tree_util.tree_leaves(ref_p),
+                                jax.tree_util.tree_leaves(got_p)):
+                    assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+# -- arena geometry ----------------------------------------------------------
+
+
+@pytest.mark.smoke
+def test_pack_unpack_roundtrip_and_index_map(zoo_step_state):
+    params, _, specs = zoo_step_state
+    cfg = models.cifar10_quick_solver()
+    layout = arena.build_layout(params, specs, cfg)
+    # geometry: spans tile-aligned, offsets contiguous, tables sized
+    from sparknet_tpu.ops.pallas_kernels import ARENA_TILE
+
+    off = 0
+    for e in layout.entries:
+        assert e.offset == off and e.span % ARENA_TILE == 0
+        assert e.span >= e.size
+        off += e.span
+    assert layout.total == off == layout.n_tiles * ARENA_TILE
+    assert len(layout.tile_lr) == len(layout.tile_decay) == layout.n_tiles
+    # the index map is the checkpoint contract: blob -> span, exact
+    rt = arena.unpack(layout, arena.pack(layout, params))
+    assert (jax.tree_util.tree_structure(rt)
+            == jax.tree_util.tree_structure(params))
+    for a, b in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(rt)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+        assert a.dtype == b.dtype
+    rows = layout.index_map()
+    assert len(rows) == len(layout.entries)
+    assert all(r["size"] <= r["span"] for r in rows)
+
+
+@pytest.mark.smoke
+def test_pad_zones_are_update_fixpoints(zoo_step_state):
+    """Pad elements (zero param, zero grad) must stay exactly zero
+    under the sweep — the property that makes arena reductions equal
+    their blob-wise twins."""
+    params, grads, specs = zoo_step_state
+    cfg = dataclasses.replace(models.cifar10_quick_solver(),
+                              solver_type="Adam")
+    layout = arena.build_layout(params, specs, cfg)
+    P = arena.pack(layout, params)
+    G = arena.pack(layout, grads)
+    S = arena.pack_slots(layout, updates.init_slots("Adam", params))
+    P2, S2 = arena.arena_apply_update(cfg, layout, P, G, S,
+                                      jnp.float32(0.01), jnp.int32(0),
+                                      force="xla")
+    pad = np.ones(layout.total, bool)
+    for e in layout.entries:
+        pad[e.offset:e.offset + e.size] = False
+    assert np.all(np.asarray(P2)[pad] == 0)
+    for s in S2:
+        assert np.all(np.asarray(s)[pad] == 0)
+
+
+# -- the fused Solver path ---------------------------------------------------
+
+
+def _run_solver(fused, storage="f32", n=2, scan=0):
+    set_config(fused_update=fused, storage_dtype=storage)
+    try:
+        rs = np.random.RandomState(3)
+        feed = _fixture_feed(rs)
+        solver = Solver(models.cifar10_quick_solver(),
+                        models.cifar10_quick(B))
+        if scan:
+            fn, v, sl, key = solver.jitted_scan_steps(scan, donate=False)
+            v, sl, losses = fn(
+                v, sl, 0, {k: jnp.asarray(x) for k, x in feed.items()},
+                key)
+            return np.asarray(losses), v
+        loss = solver.step(n, lambda it: feed)
+        return loss, solver.variables
+    finally:
+        set_config(fused_update=False, storage_dtype="f32")
+
+
+def test_fused_solver_step_matches_unfused():
+    l0, v0 = _run_solver(False)
+    l1, v1 = _run_solver(True)
+    assert np.allclose(l0, l1, rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(v0.params),
+                    jax.tree_util.tree_leaves(v1.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_fused_scan_steps_match_unfused():
+    """The arena-resident scan (arenas donated through the carry) is
+    trajectory-identical to the unfused scan."""
+    l0, _ = _run_solver(False, scan=3)
+    l1, _ = _run_solver(True, scan=3)
+    np.testing.assert_allclose(l0, l1, rtol=1e-5, atol=1e-6)
+
+
+def test_storage_bf16_arm_trains():
+    l32, _ = _run_solver(False)
+    lbf, vbf = _run_solver(True, storage="bf16")
+    assert np.isfinite(lbf)
+    # bf16 storage drifts but must stay loss-close at 2 steps from init
+    assert abs(lbf - l32) < 0.05
+    # persistent state stays blob-wise f32 (dtype-invariant snapshots)
+    for p in jax.tree_util.tree_leaves(vbf.params):
+        assert p.dtype == jnp.float32
+
+
+def test_checkpoint_roundtrip_through_index_map(tmp_path):
+    """A fused run's snapshot (written blob-wise through the arena
+    index map) restores into an UNFUSED solver and continues on the
+    same trajectory — and vice versa."""
+    rs = np.random.RandomState(5)
+    feed = _fixture_feed(rs)
+    set_config(fused_update=True)
+    try:
+        fused_solver = Solver(models.cifar10_quick_solver(),
+                              models.cifar10_quick(B))
+        fused_solver.step(2, lambda it: feed)
+        snap = fused_solver.save(str(tmp_path / "fused_snap"))
+    finally:
+        set_config(fused_update=False)
+    plain = Solver(models.cifar10_quick_solver(),
+                   models.cifar10_quick(B))
+    plain.restore(snap)
+    assert plain.iter == 2
+    l_plain = plain.step(1, lambda it: feed)
+    set_config(fused_update=True)
+    try:
+        l_fused = fused_solver.step(1, lambda it: feed)
+    finally:
+        set_config(fused_update=False)
+    assert abs(l_plain - l_fused) < 1e-4
+
+
+def test_dp_fused_trainer_round():
+    """tau=1 GSPMD DP with the fused step: same loss as the unfused
+    round (the trainer path never sees the arena — blob-boundary
+    contract)."""
+    from jax.sharding import Mesh
+
+    from sparknet_tpu.parallel.trainer import ParallelTrainer
+
+    devices = jax.devices()[:8]
+    mesh = Mesh(np.array(devices), ("data",))
+    rs = np.random.RandomState(7)
+    Bg = 16
+    feed = {"data": (rs.randn(Bg, 3, 32, 32) * 40).astype(np.float32),
+            "label": rs.randint(0, 10, Bg).astype(np.int32)}
+    losses = {}
+    for fused in (False, True):
+        set_config(fused_update=fused)
+        try:
+            solver = Solver(models.cifar10_quick_solver(),
+                            models.cifar10_quick(Bg))
+            trainer = ParallelTrainer(solver, mesh=mesh, tau=1)
+            losses[fused] = trainer.train_round(lambda it: feed)
+        finally:
+            set_config(fused_update=False)
+    assert np.allclose(losses[False], losses[True], rtol=1e-5, atol=1e-6)
+
+
+# -- static contracts --------------------------------------------------------
+
+
+@pytest.mark.smoke
+def test_vmem_bounds_fit_and_audited():
+    from sparknet_tpu.analysis.mem_model import V5E_VMEM_BYTES
+    from sparknet_tpu.ops.pallas_kernels import (
+        fused_update_vmem_bytes,
+        vmem_audit_points,
+    )
+
+    for n_slots in (1, 2):
+        for itemsize in (2, 4):
+            assert fused_update_vmem_bytes(n_slots, itemsize) \
+                < V5E_VMEM_BYTES
+    kinds = [p["kernel"] for p in vmem_audit_points()]
+    assert kinds.count("fused_update") == 3
+
+
+@pytest.mark.smoke
+def test_fused_update_hbm_model_is_single_pass():
+    from sparknet_tpu.ops.pallas_kernels import fused_update_hbm_bytes
+
+    ab = 1 << 20
+    # 1 read + 1 write per param byte, per slot byte, + 1 grad read
+    assert fused_update_hbm_bytes(ab, 1) == 5 * ab
+    assert fused_update_hbm_bytes(ab, 2) == 7 * ab
+
+
+@pytest.mark.smoke
+def test_tpu_export_single_custom_call():
+    """The whole normalize/regularize/clip/rule chain lowers (TPU
+    cross-export, zero chip time) as EXACTLY one custom call — the
+    graph-contract pin the solo_fused/dp_fused manifests bank."""
+    from sparknet_tpu.ops.pallas_kernels import (
+        fused_update_tpu_custom_calls,
+    )
+
+    assert fused_update_tpu_custom_calls(rule="SGD", n_slots=1) == 1
+    assert fused_update_tpu_custom_calls(rule="Adam", n_slots=2) == 1
+
+
+@pytest.mark.smoke
+def test_config_knobs_validate(fused_off):
+    assert get_config().fused_update is False  # default path untouched
+    assert get_config().storage_dtype == "f32"
+    set_config(storage_dtype="bfloat16")  # alias normalizes
+    assert get_config().storage_dtype == "bf16"
+    with pytest.raises(ValueError):
+        set_config(storage_dtype="int8")
+
+
+@pytest.mark.smoke
+def test_fused_update_rejects_bad_shapes(zoo_step_state):
+    from sparknet_tpu.ops.pallas_kernels import (
+        UpdateStatics,
+        fused_update,
+    )
+
+    w = jnp.zeros((100,), jnp.float32)  # not a tile multiple
+    with pytest.raises(ValueError):
+        fused_update("SGD", UpdateStatics(), w, w, [w],
+                     jnp.ones((1,)), jnp.zeros((1,)), jnp.ones((3,)))
