@@ -143,6 +143,22 @@ def expected_comm(mode: str, *, param_bytes: int, state_bytes: int = 0,
                  "outside the local-step loop (the paper's tau "
                  "amortization) — slots stay per-worker",
         )
+    if mode.startswith("elastic"):
+        # width-parameterized (elastic_w8/w6/w4 — parallel/elastic.py):
+        # the weighted tau round moves ONE model-sized weighted psum
+        # (params+state), one scalar weight-sum psum, and the loss pmean
+        # per ROUND, regardless of the mesh width the pool re-formed to
+        # — that invariance across W is exactly what the banked twins
+        # pin.  Slots stay per-worker, like the tau mode.
+        return CommExpectation(
+            required={"all-reduce": _window(param_bytes + state_bytes)},
+            forbidden=("all-to-all", "all-gather"),
+            loop_collectives_ok=False,
+            note="elastic tau round: ONE weighted model-sized psum per "
+                 "round (+ scalar weight sum), outside the local-step "
+                 "loop; contract is width-invariant across mesh "
+                 "re-formation",
+        )
     if mode == "easgd":
         return CommExpectation(
             required={"all-reduce": _window(param_bytes + state_bytes)},

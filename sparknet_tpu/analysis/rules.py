@@ -706,6 +706,89 @@ def check_fused_update_manifest(ctx: ModuleContext) -> Iterator[tuple[int, str]]
 
 
 # ---------------------------------------------------------------------------
+# elastic-manifest-fresh
+# ---------------------------------------------------------------------------
+
+# The elastic source surface lives inside sparknet_tpu/parallel/, so the
+# graph-/mem-manifest-fresh rules already hash-check its EDITS.  What
+# they cannot see is COVERAGE: whether the width-parameterized elastic
+# twin manifests (elastic_w*.json, ISSUE 8's >= 2 mesh widths) were
+# ever banked in a family, and whether the banked SOURCES fingerprints
+# fold elastic.py in at all (a SOURCES.json predating the elastic layer
+# hash-passes every other file while silently not covering this one).
+_ELASTIC_SOURCE = "sparknet_tpu/parallel/elastic.py"
+_ELASTIC_MIN_WIDTHS = 2
+_ELASTIC_REGEN = {
+    "graph_contracts": "regenerate with `python -m sparknet_tpu.analysis "
+                       "graph --update`",
+    "mem_contracts": "regenerate with `python -m sparknet_tpu.analysis "
+                     "mem --update`",
+}
+
+
+def _elastic_source_rel(path: str) -> tuple[str, str] | None:
+    norm = os.path.abspath(path).replace(os.sep, "/")
+    idx = norm.rfind("/sparknet_tpu/")
+    if idx < 0:
+        return None
+    root, rel = norm[:idx], norm[idx + 1:]
+    if rel == _ELASTIC_SOURCE:
+        return root, rel
+    return None
+
+
+@rule(
+    "elastic-manifest-fresh",
+    "the elastic trainer (parallel/elastic.py) must be folded into the "
+    "graph+mem SOURCES fingerprints with elastic_w* twin manifests "
+    "banked at >= 2 mesh widths in both families",
+)
+def check_elastic_manifest_fresh(ctx: ModuleContext) -> Iterator[tuple[int, str]]:
+    """The elastic twins are the proof that the comm/HBM contracts hold
+    ACROSS mesh re-formation — one banked width would only prove the
+    fixed-mesh case all over again.  graph-/mem-manifest-fresh already
+    flag a stale elastic.py hash (it sits on their parallel/ surface);
+    this rule owns the elastic-specific coverage: the banked
+    SOURCES.json must record elastic.py at all, and each manifest
+    family must carry at least ``_ELASTIC_MIN_WIDTHS`` elastic_w*
+    twins.  Blind spot (deliberate): hash staleness is NOT re-checked
+    here — one finding per stale file belongs to the dir-surface rules.
+    """
+    hit = _elastic_source_rel(ctx.path)
+    if hit is None:
+        return
+    root, rel = hit
+    for fam, regen in _ELASTIC_REGEN.items():
+        cdir = os.path.join(root, "docs", fam)
+        src = os.path.join(cdir, "SOURCES.json")
+        if not os.path.exists(src):
+            yield (1, f"{rel} is elastic contract source but no "
+                      f"manifests are banked (docs/{fam}/SOURCES.json "
+                      f"missing) — {regen}")
+            continue
+        try:
+            with open(src, encoding="utf-8") as f:
+                recorded = json.load(f)
+        except (OSError, ValueError):
+            yield (1, f"docs/{fam}/SOURCES.json unreadable — {regen}")
+            continue
+        if rel not in recorded:
+            yield (1, f"{rel} is not folded into the docs/{fam} SOURCES "
+                      f"fingerprint — the banked manifests predate the "
+                      f"elastic layer; {regen}")
+        try:
+            twins = [n for n in os.listdir(cdir)
+                     if n.startswith("elastic_w") and n.endswith(".json")]
+        except OSError:
+            twins = []
+        if len(twins) < _ELASTIC_MIN_WIDTHS:
+            yield (1, f"docs/{fam} banks {len(twins)} elastic_w* twin "
+                      f"manifest(s); the width-parameterized contract "
+                      f"needs >= {_ELASTIC_MIN_WIDTHS} mesh widths — "
+                      f"{regen}")
+
+
+# ---------------------------------------------------------------------------
 # queue-job-hygiene
 # ---------------------------------------------------------------------------
 

@@ -130,9 +130,20 @@ class FeedSpec:
     """Fixed per-batch geometry of one ring slot: an ordered
     ``name -> (shape, dtype)`` map plus the derived byte layout.  Every
     batch through the ring must match it exactly — fixed-size slots are
-    what make the ring allocation-free and the views zero-copy."""
+    what make the ring allocation-free and the views zero-copy.
+
+    ``max_respawns`` (policy, not geometry — excluded from equality so
+    batch/spec checks compare shapes only): how many worker deaths the
+    pipeline may absorb by respawning a replacement over the run's
+    lifetime.  0 (default) keeps the current behavior — the first death
+    raises.  A respawned worker re-owns the dead worker's shard
+    deterministically (sources are pure functions of the batch id, so
+    the replacement resumes at the first undelivered id with
+    ``g % workers == wid``) and the death is journaled as a ``feed``
+    stall event."""
 
     fields: tuple[tuple[str, tuple[int, ...], str], ...]
+    max_respawns: int = dataclasses.field(default=0, compare=False)
 
     @classmethod
     def from_arrays(cls, feeds: dict[str, np.ndarray]) -> "FeedSpec":
@@ -345,9 +356,13 @@ def _unregister_shm(shm, start_method: str) -> None:
 def _worker_loop(wid: int, nworkers: int, source: BatchSource,
                  transform, ring_name: str, spec: FeedSpec, slots: int,
                  free_q, full_q, stop, start_index: int, num_batches: int,
-                 poll_s: float, start_method: str = "fork") -> None:
+                 poll_s: float, start_method: str = "fork",
+                 first_g: int | None = None) -> None:
     """One producer: source -> transform -> slot memcpy, for every
-    global batch id ``g`` with ``g % nworkers == wid``."""
+    global batch id ``g`` with ``g % nworkers == wid``.  ``first_g``
+    overrides the iteration start (a RESPAWNED replacement resumes the
+    dead worker's shard at its first undelivered id — deterministic
+    because sources are pure functions of the id)."""
     from multiprocessing import shared_memory
 
     shm = None
@@ -357,8 +372,9 @@ def _worker_loop(wid: int, nworkers: int, source: BatchSource,
         views = [spec.views(shm.buf, s * spec.slot_bytes)
                  for s in range(slots)]
         bpe = source.batches_per_epoch
-        for g in range(start_index + wid, start_index + num_batches,
-                       nworkers):
+        for g in range(first_g if first_g is not None
+                       else start_index + wid,
+                       start_index + num_batches, nworkers):
             epoch, index = divmod(g, bpe) if bpe else (0, g)
             t0 = time.perf_counter()
             raw = source.get(epoch, index)
@@ -394,6 +410,16 @@ def _worker_loop(wid: int, nworkers: int, source: BatchSource,
 # ---------------------------------------------------------------------------
 # The pipeline
 # ---------------------------------------------------------------------------
+
+
+class _WorkerDeath(Exception):
+    """Internal: one identified producer died (raised by ``_next_msg``,
+    absorbed by the respawn policy or re-raised as RuntimeError)."""
+
+    def __init__(self, wid: int, message: str):
+        super().__init__(message)
+        self.wid = wid
+        self.message = message
 
 
 class _StageClock:
@@ -460,7 +486,8 @@ class ProcessPipeline:
                  slots: int | None = None, start_index: int = 0,
                  name: str = "feed", hold: int = 1, poll_s: float = 0.2,
                  obs_every: int = 32, spec: FeedSpec | None = None,
-                 start_method: str | None = None):
+                 start_method: str | None = None,
+                 max_respawns: int | None = None):
         from multiprocessing import shared_memory
 
         if num_batches <= 0:
@@ -471,6 +498,18 @@ class ProcessPipeline:
         self.start_index = int(start_index)
         self.workers = workers or feed_workers()
         self.hold = max(int(hold), 1)
+        # bounded worker-respawn policy (kwarg overrides the FeedSpec
+        # field; both default 0 = first death raises, the pre-respawn
+        # behavior).  Best-effort by design: a worker SIGKILLed mid-put
+        # can in principle corrupt an mp.Queue — the respawn absorbs
+        # the common deaths (OOM kill between batches, a raising
+        # source), not an adversarial scheduler.
+        self.max_respawns = int(max_respawns) if max_respawns is not None \
+            else int(getattr(spec, "max_respawns", 0) or 0)
+        self._respawns_used = 0
+        self._delivered_max: dict[int, int] = {}
+        self._pending: dict[int, tuple] = {}
+        self._held: list[int] = []
         # ring depth: every worker needs (hold + 1) OWNED slots — up to
         # ``hold`` of its delivered batches may still be retained by the
         # consumer while it produces the next one (see the module
@@ -503,7 +542,7 @@ class ProcessPipeline:
 
         import multiprocessing as mp
 
-        method = start_method or _start_method()
+        method = self._start_method = start_method or _start_method()
         ctx = mp.get_context(method)
         self._shm = None
         self._procs: list = []
@@ -566,20 +605,30 @@ class ProcessPipeline:
         clock = _StageClock(self.name, self.workers,
                             self._images_per_batch(), self._obs_every,
                             totals=self.stats)
-        pending: dict[int, tuple] = {}
-        held: list[int] = []
+        pending, held = self._pending, self._held
         try:
             for g in range(self.start_index,
                            self.start_index + self.num_batches):
                 t0 = time.perf_counter()
                 while g not in pending:
-                    msg = self._next_msg()
+                    try:
+                        msg = self._next_msg()
+                    except _WorkerDeath as death:
+                        self._respawn_or_raise(death.wid, death.message)  # graftlint: disable=stale-args-dispatch -- host-side failure path (death rebinds per except), never a timed device dispatch
+                        continue
                     kind, wid, gg, slot, extra = msg
                     if kind == "batch":
+                        if gg in pending:
+                            # duplicate after a respawn raced an
+                            # in-flight message from the dead worker:
+                            # keep the newest, recycle the older slot
+                            self._release(pending[gg][0])
                         pending[gg] = (slot, extra)
+                        if gg > self._delivered_max.get(wid, -1):
+                            self._delivered_max[wid] = gg
                     elif kind == "error":
-                        raise RuntimeError(
-                            f"feed worker {wid} raised:\n{extra}")
+                        self._respawn_or_raise(
+                            wid, f"feed worker {wid} raised:\n{extra}")
                     # "done" needs no handling: the loop bound already
                     # knows how many batches are owed
                 slot, (src_s, tr_s, wr_s) = pending.pop(g)
@@ -595,6 +644,7 @@ class ProcessPipeline:
                     self._release(slot)
                 except Exception:
                     pass  # ring already torn down
+            self._pending, self._held = {}, []
 
     def _release(self, slot: int) -> None:
         """Hand a consumed slot back to the worker that owns it."""
@@ -624,15 +674,17 @@ class ProcessPipeline:
 
     def _next_msg(self, timeout_s: float = 60.0):
         """One result-queue message, polling worker liveness: a producer
-        that died silently must surface as an error, not a hang."""
+        that died silently must surface as an error (or a respawn —
+        ``_WorkerDeath`` names the worker for the policy), not a hang."""
         deadline = time.monotonic() + timeout_s
         while True:
             try:
                 return self._full_q.get(timeout=self._poll_s)
             except _queue.Empty:
-                for p in self._procs:
+                for wid, p in enumerate(self._procs):
                     if p.exitcode not in (None, 0):
-                        raise RuntimeError(
+                        raise _WorkerDeath(
+                            wid,
                             f"feed worker {p.name} died with exitcode "
                             f"{p.exitcode} (killed? OOM?) before "
                             "delivering its batches")
@@ -645,6 +697,74 @@ class ProcessPipeline:
                         f"no feed batch arrived in {timeout_s:.0f}s "
                         f"({self.name}: {self.workers} workers alive but "
                         "silent)")
+
+    def _respawn_or_raise(self, wid: int, message: str) -> None:
+        """The bounded respawn policy (``FeedSpec.max_respawns`` /
+        constructor kwarg): with budget left, replace dead worker
+        ``wid`` with a fresh process resuming its shard at the first
+        undelivered id (deterministic re-ownership — sources are pure
+        functions of the batch id), reclaim its idle ring slots, and
+        journal the stall; with the budget exhausted (default 0),
+        re-raise as the RuntimeError the pre-respawn feed always
+        surfaced."""
+        if self._respawns_used >= self.max_respawns:
+            raise RuntimeError(message)
+        self._respawns_used += 1
+        old = self._procs[wid]
+        old.join(timeout=2.0)
+        if old.is_alive():
+            old.terminate()
+            old.join(timeout=2.0)
+        # Rebuild the worker's free list in a FRESH queue: a worker
+        # SIGKILLed inside ``free_q.get`` can die holding the queue's
+        # reader lock, and a replacement handed the same queue blocks
+        # on it forever.  Only this worker ever got from the queue, so
+        # abandoning it loses nothing; the free set is recomputed from
+        # slot ownership minus what the consumer still references —
+        # including a slot the dead worker had popped but never filled
+        # (it reported nothing, so its partial bytes are unobservable
+        # and the replacement rewrites them).
+        import multiprocessing as mp
+
+        method = self._start_method
+        ctx = mp.get_context(method)
+        in_use = {slot for slot, _ in self._pending.values()}
+        in_use.update(self._held)
+        old_q = self._free_qs[wid]
+        old_q.cancel_join_thread()
+        q = self._free_qs[wid] = ctx.Queue()
+        for s in range(self.slots):
+            if self._owner[s] == wid and s not in in_use:
+                q.put(s)
+        last = self._delivered_max.get(wid)
+        first_g = (last + self.workers) if last is not None \
+            else self.start_index + wid
+        import warnings
+
+        p = ctx.Process(
+            target=_worker_loop,
+            args=(wid, self.workers, self.source, self.transform,
+                  self._shm.name, self.spec, self.slots,
+                  q, self._full_q, self._stop,
+                  self.start_index, self.num_batches,
+                  self._poll_s, method, first_g),
+            daemon=True, name=f"{self.name}-worker-{wid}r{self._respawns_used}")
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message=r".*os\.fork\(\) was called.*",
+                category=RuntimeWarning)
+            p.start()
+        self._procs[wid] = p
+        from sparknet_tpu.obs import get_recorder
+
+        rec = get_recorder()
+        if rec:
+            rec.emit(
+                "feed", name=f"{self.name}.respawn", batches=0, images=0,
+                wall_s=0.0, stages={}, workers=self.workers,
+                note=f"worker {wid} died; shard re-owned from batch "
+                     f"{first_g} (respawn {self._respawns_used}/"
+                     f"{self.max_respawns}): {message.splitlines()[0]}")
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -7,11 +7,13 @@
   arguments, every ``docs/evidence_r*/journal.jsonl`` in the repo.
   Legacy deviations pass only via the explicit allowlist in
   ``obs/schema.py``.  Exit 1 on any non-allowlisted violation.
-* ``dryrun [--out p] [--rounds N]`` — the zero-chip-time proof: run dp
-  (tau=1 sync SGD) and tau (SparkNet averaging) rounds on the virtual
-  8-device CPU mesh with the Recorder armed, producing a journal whose
-  per-round records carry fenced walls, img/s, loss EMA, and the
-  comm_model-predicted collective budget.  Render it with ``report``.
+* ``dryrun [--out p] [--rounds N] [--elastic]`` — the zero-chip-time
+  proof: run dp (tau=1 sync SGD) and tau (SparkNet averaging) rounds on
+  the virtual 8-device CPU mesh with the Recorder armed, producing a
+  journal whose per-round records carry fenced walls, img/s, loss EMA,
+  and the comm_model-predicted collective budget.  ``--elastic`` adds a
+  fault-injected elastic leg (kill/join/straggle between rounds) whose
+  membership events land on the same schema.  Render with ``report``.
 """
 
 from __future__ import annotations
@@ -90,6 +92,12 @@ def dryrun_main(argv: list[str]) -> int:
     ap.add_argument("--tau", type=int, default=3)
     ap.add_argument("--devices", type=int, default=8)
     ap.add_argument("--family", default="cifar10_quick")
+    ap.add_argument(
+        "--elastic", action="store_true",
+        help="add an elastic fault-injection leg (parallel/elastic.py): "
+        "kill/join/straggle across rounds on the virtual mesh, so the "
+        "journal carries worker_lost/worker_joined/mesh_resize events "
+        "— still zero chip time")
     args = ap.parse_args(argv)
 
     # pin the CPU platform via the config route (the env var alone does
@@ -101,6 +109,8 @@ def dryrun_main(argv: list[str]) -> int:
 
     # a fresh journal per dryrun: appending over a previous run would
     # interleave run ids in the rendered report
+    out_dir = os.path.dirname(os.path.abspath(args.out))
+    os.makedirs(out_dir, exist_ok=True)
     if os.path.exists(args.out):
         os.remove(args.out)
     from sparknet_tpu.obs.recorder import Recorder, set_recorder
@@ -138,6 +148,28 @@ def dryrun_main(argv: list[str]) -> int:
     for _ in range(args.rounds):
         trainer.train_round(
             lambda it: _feeds_for(family, batch, rs, tau=args.tau))
+
+    if args.elastic:
+        from sparknet_tpu.parallel.elastic import (
+            ElasticTrainer, FaultPlan, delay, join, kill,
+        )
+
+        W = len(devices)
+        rounds = max(args.rounds, 4)  # enough rounds for every fault
+        print(f"obs dryrun: elastic mode, {rounds} round(s) with "
+              "kill/join/straggle ...", file=sys.stderr)
+        plan = FaultPlan([
+            kill(W - 1, at_round=1),
+            join(at_round=2),
+            delay(0, at_round=2, steps=args.tau),
+        ])
+        el = ElasticTrainer(
+            Solver(family.solver(), family.net(per_device)),
+            width=W, tau=args.tau, plan=plan, devices=devices)
+        el.train(
+            rounds,
+            lambda g: _feeds_for(family, per_device,
+                                 np.random.RandomState(g % 997)))
 
     rec.close()
     set_recorder(None)

@@ -106,6 +106,38 @@ def _feed_rows(feeds: list[dict]) -> list[str]:
     return lines
 
 
+def _member_rows(members: list[dict]) -> list[str]:
+    """Elastic membership timeline (parallel/elastic.py): every pool
+    change with its reason — a journal reader can reconstruct the mesh
+    width at any round from this table alone."""
+    lines = [
+        "| round | event | worker | width | detail |",
+        "|---|---|---|---|---|",
+    ]
+    for ev in members:
+        kind = ev.get("event", "?")
+        if kind == "mesh_resize":
+            detail = (f"{ev.get('from_width', '?')} -> "
+                      f"{ev.get('to_width', '?')} worker(s)")
+            worker = "—"
+            width = ev.get("to_width", "?")
+        else:
+            bits = []
+            if ev.get("staleness") is not None:
+                bits.append(f"staleness {ev['staleness']}")
+            if ev.get("weight") is not None:
+                bits.append(f"weight {ev['weight']:g}")
+            if ev.get("reason"):
+                bits.append(ev["reason"])
+            detail = "; ".join(bits) or "—"
+            worker = ev.get("worker", "?")
+            width = ev.get("width", "?")
+        lines.append(
+            f"| {ev.get('round', '?')} | {kind} | {worker} "
+            f"| {width} | {detail} |")
+    return lines
+
+
 def _bench_lines(benches: list[dict]) -> list[str]:
     lines = []
     for ev in benches:
@@ -179,10 +211,12 @@ def render(events: list[dict], source: str = "journal") -> str:
         if run_id not in by_run:
             runs.append(run_id)
             by_run[run_id] = {"start": [], "round": [], "span": [],
-                              "feed": [], "recompile": [], "bench": [],
-                              "bank": [], "end": []}
+                              "member": [], "feed": [], "recompile": [],
+                              "bench": [], "bank": [], "end": []}
         kind = ev.get("event")
-        key = {"run_start": "start", "run_end": "end"}.get(kind, kind)
+        key = {"run_start": "start", "run_end": "end",
+               "worker_lost": "member", "worker_joined": "member",
+               "mesh_resize": "member"}.get(kind, kind)
         if key in by_run[run_id]:
             by_run[run_id][key].append(ev)
 
@@ -198,6 +232,9 @@ def render(events: list[dict], source: str = "journal") -> str:
         if group["round"]:
             lines += ["", "### rounds", ""]
             lines += _round_rows(group["round"])
+        if group["member"]:
+            lines += ["", "### elastic membership", ""]
+            lines += _member_rows(group["member"])
         if group["span"]:
             lines += ["", "### spans", ""]
             lines += _span_rows(group["span"])

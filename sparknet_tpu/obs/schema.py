@@ -115,6 +115,28 @@ EVENTS: dict[str, tuple[dict, dict]] = {
         {"run_id": str, "count": int, "total": int},
         {"where": str, "expected": bool},
     ),
+    # -- elastic membership (parallel/elastic.py) -----------------------
+    # a worker left the averaging pool: killed by fault/plan, parked as
+    # a straggler, or dropped past the staleness bound.  ``width`` is
+    # the pool width AFTER the event; ``worker`` the stable worker id.
+    "worker_lost": (
+        {"run_id": str, "worker": int, "round": int, "width": int},
+        {"reason": str, "staleness": int},
+    ),
+    # a worker entered the pool: fresh join (adopting the consensus
+    # params+slots) or a straggler rejoining with its contribution
+    # damped to ``weight`` = staleness_decay ** staleness
+    "worker_joined": (
+        {"run_id": str, "worker": int, "round": int, "width": int},
+        {"staleness": int, "weight": _NUM, "reason": str},
+    ),
+    # the mesh re-formed at a new width (the membership changes above
+    # say why); the elastic trainer re-places surviving replicas and
+    # swaps to the cached per-width round program
+    "mesh_resize": (
+        {"run_id": str, "round": int, "from_width": int, "to_width": int},
+        {"devices": int, "reason": str},
+    ),
     # per-stage host-feed telemetry (data/pipeline.py): one aggregated
     # record per reporting window, ``stages`` mapping a stage name from
     # the docs/OBSERVABILITY.md "Feed stages" vocabulary (slot_wait /

@@ -45,14 +45,36 @@ def initialize_distributed(
     )
 
 
-def data_parallel_mesh(num_devices: int | None = None) -> Mesh:
+def data_parallel_mesh(num_devices: int | None = None,
+                       devices=None) -> Mesh:
     """1-D mesh over all (or the first N) devices on the data axis —
-    the direct analog of SparkNet's flat worker set."""
+    the direct analog of SparkNet's flat worker set.  ``devices``
+    restricts the pool the mesh is cut from (default: all visible)."""
     cfg = get_config()
-    devices = jax.devices()
+    devices = list(devices) if devices is not None else jax.devices()
     if num_devices is not None:
         devices = devices[:num_devices]
     return Mesh(np.array(devices), axis_names=(cfg.data_axis,))
+
+
+def sized_data_mesh(width: int, devices=None) -> Mesh:
+    """Shape-parameterized mesh re-formation: a fresh 1-D data mesh over
+    the first ``width`` devices of ``devices`` (default: all visible).
+
+    This is the elastic-membership primitive (``parallel/elastic.py``):
+    where SparkNet re-formed its worker set from whatever executors Spark
+    still had (the RDD fault-tolerance layer, ref: CifarApp.scala:27-33 —
+    design-replaced here), the TPU rebuild re-forms the MESH — the same
+    device pool re-cut at a new width between averaging rounds, so the
+    per-width round programs differ only in the mesh they close over.
+    """
+    devices = list(devices) if devices is not None else jax.devices()
+    if not (1 <= width <= len(devices)):
+        raise ValueError(
+            f"cannot form a {width}-wide data mesh from "
+            f"{len(devices)} device(s) (need 1 <= width <= pool size)")
+    cfg = get_config()
+    return Mesh(np.array(devices[:width]), axis_names=(cfg.data_axis,))
 
 
 def auto_mesh(

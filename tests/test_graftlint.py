@@ -33,6 +33,7 @@ EXPECTED_RULES = {
     "graph-manifest-fresh",
     "mem-manifest-fresh",
     "fused-update-manifest",
+    "elastic-manifest-fresh",
     "queue-job-hygiene",
     "obs-fenced-span",
     "feed-shm-cleanup",
@@ -617,6 +618,77 @@ def test_fused_update_manifest_ignores_non_contract_files(tmp_path):
     other.write_text(FRESH_SRC)
     assert not hits(FRESH_SRC, "fused-update-manifest", path=str(other))
     assert not hits(FRESH_SRC, "fused-update-manifest")
+
+
+# -- elastic-manifest-fresh -------------------------------------------------
+
+
+def _elastic_tree(tmp_path, record=True, covered=True, widths=(8, 6),
+                  families=("graph_contracts", "mem_contracts")):
+    """A fake repo around parallel/elastic.py: SOURCES.json (optionally
+    not covering it) + elastic_w*.json twin manifests per family."""
+    import hashlib
+    import json as _json
+
+    rel = "sparknet_tpu/parallel/elastic.py"
+    mod = tmp_path / rel
+    mod.parent.mkdir(parents=True, exist_ok=True)
+    mod.write_text(FRESH_SRC)
+    digest = hashlib.sha256(FRESH_SRC.encode()).hexdigest()
+    for fam in families:
+        cdir = tmp_path / "docs" / fam
+        cdir.mkdir(parents=True, exist_ok=True)
+        if record:
+            entry = {rel: digest} if covered else {"other.py": digest}
+            (cdir / "SOURCES.json").write_text(_json.dumps(entry))
+        for w in widths:
+            (cdir / f"elastic_w{w}.json").write_text("{}")
+    return str(mod)
+
+
+def test_elastic_manifest_fresh_clean_when_banked(tmp_path):
+    path = _elastic_tree(tmp_path)
+    assert not hits(FRESH_SRC, "elastic-manifest-fresh", path=path)
+
+
+def test_elastic_manifest_fresh_positive_when_never_banked(tmp_path):
+    path = _elastic_tree(tmp_path, record=False, widths=())
+    found = hits(FRESH_SRC, "elastic-manifest-fresh", path=path)
+    assert len(found) == 2  # one per family
+    assert "SOURCES.json missing" in found[0].message
+
+
+def test_elastic_manifest_fresh_positive_when_not_folded_in(tmp_path):
+    # manifests exist but predate the elastic layer: elastic.py absent
+    # from the fingerprint — exactly the silent-non-coverage hole the
+    # dir-hash rules cannot see
+    path = _elastic_tree(tmp_path, covered=False)
+    found = hits(FRESH_SRC, "elastic-manifest-fresh", path=path)
+    assert len(found) == 2
+    assert all("not folded into" in f.message for f in found)
+
+
+def test_elastic_manifest_fresh_positive_below_min_widths(tmp_path):
+    path = _elastic_tree(tmp_path, widths=(8,))
+    found = hits(FRESH_SRC, "elastic-manifest-fresh", path=path)
+    assert len(found) == 2
+    assert all(">= 2 mesh widths" in f.message for f in found)
+
+
+def test_elastic_manifest_fresh_suppressed(tmp_path):
+    path = _elastic_tree(tmp_path, record=False, widths=())
+    src = ("# graftlint: disable-file=elastic-manifest-fresh -- "
+           "manifest regen follows in this PR\n" + FRESH_SRC)
+    assert not hits(src, "elastic-manifest-fresh", path=path)
+    assert suppressed_hits(src, "elastic-manifest-fresh", path=path)
+
+
+def test_elastic_manifest_fresh_ignores_other_parallel_files(tmp_path):
+    other = tmp_path / "sparknet_tpu" / "parallel" / "trainer.py"
+    other.parent.mkdir(parents=True, exist_ok=True)
+    other.write_text(FRESH_SRC)
+    assert not hits(FRESH_SRC, "elastic-manifest-fresh", path=str(other))
+    assert not hits(FRESH_SRC, "elastic-manifest-fresh")
 
 
 # -- queue-job-hygiene ------------------------------------------------------

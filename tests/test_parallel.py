@@ -419,17 +419,11 @@ def test_multihost_two_process_cluster():
             for p in procs:
                 p.poll() is None and p.kill()
         if any(p.returncode != 0 for p in procs):
-            # known env drift (CHANGES PR 3): some jax builds reject the
-            # cross-process device_put equality check outright — the
-            # capability under test does not exist on this CPU backend,
-            # so skip rather than carry a standing red
-            if any("Multiprocess computations aren't implemented"
-                   in (o or "") for o in outs):
-                pytest.skip(
-                    "CPU backend rejects multiprocess device_put "
-                    "(\"Multiprocess computations aren't implemented on "
-                    "the CPU backend\") — known jax env drift, see "
-                    "CHANGES.md PR 3")
+            # known env drift: guard shared with the CLI multihost test
+            # (conftest.skip_if_cpu_multiprocess_drift)
+            from conftest import skip_if_cpu_multiprocess_drift
+
+            skip_if_cpu_multiprocess_drift(outs)
             return None
         return outs
 

@@ -140,6 +140,84 @@ def test_silent_worker_death_detected():
         pipe.close()
 
 
+def test_respawn_completes_stream_with_correct_contents():
+    """Opt-in bounded respawn (ISSUE 8 satellite): a SIGKILLed worker's
+    shard is deterministically re-owned by a replacement and the stream
+    still delivers every batch, in order, with the exact bytes the
+    source defines for each global id."""
+    src = SyntheticImageSource(batch=4, shape=(3, 12, 12), seed=7)
+    N = 24
+    with ProcessPipeline(src, num_batches=N, workers=2,
+                         max_respawns=2) as pipe:
+        it = pipe.batches()
+        got = [{k: np.array(v) for k, v in next(it).items()}
+               for _ in range(4)]
+        os.kill(pipe._procs[0].pid, signal.SIGKILL)
+        got += [{k: np.array(v) for k, v in next(it).items()}
+                for _ in range(N - 4)]
+        assert pipe._respawns_used == 1
+    for g, feeds in enumerate(got):
+        ref = src.get(0, g)
+        for k in ref:
+            np.testing.assert_array_equal(feeds[k], ref[k])
+
+
+def test_respawn_budget_zero_keeps_raising():
+    """Default FeedSpec.max_respawns == 0 preserves the PR 6 contract:
+    the first death raises (test_silent_worker_death_detected pins the
+    silent-kill arm; this pins that respawn never engages unasked)."""
+    assert FeedSpec.from_arrays({"x": np.zeros(2, np.float32)}
+                                ).max_respawns == 0
+    # a spec carrying a policy still EQUALS one probed from arrays:
+    # max_respawns is policy, not geometry (compare=False)
+    a = FeedSpec.from_arrays({"x": np.zeros(2, np.float32)})
+    b = FeedSpec(a.fields, max_respawns=3)
+    assert a == b
+
+
+def test_respawn_exhausted_budget_raises():
+    """A deterministically-raising source kills its replacement too:
+    the bounded budget drains and the original error surfaces."""
+    def fn(it):
+        if it == 2:
+            raise ValueError("decode exploded")
+        return {"x": np.zeros(2, np.float32)}
+
+    with ProcessPipeline(DataFnSource(fn), num_batches=8, workers=2,
+                         max_respawns=1) as pipe:
+        with pytest.raises(RuntimeError, match="decode exploded"):
+            list(pipe.batches())
+        assert pipe._respawns_used == 1
+
+
+def test_respawn_journals_feed_stall_event(tmp_path):
+    """Every absorbed death lands in the obs journal as a ``feed``
+    stall event naming the worker and the re-owned shard start."""
+    from sparknet_tpu.obs import schema
+    from sparknet_tpu.obs.recorder import Recorder, set_recorder
+
+    out = str(tmp_path / "feed.jsonl")
+    set_recorder(Recorder(out))
+    try:
+        src = SyntheticImageSource(batch=2, shape=(3, 8, 8), seed=3)
+        with ProcessPipeline(src, num_batches=12, workers=2,
+                             max_respawns=1, name="spawny") as pipe:
+            it = pipe.batches()
+            next(it)
+            os.kill(pipe._procs[1].pid, signal.SIGKILL)
+            for _ in range(11):
+                next(it)
+    finally:
+        set_recorder(None)
+    n, _, errors = schema.validate_journal(out)
+    assert not errors, errors
+    stalls = [e for e in schema.load_journal(out)
+              if e["event"] == "feed" and e["name"] == "spawny.respawn"]
+    assert len(stalls) == 1
+    assert "worker 1 died" in stalls[0]["note"]
+    assert "respawn 1/1" in stalls[0]["note"]
+
+
 def test_close_mid_consumption_releases_everything():
     """The ctrl-C shape: abandon the stream mid-run; close() must stop
     workers and unlink the ring (the autouse fixture asserts /dev/shm)."""
