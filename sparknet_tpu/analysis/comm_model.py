@@ -107,6 +107,15 @@ def expected_comm(mode: str, *, param_bytes: int, state_bytes: int = 0,
             forbidden=COLLECTIVE_KINDS,
             note="single chip: any collective is a lowering bug",
         )
+    # the serving engine's AOT bucket forwards (serve/engine.py):
+    # single-chip TEST-phase inference — solo's zero-collective contract
+    if mode.startswith("serve"):
+        return CommExpectation(
+            required={},
+            forbidden=COLLECTIVE_KINDS,
+            note="single-chip AOT serving forward: any collective is a "
+                 "lowering bug",
+        )
     # dp_nhwc shares dp's budget exactly: params never reorient under
     # the nhwc layout (ops/layout.py), so the grad all-reduce moves the
     # same bytes — a layout that changed this block would be a bug

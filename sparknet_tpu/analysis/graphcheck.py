@@ -136,6 +136,7 @@ GRAPH_RULES = {
 # started lowering through it)
 GRAPH_SOURCE_PATTERNS = (
     "sparknet_tpu/parallel/",
+    "sparknet_tpu/serve/",
     "sparknet_tpu/models/zoo.py",
     "sparknet_tpu/analysis/graphcheck.py",
     "sparknet_tpu/analysis/comm_model.py",
@@ -673,10 +674,12 @@ def sources_fingerprint(repo: str | None = None) -> dict:
     ``graph-manifest-fresh`` lint rule checks edits against)."""
     repo = repo or _REPO
     files: list[str] = []
-    pdir = os.path.join(repo, "sparknet_tpu", "parallel")
-    if os.path.isdir(pdir):
-        files += [os.path.join(pdir, f) for f in sorted(os.listdir(pdir))
-                  if f.endswith(".py")]
+    for sub in ("parallel", "serve"):
+        pdir = os.path.join(repo, "sparknet_tpu", sub)
+        if os.path.isdir(pdir):
+            files += [os.path.join(pdir, f)
+                      for f in sorted(os.listdir(pdir))
+                      if f.endswith(".py")]
     for rel in ("sparknet_tpu/models/zoo.py",
                 "sparknet_tpu/ops/layout.py",
                 "sparknet_tpu/analysis/graphcheck.py",

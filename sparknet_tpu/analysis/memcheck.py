@@ -114,6 +114,7 @@ MEM_RULES = {
 # graftlint rule mem-manifest-fresh compares edits against it)
 MEM_SOURCE_PATTERNS = (
     "sparknet_tpu/parallel/",
+    "sparknet_tpu/serve/",
     "sparknet_tpu/models/zoo.py",
     "sparknet_tpu/ops/pallas_kernels.py",
     "sparknet_tpu/ops/layout.py",

@@ -789,6 +789,80 @@ def check_elastic_manifest_fresh(ctx: ModuleContext) -> Iterator[tuple[int, str]
 
 
 # ---------------------------------------------------------------------------
+# serve-manifest-fresh
+# ---------------------------------------------------------------------------
+
+# Same shape as elastic-manifest-fresh, for the serving engine: the
+# serve/ package is graph-/mem-contract source (its bucket programs ARE
+# the serve_b* twins), so the banked SOURCES fingerprints must fold
+# every serve/*.py in, and each manifest family must carry the full
+# AOT bucket ladder — a SOURCES.json predating the serving layer
+# hash-passes everything else while silently not covering it.
+_SERVE_SOURCE_DIR = "sparknet_tpu/serve/"
+_SERVE_MIN_BUCKETS = 4
+_SERVE_REGEN = _ELASTIC_REGEN
+
+
+def _serve_source_rel(path: str) -> tuple[str, str] | None:
+    norm = os.path.abspath(path).replace(os.sep, "/")
+    idx = norm.rfind("/sparknet_tpu/")
+    if idx < 0:
+        return None
+    root, rel = norm[:idx], norm[idx + 1:]
+    if rel.startswith(_SERVE_SOURCE_DIR) and rel.endswith(".py"):
+        return root, rel
+    return None
+
+
+@rule(
+    "serve-manifest-fresh",
+    "the serving engine (sparknet_tpu/serve/) must be folded into the "
+    "graph+mem SOURCES fingerprints with serve_b* twin manifests "
+    "banked for the full AOT bucket ladder in both families",
+)
+def check_serve_manifest_fresh(ctx: ModuleContext) -> Iterator[tuple[int, str]]:
+    """The serve twins pin the very programs the engine AOT-compiles —
+    an unbanked bucket is a program no contract audits.  As with the
+    elastic rule, hash STALENESS belongs to graph-/mem-manifest-fresh
+    (serve/ sits on both dir surfaces); this rule owns coverage: the
+    banked SOURCES.json must record this serve/ file at all, and each
+    manifest family must carry >= ``_SERVE_MIN_BUCKETS`` serve_b*
+    twins (the 1/8/64/256 ladder).
+    """
+    hit = _serve_source_rel(ctx.path)
+    if hit is None:
+        return
+    root, rel = hit
+    for fam, regen in _SERVE_REGEN.items():
+        cdir = os.path.join(root, "docs", fam)
+        src = os.path.join(cdir, "SOURCES.json")
+        if not os.path.exists(src):
+            yield (1, f"{rel} is serving contract source but no "
+                      f"manifests are banked (docs/{fam}/SOURCES.json "
+                      f"missing) — {regen}")
+            continue
+        try:
+            with open(src, encoding="utf-8") as f:
+                recorded = json.load(f)
+        except (OSError, ValueError):
+            yield (1, f"docs/{fam}/SOURCES.json unreadable — {regen}")
+            continue
+        if rel not in recorded:
+            yield (1, f"{rel} is not folded into the docs/{fam} SOURCES "
+                      f"fingerprint — the banked manifests predate the "
+                      f"serving layer; {regen}")
+        try:
+            twins = [n for n in os.listdir(cdir)
+                     if n.startswith("serve_b") and n.endswith(".json")]
+        except OSError:
+            twins = []
+        if len(twins) < _SERVE_MIN_BUCKETS:
+            yield (1, f"docs/{fam} banks {len(twins)} serve_b* twin "
+                      f"manifest(s); the AOT ladder contract needs >= "
+                      f"{_SERVE_MIN_BUCKETS} buckets — {regen}")
+
+
+# ---------------------------------------------------------------------------
 # queue-job-hygiene
 # ---------------------------------------------------------------------------
 
@@ -804,7 +878,8 @@ _LEGACY_QUEUES = frozenset({"tpu_queue_r3.json", "tpu_queue_r4.json"})
 # in sync with mem_model._BENCH_ARGV + tools/pallas_bench.py)
 _QUEUE_BENCH_TOOLS = ("bench.py", "int8_bench.py", "layout_ab.py",
                       "scaling_bench.py", "feed_bench.py",
-                      "pallas_bench.py", "opt_update_ab.py")
+                      "pallas_bench.py", "opt_update_ab.py",
+                      "serve_bench.py", "elastic_ab.py")
 
 
 def _is_trace_job(job: dict) -> bool:

@@ -1703,6 +1703,29 @@ def cmd_bench(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """Synthetic load run through the AOT-batched serving engine
+    (sparknet_tpu/serve; docs/SERVING.md): loads a primary + aux model,
+    proves the priced over-HBM refusal, drives a closed-loop burst plan
+    through every bucket, and prints one summary JSON line.  The
+    recompile sentinel must read ZERO post-warmup compiles or the run
+    exits 1.
+
+    ref: apps/FeaturizerApp.scala:1 (the reference's batch scoring app;
+    dynamic request batching is new TPU-first surface)."""
+    import json as _json
+
+    from sparknet_tpu.serve.loadgen import load_run
+
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    summary = load_run(
+        requests=args.requests, family=args.family, arm=args.arm,
+        buckets=buckets, max_wait_ms=args.max_wait_ms,
+        log=lambda m: print(f"serve: {m}", file=sys.stderr))
+    print(_json.dumps(summary))
+    return 0 if summary["compiles_post_warmup"] == 0 else 1
+
+
 def cmd_device_query(args) -> int:
     """ref: caffe.cpp:110-150 device_query().
 
@@ -2018,6 +2041,18 @@ def main(argv=None) -> int:
     sp.add_argument("--dtype", default="",
                     choices=["", "bf16", "bfloat16", "f32"])
     sp.set_defaults(fn=cmd_bench)
+
+    sp = sub.add_parser("serve", help="AOT-batched serving load run")
+    sp.add_argument("--requests", type=int, default=504)
+    sp.add_argument("--family", default="cifar10_quick",
+                    help="cifar10_quick|lenet|mobilenet|transformer")
+    sp.add_argument("--arm", default="f32",
+                    choices=["f32", "fold_bn", "int8"])
+    sp.add_argument("--buckets", default="1,8,64,256",
+                    help="comma-separated AOT bucket ladder")
+    sp.add_argument("--max-wait-ms", type=float, default=5.0,
+                    help="deadline bound on any request's queue wait")
+    sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("device_query", help="show devices")
     sp.add_argument("--timeout", type=float, default=300.0,

@@ -13,7 +13,11 @@
   journal whose per-round records carry fenced walls, img/s, loss EMA,
   and the comm_model-predicted collective budget.  ``--elastic`` adds a
   fault-injected elastic leg (kill/join/straggle between rounds) whose
-  membership events land on the same schema.  Render with ``report``.
+  membership events land on the same schema.  ``--serve`` swaps the
+  training legs for the serving load run (sparknet_tpu/serve): >= 500
+  synthetic requests through every AOT bucket, a journaled over-HBM
+  load refusal, and exit 1 unless the recompile sentinel saw 0
+  post-warmup compiles.  Render with ``report``.
 """
 
 from __future__ import annotations
@@ -98,6 +102,15 @@ def dryrun_main(argv: list[str]) -> int:
         "kill/join/straggle across rounds on the virtual mesh, so the "
         "journal carries worker_lost/worker_joined/mesh_resize events "
         "— still zero chip time")
+    ap.add_argument(
+        "--serve", action="store_true",
+        help="run the serving load run INSTEAD of the training legs "
+        "(sparknet_tpu/serve): >= --requests synthetic requests through "
+        "every AOT bucket on two resident models, one journaled "
+        "over-HBM load refusal, and the recompile sentinel pinned at 0 "
+        "post-warmup compiles — still zero chip time")
+    ap.add_argument("--requests", type=int, default=504,
+                    help="request count for --serve (default 504)")
     args = ap.parse_args(argv)
 
     # pin the CPU platform via the config route (the env var alone does
@@ -116,6 +129,26 @@ def dryrun_main(argv: list[str]) -> int:
     from sparknet_tpu.obs.recorder import Recorder, set_recorder
 
     rec = set_recorder(Recorder(args.out))
+
+    if args.serve:
+        from sparknet_tpu.serve.loadgen import load_run
+
+        summary = load_run(
+            requests=args.requests, family=args.family,
+            log=lambda m: print(f"obs dryrun [serve]: {m}",
+                                file=sys.stderr))
+        rec.close()
+        set_recorder(None)
+        print(
+            f"obs dryrun [serve]: {summary['requests']} request(s), "
+            f"buckets {summary['buckets_exercised']}, "
+            f"{summary['compiles_post_warmup']} post-warmup compile(s), "
+            f"p50 {summary['p50_ms']:.2f} ms / "
+            f"p99 {summary['p99_ms']:.2f} ms, refusal journaled: "
+            f"{summary['refused']}")
+        print(f"obs dryrun: journal at {args.out} — render with "
+              f"`python -m sparknet_tpu.obs report {args.out}`")
+        return 0 if summary["compiles_post_warmup"] == 0 else 1
 
     import jax
     import numpy as np

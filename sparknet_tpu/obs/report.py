@@ -138,6 +138,78 @@ def _member_rows(members: list[dict]) -> list[str]:
     return lines
 
 
+def _pct(values: list[float], q: float) -> float:
+    """Nearest-rank percentile (same convention as serve/engine.py:
+    no value is interpolated into existence between real samples)."""
+    ordered = sorted(values)
+    rank = max(1, -(-int(q * len(ordered)) // 100))
+    return ordered[min(rank, len(ordered)) - 1]
+
+
+def _serve_lines(serves: list[dict]) -> list[str]:
+    """Engine lifecycle: loads, priced refusals, drains — the serving
+    twin of the runner's preflight_oom lines."""
+    lines = []
+    for ev in serves:
+        kind = ev.get("kind", "?")
+        who = ev.get("model", "?")
+        fam = ev.get("family")
+        arm = ev.get("arm")
+        label = who if fam is None else f"{who} ({fam}/{arm})"
+        if kind == "load_refused":
+            lines.append(
+                f"- **REFUSED load** `{label}`: predicted "
+                f"{ev.get('predicted_bytes', 0):,} B next to "
+                f"{ev.get('resident_bytes', 0):,} B resident exceeds "
+                f"the {ev.get('budget_bytes', 0):,} B usable-HBM budget "
+                "— refused before any compile")
+        elif kind == "model_loaded":
+            lines.append(
+                f"- loaded `{label}` buckets {ev.get('buckets', [])}, "
+                f"priced {ev.get('predicted_bytes', 0):,} B "
+                f"({ev.get('resident_bytes', 0):,} B now resident), "
+                f"all buckets AOT-compiled in "
+                f"{ev.get('wall_s', 0):.1f} s")
+        elif kind == "shutdown":
+            lines.append(
+                f"- shutdown drain served {ev.get('requests', 0)} "
+                "in-flight request(s) — zero lost")
+        else:
+            note = ev.get("note")
+            detail = f" — {note}" if note else ""
+            lines.append(f"- {kind} `{label}`{detail}")
+    return lines
+
+
+def _request_rows(requests: list[dict]) -> list[str]:
+    """The per-request latency histogram, rolled up per model x bucket:
+    p50/p99 totals plus the stage decomposition's tails.  Host+device
+    walls measured engine-side; the device stage is fence-stamped by its
+    serve_device span."""
+    groups: dict[tuple, list[dict]] = {}
+    for ev in requests:
+        groups.setdefault((str(ev.get("model", "?")),
+                           int(ev.get("bucket", 0))), []).append(ev)
+    lines = [
+        "| model | bucket | requests | p50 total ms | p99 total ms "
+        "| p99 queue ms | p50 device ms | deadline flushes | padded |",
+        "|---|---|---|---|---|---|---|---|---|",
+    ]
+    for (model, bucket) in sorted(groups):
+        evs = groups[(model, bucket)]
+        totals = [float(e.get("total_ms", 0)) for e in evs]
+        queues = [float(e.get("queue_wait_ms", 0)) for e in evs]
+        devices = [float(e.get("device_ms", 0)) for e in evs]
+        deadline = sum(1 for e in evs if e.get("deadline_flush"))
+        padded = sum(1 for e in evs if e.get("padded"))
+        lines.append(
+            f"| {model} | {bucket} | {len(evs)} "
+            f"| {_pct(totals, 50):.3f} | {_pct(totals, 99):.3f} "
+            f"| {_pct(queues, 99):.3f} | {_pct(devices, 50):.3f} "
+            f"| {deadline} | {padded} |")
+    return lines
+
+
 def _bench_lines(benches: list[dict]) -> list[str]:
     lines = []
     for ev in benches:
@@ -212,7 +284,8 @@ def render(events: list[dict], source: str = "journal") -> str:
             runs.append(run_id)
             by_run[run_id] = {"start": [], "round": [], "span": [],
                               "member": [], "feed": [], "recompile": [],
-                              "bench": [], "bank": [], "end": []}
+                              "bench": [], "bank": [], "end": [],
+                              "serve": [], "request": []}
         kind = ev.get("event")
         key = {"run_start": "start", "run_end": "end",
                "worker_lost": "member", "worker_joined": "member",
@@ -241,6 +314,13 @@ def render(events: list[dict], source: str = "journal") -> str:
         if group["feed"]:
             lines += ["", "### feed stages (host-side)", ""]
             lines += _feed_rows(group["feed"])
+        if group["serve"]:
+            lines += ["", "### serving engine", ""]
+            lines += _serve_lines(group["serve"])
+        if group["request"]:
+            lines += ["", "### request latency (p50/p99 per model × "
+                          "bucket)", ""]
+            lines += _request_rows(group["request"])
         if group["recompile"]:
             lines += ["", "### recompiles", ""]
             for ev in group["recompile"]:

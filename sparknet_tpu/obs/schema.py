@@ -165,6 +165,32 @@ EVENTS: dict[str, tuple[dict, dict]] = {
     "run_end": (
         {"run_id": str, "rounds": int, "spans": int, "compiles": int}, {},
     ),
+    # -- serving engine (sparknet_tpu/serve) ----------------------------
+    # one engine lifecycle event, discriminated by ``kind``:
+    # model_loaded / load_refused (the priced-residency admission gate,
+    # serve/residency.py — the serving twin of ``preflight_oom``) /
+    # model_unloaded / shutdown / summary (a load-run roll-up)
+    "serve": (
+        {"run_id": str, "kind": str},
+        {"model": str, "family": str, "arm": str, "buckets": list,
+         "predicted_bytes": int, "resident_bytes": int,
+         "budget_bytes": int, "requests": int, "batches": int,
+         "padded": int, "compiles": int, "p50_ms": _NUM, "p99_ms": _NUM,
+         "rps": _NUM, "wall_s": _NUM, "note": str},
+    ),
+    # one served request's latency decomposition (the p50/p99 material):
+    # queue_wait (submit -> flush) + batch_assembly (pad/fill) + device
+    # (executable call, fence included) = total.  ``bucket`` is the
+    # ladder bucket the request rode; ``padded`` whether the batch
+    # carried dead rows; ``deadline_flush`` whether max_wait_ms (not a
+    # full bucket) triggered the flush.
+    "request": (
+        {"run_id": str, "model": str, "bucket": int,
+         "queue_wait_ms": _NUM, "batch_assembly_ms": _NUM,
+         "device_ms": _NUM, "total_ms": _NUM},
+        {"batch_n": int, "padded": bool, "deadline_flush": bool,
+         "note": str},
+    ),
 }
 
 # Known-deviant legacy lines, forgiven explicitly (never silently): each

@@ -27,7 +27,10 @@ the fused ``update`` block), ``tp``
 (Megatron-style output-channel sharding), ``sp`` (Ulysses
 all-to-all sequence parallelism — the ring impl is trace-broken under
 the pinned jax, see test_seq_parallel's seed state), ``gpipe``
-(pipeline ppermute), ``moe`` (expert all_to_all dispatch).
+(pipeline ppermute), ``moe`` (expert all_to_all dispatch),
+``elastic_w{8,6,4}`` (width-parameterized τ-averaging twins), and
+``serve_b{1,8,64,256}`` (the serving engine's AOT bucket forwards —
+single-chip, forward-only, zero collectives).
 """
 
 from __future__ import annotations
@@ -496,6 +499,32 @@ def _mode_moe(devices) -> TraceTarget:
     )
 
 
+def _mode_serve(devices, bucket: int) -> TraceTarget:
+    """Bucket-parameterized serving twin (ISSUE 10): the EXACT forward
+    program the engine AOT-compiles for one ladder bucket
+    (``serve/engine.build_serve_program`` — TEST phase, end-bounded at
+    the score blob, no loss/accuracy tail).  Single chip, forward-only:
+    zero collectives, no carry (requests are stateless), and the
+    alt-args lowering pins shape-stable tracing — a bucket program that
+    recompiled per request would re-pay the relay's no-cache compile
+    tax on every flush."""
+    from sparknet_tpu.serve.engine import build_serve_program, exec_batch
+
+    fn, variables, feeds, alt_feeds = build_serve_program(
+        "cifar10_quick", bucket)
+    return TraceTarget(
+        name=f"serve_b{bucket}", fn=fn,
+        args=(variables, feeds),
+        alt_args=(variables, alt_feeds),
+        meta={"family": "cifar10_quick", "mesh": {}, "tau": 1,
+              "batch": exec_batch(bucket), "dtype": "f32",
+              "layout": "nchw", "serve": True,
+              "serve_bucket": int(bucket)},
+        param_bytes=_tree_bytes(variables.params),
+        state_bytes=_tree_bytes(variables.state),
+    )
+
+
 MODES: dict[str, Callable] = {
     "solo": _mode_solo,
     "solo_nhwc": _mode_solo_nhwc,
@@ -518,6 +547,15 @@ MODES: dict[str, Callable] = {
 MODES.update({
     f"elastic_w{w}": partial(_mode_elastic, width=w)
     for w in ELASTIC_WIDTHS
+})
+
+# bucket-parameterized serving twins: one per AOT ladder bucket, so the
+# graph+mem contracts pin the very programs the engine serves
+from sparknet_tpu.serve.engine import SERVE_BUCKETS  # noqa: E402
+
+MODES.update({
+    f"serve_b{b}": partial(_mode_serve, bucket=b)
+    for b in SERVE_BUCKETS
 })
 
 

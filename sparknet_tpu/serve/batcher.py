@@ -1,0 +1,192 @@
+"""Request queue + dynamic batcher: coalesce singles into AOT buckets.
+
+The batching policy, stated once (docs/SERVING.md "Bucket policy"):
+
+* A flush picks the SMALLEST bucket >= the pending count — padding is
+  wasted device work, so a trickle of 3 requests rides the 8-bucket,
+  never the 256-bucket.
+* The queue flushes when it can fill the LARGEST bucket (throughput
+  case) or when the OLDEST pending request has waited ``max_wait_ms``
+  (the deadline case — tail latency under trickle load is bounded by
+  max_wait_ms + one bucket's device time, never by traffic).
+* More than one largest-bucket's worth of pending requests drains as
+  multiple batches in one pump — overload parks requests in the queue,
+  not in half-full buckets.
+* ``close(drain=True)`` hands every in-flight request to the caller as
+  final batches: shutdown loses zero requests (tests/test_serve.py).
+
+Deliberately jax-free: payloads are opaque to the batcher (the engine
+owns device work), the clock is injectable (``clock=``) so the deadline
+tests advance time without sleeping, and the stdlib-only import
+surface keeps batcher unit tests off the backend entirely.
+
+ref: caffe/src/caffe/parallel.cpp P2PSync (the reference's only
+queue-shaped machinery — gradient exchange, not request batching; the
+serving queue is new TPU-first surface).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+
+__all__ = ["DynamicBatcher", "Ticket"]
+
+
+class Ticket:
+    """One in-flight request: submit-side handle + result rendezvous."""
+
+    __slots__ = ("id", "payload", "t_submit", "t_batch", "t_done",
+                 "bucket", "batch_n", "deadline_flush", "result",
+                 "error", "_done")
+
+    def __init__(self, rid: int, payload, t_submit: float):
+        self.id = rid
+        self.payload = payload
+        self.t_submit = t_submit
+        self.t_batch: float | None = None
+        self.t_done: float | None = None
+        self.bucket: int | None = None
+        self.batch_n: int | None = None
+        self.deadline_flush = False
+        self.result = None
+        self.error: BaseException | None = None
+        self._done = threading.Event()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def resolve(self, result=None, error: BaseException | None = None):
+        self.result = result
+        self.error = error
+        self._done.set()
+
+    def wait(self, timeout: float | None = None):
+        """Block for the result (raises the execution error, if any)."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.id} still pending after {timeout}s")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class DynamicBatcher:
+    """FIFO queue with bucket-quantized, deadline-bounded flushes.
+
+    Thread-safe: ``submit`` may be called from any number of client
+    threads while one pump loop (the engine worker, or a test calling
+    :meth:`take` directly) drains batches.  Time enters ONLY through
+    the injected ``clock`` — the deadline tests drive a fake clock, so
+    no test sleeps for its assertion.
+    """
+
+    def __init__(self, buckets=(1, 8, 64, 256), max_wait_ms: float = 5.0,
+                 clock=time.monotonic):
+        if not buckets or any(b <= 0 for b in buckets):
+            raise ValueError(f"buckets must be positive, got {buckets!r}")
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        self.max_wait_ms = float(max_wait_ms)
+        self.clock = clock
+        self._q: list[Ticket] = []
+        self._ids = itertools.count()
+        self._cv = threading.Condition()
+        self.closed = False
+
+    # -- submit side -------------------------------------------------------
+
+    def submit(self, payload) -> Ticket:
+        """Enqueue one request; returns its Ticket immediately."""
+        with self._cv:
+            if self.closed:
+                raise RuntimeError("batcher is closed")
+            t = Ticket(next(self._ids), payload, self.clock())
+            self._q.append(t)
+            self._cv.notify_all()
+            return t
+
+    def pending(self) -> int:
+        with self._cv:
+            return len(self._q)
+
+    # -- pump side ---------------------------------------------------------
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket that fits ``n`` requests (the padding-minimal
+        choice); the largest bucket when ``n`` overflows every bucket."""
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def _due(self, now: float) -> bool:
+        if not self._q:
+            return False
+        if len(self._q) >= self.buckets[-1]:
+            return True
+        return (now - self._q[0].t_submit) * 1e3 >= self.max_wait_ms
+
+    def take(self, force: bool = False) -> list[Ticket] | None:
+        """One batch, if a flush is due (or ``force``); else None.
+
+        The returned tickets are stamped with their batch geometry
+        (bucket, batch_n, deadline_flush) and ``t_batch``; resolving
+        them is the caller's job.
+        """
+        with self._cv:
+            now = self.clock()
+            if not self._q or not (force or self._due(now)):
+                return None
+            n = min(len(self._q), self.buckets[-1])
+            batch, self._q = self._q[:n], self._q[n:]
+            deadline = len(batch) < self.buckets[-1]
+            bucket = self.bucket_for(len(batch))
+            for t in batch:
+                t.t_batch = now
+                t.bucket = bucket
+                t.batch_n = len(batch)
+                t.deadline_flush = deadline
+            return batch
+
+    def wait_due(self, timeout: float | None = None) -> bool:
+        """Worker-loop helper: block until a flush is due or the batcher
+        closes.  Wakes at the oldest request's deadline without polling.
+        Only meaningful with a real clock."""
+        with self._cv:
+            deadline = None if timeout is None else self.clock() + timeout
+            while not self.closed:
+                now = self.clock()
+                if self._due(now):
+                    return True
+                waits = []
+                if self._q:
+                    waits.append(self._q[0].t_submit
+                                 + self.max_wait_ms / 1e3 - now)
+                if deadline is not None:
+                    remaining = deadline - now
+                    if remaining <= 0:
+                        return self._due(now)
+                    waits.append(remaining)
+                self._cv.wait(timeout=min(waits) if waits else None)
+            return self._due(self.clock())
+
+    def close(self, drain: bool = True) -> list[list[Ticket]]:
+        """Refuse new submits; return every in-flight request as final
+        batches (``drain=True``, the zero-loss contract) or fail them
+        with RuntimeError (``drain=False``)."""
+        with self._cv:
+            self.closed = True
+            self._cv.notify_all()
+        batches: list[list[Ticket]] = []
+        while True:
+            batch = self.take(force=True)
+            if batch is None:
+                break
+            if drain:
+                batches.append(batch)
+            else:
+                for t in batch:
+                    t.resolve(error=RuntimeError(
+                        "batcher closed without drain"))
+        return batches

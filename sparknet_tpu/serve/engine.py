@@ -1,0 +1,547 @@
+"""The serving engine: AOT bucket programs + priced multi-model residency.
+
+One ``ServeEngine`` holds several zoo models resident at once.  Loading
+a model (a) prices its worst-case bucket footprint against the banked
+batch-fit table and REFUSES over-HBM loads outright (residency.py —
+the queue pre-flight policy at serve time), then (b) pre-compiles one
+forward program per batch bucket via ``jax.jit(...).lower().compile()``
+so steady-state traffic never traces or compiles anything: the axon
+relay serves no executable cache (CLAUDE.md round-4 learnings), which
+makes a mid-serve recompile cost a FULL compile — the AOT bucket set is
+the serving-path answer to the same tax bench.py pays per retry.
+
+Request flow: ``submit`` -> per-model ``DynamicBatcher`` -> a flush
+(bucket-full or ``max_wait_ms`` deadline) -> zero-padded assembly into
+the smallest fitting bucket -> one executable call -> per-row results.
+Eval-mode forwards have no cross-example ops, so padded rows change
+NOTHING about real rows: batched output row i is bit-identical to a
+batch-1 run (the EXACT gate, tests/test_serve.py).
+
+Deploy arms ride the existing inference paths unchanged (and in the
+DeployNet ordering — fold BEFORE quantize, models/deploy.py):
+
+* ``f32``     — plain TEST-phase forward.
+* ``fold_bn`` — BN(+Scale) chains folded into producers (fold_bn.py).
+* ``int8``    — fold, calibrate on synthetic batches, then PTQ via
+  ``quant.quantized_inference`` — active at TRACE time, so the engine
+  enters it around ``.lower()`` (the quant.py contract).
+
+Every device wall is journaled as a fenced obs span and every request
+lands a ``request`` event (queue_wait / batch_assembly / device /
+total) — the p50/p99 material tools/serve_bench.py and the obs report
+roll up.
+
+ref: apps/FeaturizerApp.scala:1 (the reference's batch-scoring
+inference app — RDD-throughput-shaped; the queue/deadline/AOT machinery
+is new TPU-first surface).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+import numpy as np
+
+from sparknet_tpu.serve.batcher import DynamicBatcher, Ticket
+from sparknet_tpu.serve.residency import AdmissionPolicy, load_fit_table
+
+__all__ = [
+    "SERVE_BUCKETS",
+    "AdmissionRefused",
+    "ServeEngine",
+    "ServedModel",
+    "build_serve_program",
+]
+
+# the AOT bucket ladder: 1 (pure-latency floor), 8 (trickle), 64
+# (steady), 256 (the headline throughput batch — models.BENCH_CROPS'
+# alexnet shape).  Powers expose padding fractions <= 50% above the
+# previous rung, and four programs keep model-load compile time and
+# per-model executable residency small.
+SERVE_BUCKETS = (1, 8, 64, 256)
+
+# the 1-bucket executes at an internal batch of 2: XLA lowers a
+# single-row dot to a gemv whose reduction order differs from the
+# batched gemm, so a true batch-1 program is NOT bit-identical to the
+# batched buckets — one permanently-zero pad row restores bitwise
+# batch-invariance across the whole ladder (the EXACT gate's
+# foundation; measured on the CPU mesh, docs/SERVING.md "Exactness").
+EXEC_FLOOR = 2
+
+
+def exec_batch(bucket: int) -> int:
+    """The batch a bucket's program is actually compiled at."""
+    return max(int(bucket), EXEC_FLOOR)
+
+
+def _exactness_compiler_options() -> dict | None:
+    """Per-compile options pinning the EXACT gate on the CPU backend.
+
+    Threaded Eigen gemm partitions its reduction by the batch dimension,
+    so the same row summed inside an m=2 program and an m=8 program can
+    round differently — exactly the cross-bucket parity the serving
+    contract promises.  Single-threading Eigen restores a deterministic
+    per-row reduction order across the latency buckets.  The TPU MXU's
+    systolic reduction is batch-invariant by architecture, so chips get
+    no option (docs/SERVING.md "Exactness")."""
+    if jax.default_backend() == "cpu":
+        return {"xla_cpu_multi_thread_eigen": False}
+    return None
+
+_ARMS = ("f32", "fold_bn", "int8")
+
+
+class AdmissionRefused(RuntimeError):
+    """A model load the batch-fit table predicts won't fit resident HBM
+    (the verdict dict rides on ``.verdict``)."""
+
+    def __init__(self, verdict: dict):
+        self.verdict = verdict
+        super().__init__(
+            f"model load refused: {verdict['family']} at bucket "
+            f"{verdict['max_bucket']} predicts "
+            f"{verdict['predicted_bytes']:,} B next to "
+            f"{verdict['resident_bytes']:,} B resident — over the "
+            f"{verdict['budget_bytes']:,} B usable-HBM budget")
+
+
+# ---------------------------------------------------------------------------
+# Forward-program construction (shared with parallel/modes.py serve_b*)
+# ---------------------------------------------------------------------------
+
+
+def _score_blob(network) -> str:
+    """The blob the engine returns per request: the score/logits blob —
+    the first loss/accuracy layer's non-label bottom (every zoo
+    classifier wires ``score, label -> loss``), else the net's last
+    declared output (label-free families like the autoencoder)."""
+    for layer in network.layers:
+        if "label" in layer.bottoms:
+            return next(b for b in layer.bottoms if b != "label")
+    return network.output_blobs()[-1]
+
+
+def _end_layer(network, blob: str) -> str:
+    """The last layer producing ``blob`` — where the serve forward stops
+    (in-place chains rebind a blob several times; the LAST producer is
+    the value consumers see, compiler/graph.py apply contract)."""
+    name = None
+    for layer in network.layers:
+        if blob in layer.tops:
+            name = layer.name
+    if name is None:
+        raise ValueError(f"no layer produces blob {blob!r}")
+    return name
+
+
+def _forward_fn(network, blob: str, end: str):
+    def forward(variables, feeds):
+        blobs, _, _ = network.apply(
+            variables, feeds, rng=None, train=False, end=end)
+        return blobs[blob]
+    return forward
+
+
+def _family(family_name: str):
+    from sparknet_tpu.models.zoo import GRAPH_SWEEP_FAMILIES
+
+    if family_name not in GRAPH_SWEEP_FAMILIES:
+        raise KeyError(
+            f"unknown zoo family {family_name!r}; serveable families: "
+            f"{sorted(GRAPH_SWEEP_FAMILIES)}")
+    return GRAPH_SWEEP_FAMILIES[family_name]
+
+
+def _synthetic_feeds(family, batch: int, seed: int = 0) -> dict:
+    """Batcher-shaped synthetic feeds (same generator as the graph
+    sweep's — parallel/modes.py ``_feeds_for``)."""
+    from sparknet_tpu.parallel.modes import _feeds_for
+
+    return _feeds_for(family, batch, np.random.RandomState(seed))
+
+
+def build_serve_program(family_name: str = "cifar10_quick",
+                        bucket: int = 1, seed: int = 0):
+    """The EXACT f32 forward the engine AOT-compiles for one bucket,
+    exposed for the graph/mem contract twins (``serve_b{N}`` in
+    parallel/modes.py): ``(jit_fn, variables, feeds, alt_feeds)`` where
+    ``alt_feeds`` carries identical shapes with different values — the
+    recompile-hazard audit's second lowering."""
+    import jax.numpy as jnp
+
+    from sparknet_tpu.common import Phase
+    from sparknet_tpu.compiler.graph import Network
+
+    family = _family(family_name)
+    batch = exec_batch(bucket)
+    network = Network(family.net(batch), Phase.TEST)
+    variables = network.init(jax.random.key(seed))
+    blob = _score_blob(network)
+    fn = jax.jit(_forward_fn(network, blob, _end_layer(network, blob)))
+    feeds = {k: jnp.asarray(v)
+             for k, v in _synthetic_feeds(family, batch, seed).items()}
+    alt_feeds = {k: jnp.asarray(v)
+                 for k, v in _synthetic_feeds(family, batch,
+                                              seed + 1).items()}
+    return fn, variables, feeds, alt_feeds
+
+
+# ---------------------------------------------------------------------------
+# Served model: per-arm variables + one compiled executable per bucket
+# ---------------------------------------------------------------------------
+
+
+class ServedModel:
+    """One resident model: arm-transformed variables, a compiled
+    executable per bucket, and its own request batcher."""
+
+    def __init__(self, name: str, family_name: str, arm: str,
+                 buckets: tuple, max_wait_ms: float, clock,
+                 predicted_bytes: int, seed: int = 0,
+                 calibration_batches: int = 2):
+        from sparknet_tpu.common import Phase
+        from sparknet_tpu.compiler.graph import Network, NetVars
+        from sparknet_tpu.ops.layout import internal_shape
+
+        self.name = name
+        self.family_name = family_name
+        self.arm = arm
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        self.predicted_bytes = int(predicted_bytes)
+        self.batcher = DynamicBatcher(self.buckets, max_wait_ms, clock)
+        self.qstate: dict | None = None
+
+        family = _family(family_name)
+        self.family = family
+        if family.feed == "tokens":
+            self.item_shape: tuple = (family.seq_len,)
+            self.item_dtype = np.int32
+        else:
+            self.item_shape = internal_shape(
+                (1, *family.image_shape))[1:]
+            self.item_dtype = np.float32
+
+        base = Network(family.net(self.buckets[0]), Phase.TEST)
+        self.variables = base.init(jax.random.key(seed))
+
+        def network_for(bucket: int):
+            net_param = family.net(exec_batch(bucket))
+            if arm in ("fold_bn", "int8"):
+                from sparknet_tpu.models.fold_bn import fold_batchnorm
+
+                folded_net, params, state, _ = fold_batchnorm(
+                    net_param, self.variables.params,
+                    self.variables.state)
+                return Network(folded_net, Phase.TEST), \
+                    NetVars(params=params, state=state)
+            return Network(net_param, Phase.TEST), self.variables
+
+        # arm transforms happen ONCE, at the smallest bucket (the fold
+        # algebra and the calibration stream are batch-invariant); every
+        # bucket then serves the same variables pytree bit-for-bit
+        net0, self.variables = network_for(self.buckets[0])
+        if arm == "int8":
+            from sparknet_tpu import quant
+
+            self.qstate = quant.calibrate(
+                net0, self.variables,
+                (_synthetic_feeds(family, 8, seed=s + 1)
+                 for s in range(calibration_batches)),
+                num_batches=calibration_batches)
+
+        self.score_blob = _score_blob(net0)
+        self.executables: dict[int, object] = {}
+        self.compile_wall_s = 0.0
+        t0 = time.perf_counter()
+        for bucket in self.buckets:
+            net_b, _ = network_for(bucket)
+            fn = _forward_fn(net_b, self.score_blob,
+                             _end_layer(net_b, self.score_blob))
+            ctx = (quant_ctx(self.qstate) if arm == "int8"
+                   else contextlib.nullcontext())
+            example = self._example_feeds(bucket)
+            with ctx:
+                lowered = jax.jit(fn).lower(self.variables, example)
+            # graftlint: disable-next-line=stale-args-dispatch -- each iteration compiles a DIFFERENT bucket program (fn/example rebind above); the wall is host compile time, not a timed device loop
+            self.executables[bucket] = lowered.compile(
+                compiler_options=_exactness_compiler_options())
+        self.compile_wall_s = time.perf_counter() - t0
+
+        # rolled per-request latencies (ms), the serve_bench material
+        self.lat_total_ms: list[float] = []
+        self.lat_queue_ms: list[float] = []
+        self.lat_device_ms: list[float] = []
+        self.requests = 0
+        self.batches = 0
+        self.padded_rows = 0
+
+    def _example_feeds(self, bucket: int) -> dict:
+        """Shape/dtype templates for ``.lower()`` — abstract structs, so
+        AOT compilation allocates nothing batch-sized.  Shaped at the
+        EXEC batch (>= EXEC_FLOOR), not the ladder bucket."""
+        n = exec_batch(bucket)
+        data = jax.ShapeDtypeStruct((n, *self.item_shape),
+                                    self.item_dtype)
+        label = jax.ShapeDtypeStruct((n,), np.int32)
+        return {"data": data, "label": label}
+
+
+def quant_ctx(qstate: dict):
+    from sparknet_tpu import quant
+
+    return quant.quantized_inference(qstate)
+
+
+# ---------------------------------------------------------------------------
+# The engine
+# ---------------------------------------------------------------------------
+
+
+class ServeEngine:
+    """Multi-model serving front end: priced loads, dynamic batching,
+    AOT-bucket execution, per-request telemetry.
+
+    ``clock`` is injectable (batcher deadline tests drive a fake one);
+    device walls always come from the real ``time.perf_counter`` and
+    are fence-stamped — the injectable clock orders queue events, it
+    never times the chip.
+    """
+
+    def __init__(self, buckets: tuple = SERVE_BUCKETS,
+                 max_wait_ms: float = 5.0, *,
+                 fit_table: dict | None = None,
+                 hbm_bytes: int | None = None,
+                 clock=time.monotonic,
+                 calibration_batches: int = 2):
+        from sparknet_tpu.analysis.mem_model import V5E_HBM_BYTES
+
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        self.max_wait_ms = float(max_wait_ms)
+        self.clock = clock
+        self.calibration_batches = int(calibration_batches)
+        self.policy = AdmissionPolicy(
+            fit_table if fit_table is not None else load_fit_table(),
+            hbm_bytes=hbm_bytes or V5E_HBM_BYTES)
+        self._models: dict[str, ServedModel] = {}
+        self._resident_bytes = 0
+        self._closed = False
+
+    # -- model lifecycle ---------------------------------------------------
+
+    def resident_bytes(self) -> int:
+        return self._resident_bytes
+
+    def models(self) -> list[str]:
+        return list(self._models)
+
+    def load_model(self, name: str, family: str = "cifar10_quick",
+                   arm: str = "f32", buckets: tuple | None = None,
+                   seed: int = 0) -> ServedModel:
+        """Price, maybe refuse, else AOT-compile every bucket.  The
+        refusal happens BEFORE any jax work — a refused load journals
+        its verdict and costs zero compile seconds and zero dials."""
+        from sparknet_tpu.obs.recorder import get_recorder
+
+        if arm not in _ARMS:
+            raise ValueError(f"unknown arm {arm!r}; one of {_ARMS}")
+        if name in self._models:
+            raise ValueError(f"model {name!r} already resident")
+        buckets = tuple(sorted(set(buckets or self.buckets)))
+        rec = get_recorder()
+        verdict = self.policy.admit(family, buckets[-1],
+                                    self._resident_bytes)
+        if not verdict["fits"]:
+            rec.emit(
+                "serve", kind="load_refused", model=name, family=family,
+                arm=arm, buckets=list(buckets),
+                predicted_bytes=verdict["predicted_bytes"],
+                resident_bytes=verdict["resident_bytes"],
+                budget_bytes=verdict["budget_bytes"],
+                note="batch-fit table predicts over-HBM residency — "
+                     "refused before any compile (queue pre-flight "
+                     "policy at serve time)")
+            raise AdmissionRefused(verdict)
+        model = ServedModel(
+            name, family, arm, buckets, self.max_wait_ms, self.clock,
+            verdict["predicted_bytes"], seed=seed,
+            calibration_batches=self.calibration_batches)
+        self._models[name] = model
+        self._resident_bytes += model.predicted_bytes
+        rec.emit(
+            "serve", kind="model_loaded", model=name, family=family,
+            arm=arm, buckets=list(model.buckets),
+            predicted_bytes=model.predicted_bytes,
+            resident_bytes=self._resident_bytes,
+            budget_bytes=verdict["budget_bytes"],
+            wall_s=round(model.compile_wall_s, 6),
+            note="all buckets AOT-compiled at load "
+                 "(jit().lower().compile())")
+        return model
+
+    def unload_model(self, name: str) -> None:
+        from sparknet_tpu.obs.recorder import get_recorder
+
+        model = self._models.pop(name)
+        model.batcher.close(drain=False)
+        self._resident_bytes -= model.predicted_bytes
+        get_recorder().emit(
+            "serve", kind="model_unloaded", model=name,
+            family=model.family_name, arm=model.arm,
+            resident_bytes=self._resident_bytes)
+
+    # -- request path ------------------------------------------------------
+
+    def submit(self, model_name: str, item) -> Ticket:
+        """Enqueue one request (a single example, item-shaped)."""
+        model = self._models[model_name]
+        item = np.asarray(item, model.item_dtype)
+        if item.shape != model.item_shape:
+            raise ValueError(
+                f"request shape {item.shape} != model item shape "
+                f"{model.item_shape}")
+        return model.batcher.submit(item)
+
+    def infer(self, model_name: str, item,
+              timeout: float | None = 60.0):
+        """Synchronous single-request path: submit, flush immediately
+        (bucket 1 — no batching win to wait for), return the scores."""
+        ticket = self.submit(model_name, item)
+        self.pump(force=True)
+        return ticket.wait(timeout)
+
+    def pump(self, force: bool = False) -> int:
+        """Drain every model's due batches on the caller's thread;
+        returns the number of batches executed.  The synchronous twin of
+        :meth:`serve_forever` — tests, the dryrun, and closed-loop
+        benches drive this directly."""
+        executed = 0
+        for model in list(self._models.values()):
+            while True:
+                batch = model.batcher.take(force=force)
+                if batch is None:
+                    break
+                self._execute(model, batch)
+                executed += 1
+        return executed
+
+    def serve_forever(self, until=None, poll_s: float = 0.05) -> int:
+        """Worker loop: block on flush deadlines, execute batches, exit
+        when ``until()`` goes truthy (or the engine shuts down).
+        Returns batches executed."""
+        executed = 0
+        while not self._closed and not (until and until()):
+            ready = False
+            for model in list(self._models.values()):
+                if model.batcher.wait_due(timeout=poll_s):
+                    ready = True
+                    break
+            if ready:
+                executed += self.pump()
+        return executed
+
+    def shutdown(self) -> int:
+        """Drain: every in-flight request is executed before the engine
+        stops accepting work — zero requests lost (the batcher close
+        contract).  Returns requests served during the drain."""
+        from sparknet_tpu.obs.recorder import get_recorder
+
+        self._closed = True
+        drained = 0
+        for model in list(self._models.values()):
+            for batch in model.batcher.close(drain=True):
+                self._execute(model, batch)
+                drained += len(batch)
+        get_recorder().emit(
+            "serve", kind="shutdown", requests=drained,
+            note="queue drained on shutdown — zero in-flight requests "
+                 "lost")
+        return drained
+
+    # -- execution ---------------------------------------------------------
+
+    def _execute(self, model: ServedModel, tickets: list) -> None:
+        """One padded-bucket executable call; resolves every ticket and
+        journals its request record."""
+        from sparknet_tpu.obs.recorder import get_recorder
+
+        rec = get_recorder()
+        bucket = tickets[0].bucket
+        n = exec_batch(bucket)
+        asm0 = time.perf_counter()
+        data = np.zeros((n, *model.item_shape), model.item_dtype)
+        for i, t in enumerate(tickets):
+            data[i] = t.payload
+        label = np.zeros((n,), np.int32)
+        asm_ms = (time.perf_counter() - asm0) * 1e3
+        dev0 = time.perf_counter()
+        try:
+            with rec.span("serve_device",
+                          note=f"{model.name}/b{bucket}") as sp:
+                out = model.executables[bucket](
+                    model.variables, {"data": data, "label": label})
+                # np.asarray on the executable's own output buffer IS
+                # the value fence (common.value_fence mechanism) — the
+                # whole batch is fetched anyway to scatter rows back
+                out_np = np.asarray(out)
+                sp.fence_value(float(out_np.ravel()[-1]))
+        except Exception as e:
+            for t in tickets:
+                # graftlint: disable-next-line=stale-args-dispatch -- host-side error fan-out to waiting tickets, never a device dispatch
+                t.resolve(error=e)
+            raise
+        device_ms = (time.perf_counter() - dev0) * 1e3
+        now = self.clock()
+        model.batches += 1
+        model.padded_rows += bucket - len(tickets)
+        for i, t in enumerate(tickets):
+            t.t_done = now
+            queue_ms = max(0.0, (t.t_batch - t.t_submit) * 1e3)
+            total_ms = queue_ms + asm_ms + device_ms
+            t.resolve(result=out_np[i])
+            model.requests += 1
+            model.lat_total_ms.append(total_ms)
+            model.lat_queue_ms.append(queue_ms)
+            model.lat_device_ms.append(device_ms)
+            rec.emit(
+                "request", model=model.name, bucket=bucket,
+                queue_wait_ms=round(queue_ms, 4),
+                batch_assembly_ms=round(asm_ms, 4),
+                device_ms=round(device_ms, 4),
+                total_ms=round(total_ms, 4),
+                batch_n=len(tickets), padded=bucket > len(tickets),
+                deadline_flush=bool(t.deadline_flush))
+
+    # -- telemetry ---------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Per-model latency/throughput roll-up (host-side walls)."""
+        out: dict = {}
+        for name, model in self._models.items():
+            out[name] = {
+                "family": model.family_name,
+                "arm": model.arm,
+                "buckets": list(model.buckets),
+                "requests": model.requests,
+                "batches": model.batches,
+                "padded_rows": model.padded_rows,
+                "predicted_bytes": model.predicted_bytes,
+                "p50_ms": percentile(model.lat_total_ms, 50),
+                "p99_ms": percentile(model.lat_total_ms, 99),
+                "queue_p99_ms": percentile(model.lat_queue_ms, 99),
+                "device_p50_ms": percentile(model.lat_device_ms, 50),
+            }
+        return out
+
+
+def percentile(values: list, q: float) -> float:
+    """Nearest-rank percentile (the latency-report convention: p99 of
+    100 samples is the 99th sorted value, no interpolation invented
+    between real measurements).  Empty input reads 0.0 so stats paths
+    stay arithmetic-safe before any traffic lands."""
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    rank = max(1, int(np.ceil(q / 100.0 * len(ordered))))
+    return float(ordered[rank - 1])

@@ -1,0 +1,155 @@
+"""Synthetic load generation for the serving engine.
+
+One deterministic closed-loop "load run" shared by the three chip-free
+consumers — ``python -m sparknet_tpu.obs dryrun --serve``, graft-entry
+dryrun mode 18, and tests/test_serve.py — so they all exercise the same
+thing: every ladder bucket, a multi-model resident set, one journaled
+over-HBM refusal, and the recompile sentinel across >= 500 requests.
+
+The burst plan covers the bucket ladder end to end: singles ride the
+1-bucket, small bursts pad into the 8-bucket, and the 64/256 bursts
+fill their buckets exactly.  The sentinel is snapshotted AFTER model
+loads and a one-batch-per-bucket warmup — every load compiles its
+buckets by design; what must be zero is compiles caused by *traffic*.
+
+ref: apps/ImageNetRunDBApp.scala:1 (the reference's synthetic-drive
+scoring loop; open/closed-loop arrival processes are new surface).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from sparknet_tpu.serve.engine import (
+    SERVE_BUCKETS,
+    AdmissionRefused,
+    ServeEngine,
+    percentile,
+)
+
+__all__ = ["burst_plan", "load_run", "synthetic_items"]
+
+
+def synthetic_items(model, n: int, rs: np.random.RandomState) -> list:
+    """``n`` single-request payloads in the model's item shape/dtype."""
+    if model.item_dtype == np.int32:
+        vocab = getattr(model.family, "vocab", 2) or 2
+        return [rs.randint(0, vocab, model.item_shape).astype(np.int32)
+                for _ in range(n)]
+    return [(rs.randn(*model.item_shape) * 10).astype(np.float32)
+            for _ in range(n)]
+
+
+def burst_plan(requests: int = 504,
+               buckets: tuple = SERVE_BUCKETS) -> list[int]:
+    """A deterministic burst-size sequence covering every bucket:
+    largest-first fills (one burst per bucket, exact fit), then padded
+    mid-bursts, then a trickle of singles up to ``requests`` total."""
+    plan = [b for b in sorted(buckets, reverse=True)]
+    mid = sorted(buckets)[min(1, len(buckets) - 1)]
+    while sum(plan) + mid <= requests:
+        plan.append(max(1, mid - 3) if len(plan) % 3 == 0 else mid)
+    while sum(plan) < requests:
+        plan.append(1)
+    return plan
+
+
+def load_run(requests: int = 504, family: str = "cifar10_quick",
+             arm: str = "f32",
+             extra_models: tuple = (("aux", "lenet", "f32"),),
+             buckets: tuple = SERVE_BUCKETS, max_wait_ms: float = 5.0,
+             refusal_family: str | None = "resnet50", seed: int = 0,
+             log=None) -> dict:
+    """The closed-loop CPU-mesh load run (zero chip time).
+
+    Returns a summary dict and journals one ``serve`` kind="summary"
+    event; ``compiles_post_warmup`` is the recompile-sentinel delta over
+    the whole traffic phase — the AOT-bucket claim is that it is 0.
+    """
+    from sparknet_tpu.obs.recorder import get_recorder
+    from sparknet_tpu.obs.sentinel import get_sentinel
+
+    def say(msg: str) -> None:
+        if log:
+            log(msg)
+
+    sentinel = get_sentinel().install()
+    engine = ServeEngine(buckets=buckets, max_wait_ms=max_wait_ms)
+    say(f"loading primary ({family}/{arm}) — AOT-compiling "
+        f"{len(engine.buckets)} bucket(s) ...")
+    primary = engine.load_model("primary", family=family, arm=arm,
+                                seed=seed)
+    for name, fam, extra_arm in extra_models:
+        say(f"loading {name} ({fam}/{extra_arm}) ...")
+        engine.load_model(name, family=fam, arm=extra_arm, seed=seed)
+
+    refused = False
+    if refusal_family:
+        try:
+            # price at the full ladder top regardless of the engine's
+            # bucket set: admission fires BEFORE any construction, so
+            # the refusal family never needs to be serveable
+            engine.load_model("over_hbm", family=refusal_family,
+                              buckets=(SERVE_BUCKETS[-1],))
+        except AdmissionRefused as e:
+            refused = True
+            say(f"over-HBM load refused as priced: "
+                f"{e.verdict['predicted_bytes']:,} B predicted vs "
+                f"{e.verdict['budget_bytes']:,} B budget")
+
+    rs = np.random.RandomState(seed)
+    # warmup: one forced flush through every bucket, THEN snapshot the
+    # sentinel — first-touch work must not masquerade as a traffic
+    # compile, nor traffic compiles hide in warmup
+    for b in engine.buckets:
+        for item in synthetic_items(primary, max(1, b // 2), rs):
+            engine.submit("primary", item)
+        engine.pump(force=True)
+    compiles0 = sentinel.count
+
+    plan = burst_plan(requests, engine.buckets)
+    say(f"traffic: {sum(plan)} request(s) over {len(plan)} burst(s) ...")
+    tickets = []
+    t0 = time.perf_counter()
+    for i, burst in enumerate(plan):
+        model_name = "aux" if (extra_models and burst == 1
+                               and i % 4 == 0) else "primary"
+        target = engine._models[model_name]
+        for item in synthetic_items(target, burst, rs):
+            tickets.append((model_name, engine.submit(model_name, item)))
+        engine.pump(force=True)
+    wall_s = time.perf_counter() - t0
+    compiles_post = sentinel.count - compiles0
+
+    for _, t in tickets:
+        t.wait(timeout=60.0)
+    buckets_exercised = sorted({t.bucket for _, t in tickets})
+    stats = engine.stats()
+    totals = [ms for m in engine._models.values()
+              for ms in m.lat_total_ms]
+    summary = {
+        "requests": len(tickets),
+        "batches": sum(m.batches for m in engine._models.values()),
+        "padded_rows": sum(m.padded_rows
+                           for m in engine._models.values()),
+        "buckets_exercised": buckets_exercised,
+        "compiles_post_warmup": compiles_post,
+        "p50_ms": percentile(totals, 50),
+        "p99_ms": percentile(totals, 99),
+        "rps": round(len(tickets) / wall_s, 1) if wall_s > 0 else 0.0,
+        "wall_s": round(wall_s, 3),
+        "refused": refused,
+        "stats": stats,
+    }
+    get_recorder().emit(
+        "serve", kind="summary", model="primary", family=family,
+        arm=arm, buckets=list(buckets_exercised),
+        requests=summary["requests"], batches=summary["batches"],
+        padded=summary["padded_rows"], compiles=compiles_post,
+        p50_ms=summary["p50_ms"], p99_ms=summary["p99_ms"],
+        rps=summary["rps"], wall_s=summary["wall_s"],
+        note="closed-loop CPU-mesh load run (host-side walls)")
+    engine.shutdown()
+    return summary

@@ -34,6 +34,7 @@ EXPECTED_RULES = {
     "mem-manifest-fresh",
     "fused-update-manifest",
     "elastic-manifest-fresh",
+    "serve-manifest-fresh",
     "queue-job-hygiene",
     "obs-fenced-span",
     "feed-shm-cleanup",
@@ -689,6 +690,77 @@ def test_elastic_manifest_fresh_ignores_other_parallel_files(tmp_path):
     other.write_text(FRESH_SRC)
     assert not hits(FRESH_SRC, "elastic-manifest-fresh", path=str(other))
     assert not hits(FRESH_SRC, "elastic-manifest-fresh")
+
+
+# -- serve-manifest-fresh ---------------------------------------------------
+
+
+def _serve_tree(tmp_path, record=True, covered=True,
+                buckets=(1, 8, 64, 256),
+                families=("graph_contracts", "mem_contracts")):
+    """A fake repo around serve/engine.py: SOURCES.json (optionally not
+    covering it) + serve_b*.json twin manifests per family."""
+    import hashlib
+    import json as _json
+
+    rel = "sparknet_tpu/serve/engine.py"
+    mod = tmp_path / rel
+    mod.parent.mkdir(parents=True, exist_ok=True)
+    mod.write_text(FRESH_SRC)
+    digest = hashlib.sha256(FRESH_SRC.encode()).hexdigest()
+    for fam in families:
+        cdir = tmp_path / "docs" / fam
+        cdir.mkdir(parents=True, exist_ok=True)
+        if record:
+            entry = {rel: digest} if covered else {"other.py": digest}
+            (cdir / "SOURCES.json").write_text(_json.dumps(entry))
+        for b in buckets:
+            (cdir / f"serve_b{b}.json").write_text("{}")
+    return str(mod)
+
+
+def test_serve_manifest_fresh_clean_when_banked(tmp_path):
+    path = _serve_tree(tmp_path)
+    assert not hits(FRESH_SRC, "serve-manifest-fresh", path=path)
+
+
+def test_serve_manifest_fresh_positive_when_never_banked(tmp_path):
+    path = _serve_tree(tmp_path, record=False, buckets=())
+    found = hits(FRESH_SRC, "serve-manifest-fresh", path=path)
+    assert len(found) == 2  # one per family
+    assert "SOURCES.json missing" in found[0].message
+
+
+def test_serve_manifest_fresh_positive_when_not_folded_in(tmp_path):
+    # manifests exist but predate the serving layer: engine.py absent
+    # from the fingerprint — the silent-non-coverage hole
+    path = _serve_tree(tmp_path, covered=False)
+    found = hits(FRESH_SRC, "serve-manifest-fresh", path=path)
+    assert len(found) == 2
+    assert all("not folded into" in f.message for f in found)
+
+
+def test_serve_manifest_fresh_positive_below_bucket_ladder(tmp_path):
+    path = _serve_tree(tmp_path, buckets=(1, 8))
+    found = hits(FRESH_SRC, "serve-manifest-fresh", path=path)
+    assert len(found) == 2
+    assert all("4 buckets" in f.message for f in found)
+
+
+def test_serve_manifest_fresh_suppressed(tmp_path):
+    path = _serve_tree(tmp_path, record=False, buckets=())
+    src = ("# graftlint: disable-file=serve-manifest-fresh -- "
+           "manifest regen follows in this PR\n" + FRESH_SRC)
+    assert not hits(src, "serve-manifest-fresh", path=path)
+    assert suppressed_hits(src, "serve-manifest-fresh", path=path)
+
+
+def test_serve_manifest_fresh_ignores_other_packages(tmp_path):
+    other = tmp_path / "sparknet_tpu" / "parallel" / "trainer.py"
+    other.parent.mkdir(parents=True, exist_ok=True)
+    other.write_text(FRESH_SRC)
+    assert not hits(FRESH_SRC, "serve-manifest-fresh", path=str(other))
+    assert not hits(FRESH_SRC, "serve-manifest-fresh")
 
 
 # -- queue-job-hygiene ------------------------------------------------------

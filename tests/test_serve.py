@@ -1,0 +1,310 @@
+"""Serving engine gates (sparknet_tpu/serve; ROADMAP item 1).
+
+Four contract families:
+
+1. **Batcher policy** — stdlib-only unit tests on a fake clock: the
+   smallest-fitting-bucket choice, the ``max_wait_ms`` deadline flush
+   under trickle load (no request's queue wait exceeds the deadline),
+   zero-loss drain on shutdown, and refusal of post-close submits.
+   No jax, no sleeps.
+2. **The EXACT gate** — a padded dynamic batch is BITWISE identical to
+   batch-1 serial inference, per zoo family x deploy arm.  This is the
+   whole correctness claim of bucket padding: batching is a latency
+   policy, never a numerics change.  mobilenet is the documented
+   exception (depthwise stack is not batch-stable on this backend —
+   docs/SERVING.md "Exactness") and gets an allclose gate instead.
+3. **Priced admission** — the over-HBM model load refuses BEFORE any
+   compile, end to end through the journal (the queue pre-flight's
+   policy, applied to residency).
+4. **The AOT load run** — every bucket exercised with the recompile
+   sentinel pinned at ZERO post-warmup compiles.
+
+ref: apps/FeaturizerApp.scala:1 (the reference's batch scoring app;
+dynamic request batching is new TPU-first surface).
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.serve import AdmissionRefused, DynamicBatcher, ServeEngine
+from sparknet_tpu.serve.engine import EXEC_FLOOR, exec_batch, percentile
+
+
+class FakeClock:
+    """Injectable time for the deadline tests: advances only on demand."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+# -- batcher policy (jax-free) ----------------------------------------------
+
+
+@pytest.mark.smoke
+def test_bucket_for_picks_smallest_fitting():
+    b = DynamicBatcher(buckets=(1, 8, 64, 256))
+    assert b.bucket_for(1) == 1
+    assert b.bucket_for(2) == 8
+    assert b.bucket_for(8) == 8
+    assert b.bucket_for(9) == 64
+    assert b.bucket_for(65) == 256
+    # overflow clamps to the largest (the queue drains it as batches)
+    assert b.bucket_for(1000) == 256
+
+
+@pytest.mark.smoke
+def test_deadline_flush_under_trickle_load():
+    """A trickle never waits past max_wait_ms: the flush fires at the
+    OLDEST request's deadline, not when a bucket happens to fill."""
+    clock = FakeClock()
+    b = DynamicBatcher(buckets=(1, 8, 64), max_wait_ms=5.0, clock=clock)
+    tickets = []
+    # one request every 2 ms, pump ticking at 1 ms — never enough
+    # pending to fill the 64-bucket, so every flush is deadline-driven
+    for tick in range(30):
+        clock.t = tick * 1e-3
+        if tick % 2 == 0 and len(tickets) < 6:
+            tickets.append(b.submit(f"req{len(tickets)}"))
+        b.take()
+    assert not b.pending()
+    for t in tickets:
+        assert t.t_batch is not None, f"request {t.id} never flushed"
+        wait_ms = (t.t_batch - t.t_submit) * 1e3
+        # the flush fires at the first pump tick AT/AFTER the deadline,
+        # so the bound is max_wait plus one pump tick of quantization
+        assert wait_ms <= 5.0 + 1.0 + 1e-6, \
+            f"request {t.id} waited {wait_ms}ms"
+        assert t.deadline_flush  # trickle: every flush was deadline-driven
+
+
+@pytest.mark.smoke
+def test_full_bucket_flushes_without_deadline():
+    clock = FakeClock()
+    b = DynamicBatcher(buckets=(1, 8), max_wait_ms=5.0, clock=clock)
+    tickets = [b.submit(i) for i in range(8)]
+    batch = b.take()  # due immediately: the largest bucket is full
+    assert batch is not None and len(batch) == 8
+    assert all(not t.deadline_flush for t in tickets)
+    assert all(t.bucket == 8 for t in tickets)
+
+
+@pytest.mark.smoke
+def test_partial_flush_stamps_smallest_bucket():
+    clock = FakeClock()
+    b = DynamicBatcher(buckets=(1, 8, 64), max_wait_ms=5.0, clock=clock)
+    for i in range(3):
+        b.submit(i)
+    clock.t = 0.006  # past the deadline
+    batch = b.take()
+    assert [t.bucket for t in batch] == [8, 8, 8]  # 3 rides the 8-bucket
+    assert all(t.deadline_flush and t.batch_n == 3 for t in batch)
+
+
+@pytest.mark.smoke
+def test_close_drains_every_inflight_request():
+    clock = FakeClock()
+    b = DynamicBatcher(buckets=(1, 8), max_wait_ms=5.0, clock=clock)
+    tickets = [b.submit(i) for i in range(11)]
+    batches = b.close(drain=True)
+    drained = [t for batch in batches for t in batch]
+    assert sorted(t.id for t in drained) == sorted(t.id for t in tickets)
+    with pytest.raises(RuntimeError, match="closed"):
+        b.submit("late")
+
+
+@pytest.mark.smoke
+def test_close_without_drain_fails_tickets():
+    b = DynamicBatcher(buckets=(1, 8), clock=FakeClock())
+    t = b.submit("x")
+    b.close(drain=False)
+    with pytest.raises(RuntimeError, match="without drain"):
+        t.wait(timeout=0.1)
+
+
+@pytest.mark.smoke
+def test_overflow_drains_as_multiple_batches():
+    clock = FakeClock()
+    b = DynamicBatcher(buckets=(1, 8), max_wait_ms=5.0, clock=clock)
+    for i in range(20):
+        b.submit(i)
+    sizes = []
+    while (batch := b.take(force=True)) is not None:
+        sizes.append(len(batch))
+    assert sizes == [8, 8, 4]
+
+
+@pytest.mark.smoke
+def test_percentile_nearest_rank():
+    vals = list(range(1, 101))
+    assert percentile(vals, 50) == 50
+    assert percentile(vals, 99) == 99
+    assert percentile(vals, 100) == 100
+    assert percentile([7.0], 99) == 7.0
+    assert percentile([], 50) == 0.0
+
+
+@pytest.mark.smoke
+def test_exec_batch_floor():
+    # the 1-bucket compiles at the exec floor: a single-row program
+    # lowers to a gemv whose reduction order breaks bitwise parity with
+    # the batched gemm (docs/SERVING.md "Exactness")
+    assert exec_batch(1) == EXEC_FLOOR == 2
+    assert exec_batch(8) == 8
+    assert exec_batch(256) == 256
+
+
+# -- the EXACT gate ---------------------------------------------------------
+
+# the three batch-stable zoo families (mobilenet's depthwise stack is
+# not batch-stable on this backend at ANY batch — allclose gate below)
+EXACT_CASES = [
+    pytest.param("cifar10_quick", "f32", marks=pytest.mark.smoke),
+    ("cifar10_quick", "fold_bn"),
+    ("cifar10_quick", "int8"),
+    ("lenet", "f32"),
+    ("lenet", "fold_bn"),
+    ("lenet", "int8"),
+    ("transformer", "f32"),
+    ("transformer", "fold_bn"),
+    ("transformer", "int8"),
+]
+
+
+def _serve_items(engine, name, n, seed=3):
+    from sparknet_tpu.serve.loadgen import synthetic_items
+
+    return synthetic_items(engine._models[name],
+                           n, np.random.RandomState(seed))
+
+
+@pytest.mark.parametrize("family,arm", EXACT_CASES)
+def test_exact_gate_padded_batch_matches_serial(family, arm):
+    """Bitwise: serial batch-1, a full 8-batch, and a padded 3-batch all
+    produce identical per-row scores for the same items."""
+    engine = ServeEngine(buckets=(1, 8))
+    engine.load_model("m", family=family, arm=arm)
+    items = _serve_items(engine, "m", 8)
+
+    serial = [np.asarray(engine.infer("m", it)) for it in items]
+
+    full = [engine.submit("m", it) for it in items]
+    assert engine.pump(force=True) == 1
+    for t, ref in zip(full, serial):
+        assert t.bucket == 8 and t.batch_n == 8
+        assert np.array_equal(np.asarray(t.result), ref), (family, arm)
+
+    padded = [engine.submit("m", it) for it in items[:3]]
+    assert engine.pump(force=True) == 1
+    for t, ref in zip(padded, serial[:3]):
+        assert t.bucket == 8 and t.batch_n == 3  # 5 pad rows
+        assert np.array_equal(np.asarray(t.result), ref), (family, arm)
+    engine.shutdown()
+
+
+@pytest.mark.slow
+def test_mobilenet_batched_is_allclose():
+    """The documented exception: depthwise convs are not batch-stable
+    on this backend, so mobilenet gets a tolerance gate, not EXACT."""
+    engine = ServeEngine(buckets=(1, 8))
+    engine.load_model("m", family="mobilenet", arm="f32")
+    items = _serve_items(engine, "m", 4)
+    serial = [np.asarray(engine.infer("m", it)) for it in items]
+    batched = [engine.submit("m", it) for it in items]
+    engine.pump(force=True)
+    for t, ref in zip(batched, serial):
+        np.testing.assert_allclose(np.asarray(t.result), ref,
+                                   rtol=1e-4, atol=1e-5)
+    engine.shutdown()
+
+
+# -- priced admission -------------------------------------------------------
+
+
+@pytest.mark.smoke
+def test_over_hbm_load_refused_and_journaled(tmp_path):
+    """resnet50 at bucket 256 prices over the v5e budget: the load
+    refuses BEFORE any jax work and the verdict lands in the journal."""
+    from sparknet_tpu.obs.recorder import Recorder, set_recorder
+
+    path = str(tmp_path / "refusal.jsonl")
+    rec = set_recorder(Recorder(path, run_id="serve-test"))
+    try:
+        engine = ServeEngine()  # banked fit table, real HBM budget
+        with pytest.raises(AdmissionRefused) as ei:
+            engine.load_model("big", family="resnet50",
+                              buckets=(1, 8, 64, 256))
+    finally:
+        rec.close()
+        set_recorder(None)
+    v = ei.value.verdict
+    assert v["priced"] and not v["fits"]
+    assert v["predicted_bytes"] > v["budget_bytes"]
+    assert "big" not in engine.models()
+    with open(path, encoding="utf-8") as f:
+        events = [json.loads(line) for line in f]
+    refusals = [e for e in events
+                if e.get("event") == "serve"
+                and e.get("kind") == "load_refused"]
+    assert len(refusals) == 1
+    assert refusals[0]["predicted_bytes"] == v["predicted_bytes"]
+
+
+@pytest.mark.smoke
+def test_unpriced_family_admits():
+    """A family absent from the fit table admits (lenet banks 0 params
+    in no table row) — pricing gates what it can price, nothing else."""
+    from sparknet_tpu.serve.residency import AdmissionPolicy
+
+    policy = AdmissionPolicy(fit_table={"families": {}})
+    verdict = policy.admit("lenet", max_bucket=256, resident_bytes=0)
+    assert verdict["fits"] and not verdict["priced"]
+
+
+@pytest.mark.smoke
+def test_shape_checked_submit():
+    engine = ServeEngine(buckets=(1,))
+    engine.load_model("m", family="lenet")
+    with pytest.raises(ValueError, match="item shape"):
+        engine.submit("m", np.zeros((3, 32, 32), np.float32))
+    engine.shutdown()
+
+
+# -- the AOT load run -------------------------------------------------------
+
+
+def test_load_run_zero_postwarmup_compiles(tmp_path):
+    """A small closed-loop load run: every bucket exercised, shutdown
+    drains clean, and the recompile sentinel reads ZERO compiles in the
+    traffic phase — the AOT-bucket contract at test scale."""
+    from sparknet_tpu.serve.loadgen import load_run
+
+    summary = load_run(requests=40, family="cifar10_quick",
+                       buckets=(1, 8), refusal_family="resnet50")
+    assert summary["requests"] >= 40
+    assert summary["buckets_exercised"] == [1, 8]
+    assert summary["compiles_post_warmup"] == 0
+    assert summary["refused"]
+    assert summary["p99_ms"] >= summary["p50_ms"] > 0
+    assert summary["padded_rows"] > 0  # the trickle padded into buckets
+    stats = summary["stats"]
+    assert set(stats) == {"primary", "aux"}  # multi-model residency
+    for s in stats.values():
+        assert s["p99_ms"] >= s["p50_ms"] >= 0
+
+
+@pytest.mark.smoke
+def test_unload_model_releases_residency():
+    engine = ServeEngine(buckets=(1,))
+    engine.load_model("m", family="lenet")
+    engine.unload_model("m")
+    assert engine.models() == []
+    assert engine.resident_bytes() == 0
+    with pytest.raises(KeyError):
+        engine.submit("m", np.zeros((1, 28, 28), np.float32))
