@@ -137,6 +137,7 @@ GRAPH_RULES = {
 GRAPH_SOURCE_PATTERNS = (
     "sparknet_tpu/parallel/",
     "sparknet_tpu/serve/",
+    "sparknet_tpu/loop/",
     "sparknet_tpu/models/zoo.py",
     "sparknet_tpu/analysis/graphcheck.py",
     "sparknet_tpu/analysis/comm_model.py",
@@ -674,7 +675,7 @@ def sources_fingerprint(repo: str | None = None) -> dict:
     ``graph-manifest-fresh`` lint rule checks edits against)."""
     repo = repo or _REPO
     files: list[str] = []
-    for sub in ("parallel", "serve"):
+    for sub in ("parallel", "serve", "loop"):
         pdir = os.path.join(repo, "sparknet_tpu", sub)
         if os.path.isdir(pdir):
             files += [os.path.join(pdir, f)

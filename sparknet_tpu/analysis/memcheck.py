@@ -115,6 +115,7 @@ MEM_RULES = {
 MEM_SOURCE_PATTERNS = (
     "sparknet_tpu/parallel/",
     "sparknet_tpu/serve/",
+    "sparknet_tpu/loop/",
     "sparknet_tpu/models/zoo.py",
     "sparknet_tpu/ops/pallas_kernels.py",
     "sparknet_tpu/ops/layout.py",

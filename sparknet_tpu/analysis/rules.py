@@ -863,6 +863,71 @@ def check_serve_manifest_fresh(ctx: ModuleContext) -> Iterator[tuple[int, str]]:
 
 
 # ---------------------------------------------------------------------------
+# loop-manifest-fresh
+# ---------------------------------------------------------------------------
+
+# The production loop (sparknet_tpu/loop/) composes programs the
+# contracts already audit — ElasticTrainer rounds (elastic_w* twins)
+# and the engine's bucket forwards (serve_b* twins) — so it banks no
+# twin manifests of its own.  But its modules ARE contract source (they
+# decide which programs lower and with what feeds), so the banked
+# SOURCES fingerprints must fold every loop/*.py in: a SOURCES.json
+# predating the loop layer hash-passes everything else while silently
+# not covering it.  Coverage only — no twin count (the twins belong to
+# the elastic/serve rules).
+_LOOP_SOURCE_DIR = "sparknet_tpu/loop/"
+_LOOP_REGEN = _ELASTIC_REGEN
+
+
+def _loop_source_rel(path: str) -> tuple[str, str] | None:
+    norm = os.path.abspath(path).replace(os.sep, "/")
+    idx = norm.rfind("/sparknet_tpu/")
+    if idx < 0:
+        return None
+    root, rel = norm[:idx], norm[idx + 1:]
+    if rel.startswith(_LOOP_SOURCE_DIR) and rel.endswith(".py"):
+        return root, rel
+    return None
+
+
+@rule(
+    "loop-manifest-fresh",
+    "the production loop (sparknet_tpu/loop/) must be folded into the "
+    "graph+mem SOURCES fingerprints in both contract families",
+)
+def check_loop_manifest_fresh(ctx: ModuleContext) -> Iterator[tuple[int, str]]:
+    """Coverage twin of serve-manifest-fresh for the train-to-serve
+    loop.  Hash STALENESS belongs to graph-/mem-manifest-fresh (loop/
+    sits on both dir surfaces); this rule owns coverage: the banked
+    SOURCES.json must record this loop/ file at all.  No twin-manifest
+    count — the loop lowers exclusively through programs the elastic_w*
+    and serve_b* twins already pin.
+    """
+    hit = _loop_source_rel(ctx.path)
+    if hit is None:
+        return
+    root, rel = hit
+    for fam, regen in _LOOP_REGEN.items():
+        cdir = os.path.join(root, "docs", fam)
+        src = os.path.join(cdir, "SOURCES.json")
+        if not os.path.exists(src):
+            yield (1, f"{rel} is loop contract source but no manifests "
+                      f"are banked (docs/{fam}/SOURCES.json missing) — "
+                      f"{regen}")
+            continue
+        try:
+            with open(src, encoding="utf-8") as f:
+                recorded = json.load(f)
+        except (OSError, ValueError):
+            yield (1, f"docs/{fam}/SOURCES.json unreadable — {regen}")
+            continue
+        if rel not in recorded:
+            yield (1, f"{rel} is not folded into the docs/{fam} SOURCES "
+                      f"fingerprint — the banked manifests predate the "
+                      f"loop layer; {regen}")
+
+
+# ---------------------------------------------------------------------------
 # queue-job-hygiene
 # ---------------------------------------------------------------------------
 

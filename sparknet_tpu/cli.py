@@ -1726,6 +1726,43 @@ def cmd_serve(args) -> int:
     return 0 if summary["compiles_post_warmup"] == 0 else 1
 
 
+def cmd_loop(args) -> int:
+    """The train-to-serve production loop (sparknet_tpu/loop;
+    docs/ARCHITECTURE.md "Production loop"): elastic training rounds ->
+    atomic checkpoint -> deploy-arm candidate AOT-compiled off the
+    request path -> hot swap into the live engine -> over-HBM refusal
+    -> bitwise rollback, with traffic in flight throughout.  Prints one
+    summary JSON line; exits 1 unless every gate holds (zero
+    serving-path compiles, zero dropped tickets, scores change on
+    rollout and restore on rollback).  A chip-free gate: pins the
+    virtual CPU mesh (never dials the relay) — production rollouts go
+    through ProductionLoop directly.
+
+    ref: apps/FeaturizerApp.scala:1 (the reference's single driver app
+    owning both training and scoring; the hot-reload protocol is new
+    TPU-first surface)."""
+    import json as _json
+
+    # a chip-free verification drive, like `obs dryrun --loop`: pin the
+    # virtual CPU mesh so the elastic pool exists on any host (the
+    # config route outranks the site hook — CLAUDE.md platform gotcha)
+    from sparknet_tpu.analysis.graphcheck import _pin_cpu_mesh
+
+    _pin_cpu_mesh(max(8, args.width))
+
+    from sparknet_tpu.loop.dryrun import loop_run
+
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    summary = loop_run(
+        iterations=args.iterations, rounds_per_rollout=args.rounds,
+        family=args.family, arm=args.arm, buckets=buckets,
+        width=args.width, tau=args.tau, requests=args.requests,
+        max_wait_ms=args.max_wait_ms, workdir=args.workdir or None,
+        log=lambda m: print(f"loop: {m}", file=sys.stderr))
+    print(_json.dumps(summary))
+    return 0 if summary["ok"] else 1
+
+
 def cmd_device_query(args) -> int:
     """ref: caffe.cpp:110-150 device_query().
 
@@ -2053,6 +2090,29 @@ def main(argv=None) -> int:
     sp.add_argument("--max-wait-ms", type=float, default=5.0,
                     help="deadline bound on any request's queue wait")
     sp.set_defaults(fn=cmd_serve)
+
+    sp = sub.add_parser(
+        "loop", help="train-to-serve production loop (hot reload)")
+    sp.add_argument("--iterations", type=int, default=1,
+                    help="train->checkpoint->rollout cycles")
+    sp.add_argument("--rounds", type=int, default=2,
+                    help="elastic rounds per rollout")
+    sp.add_argument("--family", default="cifar10_quick",
+                    help="cifar10_quick|lenet|mobilenet|transformer")
+    sp.add_argument("--arm", default="f32",
+                    choices=["f32", "fold_bn", "int8"])
+    sp.add_argument("--buckets", default="1,8",
+                    help="comma-separated AOT bucket ladder")
+    sp.add_argument("--width", type=int, default=4,
+                    help="elastic worker-pool width")
+    sp.add_argument("--tau", type=int, default=2,
+                    help="local steps per elastic round")
+    sp.add_argument("--requests", type=int, default=48,
+                    help="in-flight traffic across the cycle")
+    sp.add_argument("--max-wait-ms", type=float, default=5.0)
+    sp.add_argument("--workdir", default="",
+                    help="checkpoint dir (default: a temp dir)")
+    sp.set_defaults(fn=cmd_loop)
 
     sp = sub.add_parser("device_query", help="show devices")
     sp.add_argument("--timeout", type=float, default=300.0,

@@ -83,6 +83,7 @@ __all__ = [
     "ProcessPipeline",
     "device_feed",
     "feed_workers",
+    "shard_batches",
 ]
 
 # the journal stage vocabulary (docs/OBSERVABILITY.md "Feed stages"):
@@ -286,6 +287,20 @@ class PrestagedSource(BatchSource):
 
     def get(self, epoch: int, index: int) -> dict[str, np.ndarray]:
         return self.feeds
+
+
+def shard_batches(source: BatchSource):
+    """Adapt a :class:`BatchSource` to the elastic shard-feed contract
+    (parallel/elastic.py ``ShardFn``): global shard id ``g`` -> that
+    shard's raw batch, deterministically — ``source.get`` keys on the
+    index alone, so a shard reassigned across a mesh resize replays
+    identical data (the ``g % W' == w`` ownership rule).  This is the
+    data plane's hand-off to the train-to-serve loop (sparknet_tpu/
+    loop/feed.py turns these raw batches into net feeds)."""
+    def data_fn(g: int) -> dict:
+        return source.get(0, int(g))
+
+    return data_fn
 
 
 class TransformStage:

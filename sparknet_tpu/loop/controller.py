@@ -1,0 +1,167 @@
+"""ProductionLoop: elastic rounds -> checkpoint -> candidate -> rollout.
+
+One object owns the whole train-to-serve cycle the reference ran as a
+single driver app (ref: apps/FeaturizerApp.scala:1): it wraps an
+:class:`~sparknet_tpu.parallel.elastic.ElasticTrainer` (training side)
+and a live :class:`~sparknet_tpu.serve.engine.ServeEngine` (serving
+side), and each iteration of :meth:`run`
+
+1. trains ``rounds_per_rollout`` elastic rounds off the shard feed,
+2. folds the averaged pool into the solver and writes an ATOMIC
+   checkpoint (``Solver.save`` npz — temp + ``os.replace``),
+3. reads the checkpoint back (loop/deploy.py — the durable hand-off,
+   exercised every rollout),
+4. AOT-compiles the deploy-arm candidate on THIS thread
+   (``engine.build_candidate`` — priced against resident HBM first;
+   a refusal journals and keeps the incumbent serving), and
+5. hot-swaps it in (``engine.swap_model`` — pump-lock flip, incumbent
+   drained with its own executables, retained one generation for
+   :meth:`rollback`).
+
+Every transition journals a ``loop`` event (obs/schema.py) on top of
+the engine's ``serve`` rollout/rollback records, so one journal tells
+the whole story: which round produced which checkpoint, which version
+it became, and what it displaced.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+__all__ = ["ProductionLoop"]
+
+
+class ProductionLoop:
+    """Drive a solver's elastic training INTO a live serving engine.
+
+    ``data_fn`` follows the elastic ShardFn contract (see
+    loop/feed.py); ``workdir`` receives the ``round{N:05d}`` snapshot
+    pairs; ``serve_name`` is the engine-resident model the rollouts
+    replace (loaded on first :meth:`ensure_serving` if absent).
+    """
+
+    def __init__(self, solver, engine, data_fn, *, workdir: str,
+                 family: str = "cifar10_quick", arm: str = "f32",
+                 buckets: tuple | None = None, serve_name: str = "live",
+                 tau: int = 1, width: int | None = None, devices=None,
+                 plan=None, staleness_decay: float = 0.5):
+        from sparknet_tpu.parallel.elastic import ElasticTrainer
+
+        self.engine = engine
+        self.data_fn = data_fn
+        self.workdir = workdir
+        self.family = family
+        self.arm = arm
+        self.buckets = tuple(buckets) if buckets else None
+        self.serve_name = serve_name
+        self.trainer = ElasticTrainer(
+            solver, width=width, tau=tau, devices=devices, plan=plan,
+            staleness_decay=staleness_decay)
+        self.rollouts = 0
+        self.rollbacks = 0
+        self.checkpoints = 0
+        os.makedirs(workdir, exist_ok=True)
+
+    def _emit(self, kind: str, **fields) -> None:
+        from sparknet_tpu.obs.recorder import get_recorder
+
+        get_recorder().emit("loop", kind=kind, model=self.serve_name,
+                            family=self.family, **fields)
+
+    # -- serving-side lifecycle --------------------------------------------
+
+    def ensure_serving(self, seed: int = 0):
+        """Load the first generation (seed-initialized) if ``serve_name``
+        is not yet resident; later generations arrive via rollouts."""
+        if self.serve_name in self.engine.models():
+            return self.engine._models[self.serve_name]
+        return self.engine.load_model(
+            self.serve_name, family=self.family, arm=self.arm,
+            buckets=self.buckets, seed=seed)
+
+    # -- the cycle stages --------------------------------------------------
+
+    def checkpoint(self) -> str:
+        """Fold the elastic pool into the solver and snapshot it
+        atomically; returns the npz path (the rollout's input)."""
+        t0 = time.perf_counter()
+        self.trainer.sync_to_solver()
+        prefix = os.path.join(self.workdir,
+                              f"round{self.trainer.round:05d}")
+        path = self.trainer.solver.save(prefix)
+        self.checkpoints += 1
+        self._emit("checkpoint", round=self.trainer.round,
+                   iteration=int(self.trainer.solver.iter), path=path,
+                   wall_s=round(time.perf_counter() - t0, 6),
+                   note="atomic npz (temp + os.replace) — pollers "
+                        "never see a torn archive")
+        return path
+
+    def rollout(self, path: str) -> dict | None:
+        """Checkpoint -> candidate -> hot swap.  Returns the swap
+        telemetry, or None when admission pricing refuses the candidate
+        (journaled; the incumbent keeps serving — refused, not fatal)."""
+        from sparknet_tpu.loop.deploy import variables_from_checkpoint
+        from sparknet_tpu.serve.engine import AdmissionRefused
+
+        t0 = time.perf_counter()
+        variables = variables_from_checkpoint(path)
+        self._emit("candidate", arm=self.arm, path=path,
+                   round=self.trainer.round)
+        try:
+            candidate = self.engine.build_candidate(
+                self.serve_name, family=self.family, arm=self.arm,
+                buckets=self.buckets, variables=variables)
+        except AdmissionRefused as refusal:
+            self._emit("refused", arm=self.arm, path=path,
+                       round=self.trainer.round,
+                       note=str(refusal))
+            return None
+        info = self.engine.swap_model(self.serve_name, candidate)
+        self.rollouts += 1
+        self._emit("rollout", arm=self.arm, path=path,
+                   round=self.trainer.round, version=info["version"],
+                   drained=info["drained"],
+                   wall_s=round(time.perf_counter() - t0, 6))
+        return info
+
+    def rollback(self):
+        """Restore the previous serving generation (bitwise — the same
+        retained ``ServedModel``); returns it."""
+        prev = self.engine.rollback(self.serve_name)
+        self.rollbacks += 1
+        self._emit("rollback", version=prev.version,
+                   note="previous generation restored bitwise")
+        return prev
+
+    # -- the loop ----------------------------------------------------------
+
+    def run(self, iterations: int = 1, rounds_per_rollout: int = 2,
+            seed: int = 0) -> dict:
+        """``iterations`` full train->checkpoint->rollout cycles against
+        the live engine; returns a summary (also journaled)."""
+        self.ensure_serving(seed=seed)
+        t0 = time.perf_counter()
+        losses = []
+        for i in range(iterations):
+            loss = self.trainer.train(rounds_per_rollout, self.data_fn)
+            losses.append(float(loss))
+            path = self.checkpoint()
+            self.rollout(path)
+        summary = {
+            "iterations": iterations,
+            "rounds": self.trainer.round,
+            "rollouts": self.rollouts,
+            "rollbacks": self.rollbacks,
+            "checkpoints": self.checkpoints,
+            "loss": losses[-1] if losses else 0.0,
+            "wall_s": time.perf_counter() - t0,
+        }
+        self._emit("summary", iteration=iterations,
+                   round=self.trainer.round, rollouts=self.rollouts,
+                   rollbacks=self.rollbacks,
+                   checkpoints=self.checkpoints,
+                   loss=summary["loss"],
+                   wall_s=round(summary["wall_s"], 6))
+        return summary

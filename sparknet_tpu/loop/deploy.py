@@ -1,0 +1,54 @@
+"""Checkpoint -> deploy candidate: read trained weights for serving.
+
+The rollout path deliberately builds its candidate from the SAVED
+artifact, not from the live solver pytree: the checkpoint is the
+durable hand-off between the training half and the serving half of the
+production loop (a restarted serving host rolls out from the same
+file), and routing through it exercises the atomic-write contract every
+rollout (solvers/solver.py ``save`` — temp file + ``os.replace``, so a
+reader never sees a torn archive).
+
+Only ``param/`` and ``state/`` enter the serve-side ``NetVars``:
+optimizer history (``hist/``) is training state the TEST-phase forward
+never touches, and dropping it here is what makes the candidate's
+footprint the batch-fit table's INFERENCE prediction, not a training
+residency.
+
+ref: src/main/scala/loaders/CifarLoader.scala:1 (reference weight
+I/O shape: flat named arrays in, model out).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["variables_from_checkpoint"]
+
+
+def variables_from_checkpoint(path: str):
+    """Parse a ``*.solverstate.npz`` archive into the ``NetVars`` a
+    :class:`~sparknet_tpu.serve.engine.ServedModel` lowers against.
+
+    Keys follow the save layout ``param/<layer>/<i>`` and
+    ``state/<layer>/<key>`` (layer names may themselves contain ``/``
+    — googlenet's ``inception_4a/output`` — so the index/key splits off
+    the RIGHT).
+    """
+    from sparknet_tpu.compiler.graph import NetVars
+
+    data = np.load(path)
+    params: dict[str, dict[int, np.ndarray]] = {}
+    state: dict[str, dict[str, np.ndarray]] = {}
+    for key in data.files:
+        if key.startswith("param/"):
+            lname, idx = key[len("param/"):].rsplit("/", 1)
+            params.setdefault(lname, {})[int(idx)] = np.asarray(data[key])
+        elif key.startswith("state/"):
+            lname, skey = key[len("state/"):].rsplit("/", 1)
+            state.setdefault(lname, {})[skey] = np.asarray(data[key])
+    if not params:
+        raise ValueError(f"no param/ entries in checkpoint {path!r}")
+    return NetVars(
+        params={ln: [d[i] for i in sorted(d)]
+                for ln, d in params.items()},
+        state={ln: dict(s) for ln, s in state.items()})

@@ -1,0 +1,188 @@
+"""The chip-free production-loop drive: train, swap, roll back, prove it.
+
+One deterministic CPU-mesh run shared by its three consumers — ``python
+-m sparknet_tpu.obs dryrun --loop``, graft-entry dryrun mode 19, and
+tests/test_loop.py — exercising the FULL cycle against a live engine
+with traffic in flight:
+
+1. seed-initialized incumbent serves a probe (scores ``s0``),
+2. ``ProductionLoop`` trains elastic rounds, checkpoints atomically,
+   builds the deploy candidate from the SAVED file, hot-swaps it in
+   (tickets submitted before the swap drain through the incumbent's
+   own executables — zero dropped),
+3. the probe's scores CHANGE (``s1 != s0`` — trained weights are live),
+4. an over-HBM candidate is refused by admission pricing (journaled,
+   incumbent untouched: the probe still reads ``s1``),
+5. ``rollback`` restores the retired generation and the probe reads
+   ``s0`` again BITWISE (same ServedModel object, same executables),
+6. throughout, ``engine.serve_path_compiles`` stays ZERO — every
+   rollout compile landed on the builder thread, none on the serving
+   path (the per-thread sentinel ledger, obs/sentinel.py).
+
+All gates are returned in the summary (and journaled as a ``loop``
+kind="summary" event); the CLI wrappers exit nonzero when any fails.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import time
+
+import numpy as np
+
+__all__ = ["loop_run"]
+
+
+def loop_run(iterations: int = 1, rounds_per_rollout: int = 2,
+             family: str = "cifar10_quick", arm: str = "f32",
+             buckets: tuple = (1, 8), per_worker_batch: int = 2,
+             width: int = 4, tau: int = 2, requests: int = 48,
+             max_wait_ms: float = 5.0,
+             refusal_family: str | None = "resnet50", seed: int = 0,
+             workdir: str | None = None, log=None) -> dict:
+    """Run the full train->serve->swap->rollback cycle on the virtual
+    CPU mesh (zero chip time); returns the gate summary."""
+    from sparknet_tpu.loop.controller import ProductionLoop
+    from sparknet_tpu.loop.feed import synthetic_shard_feed
+    from sparknet_tpu.models.zoo import GRAPH_SWEEP_FAMILIES
+    from sparknet_tpu.obs.recorder import get_recorder
+    from sparknet_tpu.obs.sentinel import get_sentinel
+    from sparknet_tpu.serve.engine import (AdmissionRefused, ServeEngine,
+                                           SERVE_BUCKETS)
+    from sparknet_tpu.serve.loadgen import synthetic_items
+    from sparknet_tpu.solvers.solver import Solver
+
+    def say(msg: str) -> None:
+        if log:
+            log(msg)
+
+    get_sentinel().install()
+    fam = GRAPH_SWEEP_FAMILIES[family]
+    own_workdir = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="tpunet_loop_")
+    t_start = time.perf_counter()
+    try:
+        engine = ServeEngine(buckets=buckets, max_wait_ms=max_wait_ms)
+        loop = ProductionLoop(
+            Solver(fam.solver(), fam.net(per_worker_batch)), engine,
+            synthetic_shard_feed(fam, per_worker_batch, seed=seed),
+            workdir=workdir, family=family, arm=arm, buckets=buckets,
+            width=width, tau=tau)
+
+        say(f"loading incumbent ({family}/{arm}) — AOT-compiling "
+            f"{len(engine.buckets)} bucket(s) ...")
+        incumbent = loop.ensure_serving(seed=seed)
+
+        rs = np.random.RandomState(seed)
+        probe = synthetic_items(incumbent, 1, rs)[0]
+        # warmup every bucket, then zero the serving-path ledger: load
+        # compiles are by design, traffic/rollout compiles are the bug
+        for b in engine.buckets:
+            for item in synthetic_items(incumbent, max(1, b // 2), rs):
+                engine.submit(loop.serve_name, item)
+            engine.pump(force=True)
+        compiles0 = engine.serve_path_compiles
+        s0 = np.asarray(engine.infer(loop.serve_name, probe))
+
+        tickets = []
+
+        def traffic(n: int) -> None:
+            model = engine._models[loop.serve_name]
+            for item in synthetic_items(model, n, rs):
+                tickets.append(engine.submit(loop.serve_name, item))
+            engine.pump(force=True)
+
+        traffic(max(1, requests // 3))
+
+        # leave tickets PENDING across the swap — the drain contract
+        # (they must resolve through the incumbent's own executables)
+        pending_swap = [engine.submit(loop.serve_name, item)
+                        for item in synthetic_items(incumbent, 3, rs)]
+        tickets.extend(pending_swap)
+        say(f"training {iterations} x {rounds_per_rollout} elastic "
+            f"round(s) (W={width}, tau={tau}) + rollout ...")
+        loop.run(iterations=iterations,
+                 rounds_per_rollout=rounds_per_rollout, seed=seed)
+        swap_drained_ok = all(t.done() for t in pending_swap)
+        s1 = np.asarray(engine.infer(loop.serve_name, probe))
+        scores_changed = not np.array_equal(s0, s1)
+        say(f"post-rollout: scores_changed={scores_changed} "
+            f"pending drained={swap_drained_ok}")
+
+        traffic(max(1, requests // 3))
+
+        refused = False
+        if refusal_family:
+            try:
+                engine.build_candidate(loop.serve_name,
+                                       family=refusal_family,
+                                       buckets=(SERVE_BUCKETS[-1],))
+            except AdmissionRefused as e:
+                refused = True
+                loop._emit("refused", round=loop.trainer.round,
+                           note=f"over-HBM candidate refused: "
+                                f"{e.verdict['predicted_bytes']:,} B "
+                                f"predicted vs "
+                                f"{e.verdict['budget_bytes']:,} B budget")
+                say("over-HBM rollout candidate refused as priced")
+        incumbent_intact = np.array_equal(
+            s1, np.asarray(engine.infer(loop.serve_name, probe)))
+
+        pending_rb = [engine.submit(loop.serve_name, item)
+                      for item in synthetic_items(
+                          engine._models[loop.serve_name], 3, rs)]
+        tickets.extend(pending_rb)
+        loop.rollback()
+        rollback_drained_ok = all(t.done() for t in pending_rb)
+        s2 = np.asarray(engine.infer(loop.serve_name, probe))
+        scores_restored = np.array_equal(s0, s2)
+        say(f"post-rollback: scores_restored={scores_restored} "
+            f"pending drained={rollback_drained_ok}")
+
+        traffic(max(1, requests // 3))
+
+        for t in tickets:
+            t.wait(timeout=60.0)
+        dropped = sum(1 for t in tickets if not t.done())
+        serve_compiles = engine.serve_path_compiles - compiles0
+        engine.shutdown()
+
+        summary = {
+            "iterations": iterations,
+            "rounds": loop.trainer.round,
+            "rollouts": loop.rollouts,
+            "rollbacks": loop.rollbacks,
+            "checkpoints": loop.checkpoints,
+            "requests": len(tickets),
+            "dropped": dropped,
+            "swap_drained": swap_drained_ok,
+            "rollback_drained": rollback_drained_ok,
+            "scores_changed": scores_changed,
+            "scores_restored": scores_restored,
+            "incumbent_intact_after_refusal": incumbent_intact,
+            "refused": refused,
+            "serve_path_compiles": serve_compiles,
+            "wall_s": round(time.perf_counter() - t_start, 3),
+        }
+        summary["ok"] = bool(
+            serve_compiles == 0 and dropped == 0 and swap_drained_ok
+            and rollback_drained_ok and scores_changed
+            and scores_restored and incumbent_intact
+            and (refused or not refusal_family))
+        get_recorder().emit(
+            "loop", kind="summary", model="live", family=family,
+            arm=arm, iteration=iterations, round=loop.trainer.round,
+            rollouts=loop.rollouts, rollbacks=loop.rollbacks,
+            checkpoints=loop.checkpoints, requests=len(tickets),
+            drained=len(pending_swap) + len(pending_rb),
+            compiles=serve_compiles, loss=0.0,
+            wall_s=summary["wall_s"],
+            note="chip-free loop drive: gates "
+                 f"ok={summary['ok']} compiles={serve_compiles} "
+                 f"dropped={dropped}")
+        return summary
+    finally:
+        if own_workdir:
+            shutil.rmtree(workdir, ignore_errors=True)

@@ -1,0 +1,59 @@
+"""Shard feeds for the production loop: data plane -> elastic contract.
+
+The loop trains through :class:`~sparknet_tpu.parallel.elastic.ElasticTrainer`,
+whose ``data_fn(g)`` takes a GLOBAL shard id and returns one per-worker
+feed dict (the ShardFn contract — membership changes reassign ids, they
+never change what shard ``g`` contains).  The data plane's
+:class:`~sparknet_tpu.data.pipeline.BatchSource` speaks (epoch, index),
+so ``data.pipeline.shard_batches`` adapts one to the other and this
+module layers the zoo-family shaping on top: uint8 NCHW pixels become
+the internal-layout float feed the family's RDD layers expect, token
+families generate id matrices directly (same generator discipline as
+parallel/modes.py ``_feeds_for`` — seeded per shard id, so shard ``g``
+is deterministic across workers, rounds, and process restarts).
+
+ref: src/main/scala/libs/ScaleAndConvert.scala:1 (the reference's
+decode/convert stage feeding training and scoring alike).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["synthetic_shard_feed"]
+
+
+def synthetic_shard_feed(family, batch: int, seed: int = 0):
+    """A deterministic ShardFn for one zoo family at a PER-WORKER batch.
+
+    Image families ride the data plane's ``SyntheticImageSource``
+    through ``shard_batches`` (uint8 NCHW -> float32 in [-0.5, 0.5),
+    transposed to the active internal layout); token families key an
+    RNG off the shard id like the graph sweep's feed generator.
+    """
+    if family.feed == "tokens":
+        def token_fn(g: int) -> dict:
+            rs = np.random.RandomState((seed * 9176 + int(g)) % (2**31))
+            data = rs.randint(0, family.vocab,
+                              (batch, family.seq_len)).astype(np.int32)
+            label = rs.randint(0, family.num_classes,
+                               batch).astype(np.int32)
+            return {"data": data, "label": label}
+        return token_fn
+
+    from sparknet_tpu.data.pipeline import (SyntheticImageSource,
+                                            shard_batches)
+    from sparknet_tpu.ops.layout import internal_shape
+
+    raw_fn = shard_batches(SyntheticImageSource(
+        batch, shape=tuple(family.image_shape),
+        classes=family.num_classes, seed=seed))
+    want = internal_shape((batch, *family.image_shape))
+
+    def image_fn(g: int) -> dict:
+        raw = raw_fn(g)
+        data = raw["data"].astype(np.float32) * (1.0 / 255.0) - 0.5
+        if data.shape != want:  # channels-last build: NCHW -> NHWC
+            data = np.ascontiguousarray(data.transpose(0, 2, 3, 1))
+        return {"data": data, "label": raw["label"].astype(np.int32)}
+    return image_fn

@@ -17,7 +17,12 @@
   training legs for the serving load run (sparknet_tpu/serve): >= 500
   synthetic requests through every AOT bucket, a journaled over-HBM
   load refusal, and exit 1 unless the recompile sentinel saw 0
-  post-warmup compiles.  Render with ``report``.
+  post-warmup compiles.  ``--loop`` drives the full train-to-serve
+  production loop (sparknet_tpu/loop): elastic rounds -> atomic
+  checkpoint -> hot swap into the live engine -> over-HBM refusal ->
+  bitwise rollback, with traffic in flight; exit 1 unless every gate
+  holds (zero serving-path compiles, zero dropped tickets, scores
+  change then restore).  Render with ``report``.
 """
 
 from __future__ import annotations
@@ -111,6 +116,15 @@ def dryrun_main(argv: list[str]) -> int:
         "post-warmup compiles — still zero chip time")
     ap.add_argument("--requests", type=int, default=504,
                     help="request count for --serve (default 504)")
+    ap.add_argument(
+        "--loop", action="store_true",
+        help="run the train-to-serve production loop INSTEAD of the "
+        "training legs (sparknet_tpu/loop): elastic rounds -> atomic "
+        "checkpoint -> candidate -> hot swap -> refusal -> bitwise "
+        "rollback with requests in flight; exit 1 unless all gates "
+        "pass — still zero chip time")
+    ap.add_argument("--iterations", type=int, default=1,
+                    help="train->rollout cycles for --loop (default 1)")
     args = ap.parse_args(argv)
 
     # pin the CPU platform via the config route (the env var alone does
@@ -129,6 +143,30 @@ def dryrun_main(argv: list[str]) -> int:
     from sparknet_tpu.obs.recorder import Recorder, set_recorder
 
     rec = set_recorder(Recorder(args.out))
+
+    if args.loop:
+        from sparknet_tpu.loop.dryrun import loop_run
+
+        summary = loop_run(
+            iterations=args.iterations, rounds_per_rollout=args.rounds,
+            family=args.family, tau=args.tau,
+            log=lambda m: print(f"obs dryrun [loop]: {m}",
+                                file=sys.stderr))
+        rec.close()
+        set_recorder(None)
+        print(
+            f"obs dryrun [loop]: {summary['rounds']} elastic round(s) "
+            f"-> {summary['rollouts']} rollout(s) / "
+            f"{summary['rollbacks']} rollback(s), "
+            f"{summary['requests']} request(s) "
+            f"({summary['dropped']} dropped), "
+            f"{summary['serve_path_compiles']} serving-path compile(s), "
+            f"scores changed: {summary['scores_changed']}, restored "
+            f"bitwise: {summary['scores_restored']}, refusal "
+            f"journaled: {summary['refused']}")
+        print(f"obs dryrun: journal at {args.out} — render with "
+              f"`python -m sparknet_tpu.obs report {args.out}`")
+        return 0 if summary["ok"] else 1
 
     if args.serve:
         from sparknet_tpu.serve.loadgen import load_run

@@ -174,10 +174,67 @@ def _serve_lines(serves: list[dict]) -> list[str]:
             lines.append(
                 f"- shutdown drain served {ev.get('requests', 0)} "
                 "in-flight request(s) — zero lost")
+        elif kind == "rollout":
+            lines.append(
+                f"- **ROLLOUT** `{label}` -> version "
+                f"{ev.get('version', '?')}: hot swap in "
+                f"{ev.get('wall_s', 0):.4f} s, incumbent drained "
+                f"{ev.get('drained', 0)} ticket(s) with its own "
+                "executables")
+        elif kind == "rollback":
+            lines.append(
+                f"- **ROLLBACK** `{label}` -> version "
+                f"{ev.get('version', '?')}: previous ServedModel "
+                f"restored bitwise, {ev.get('drained', 0)} ticket(s) "
+                "drained")
+        elif kind == "candidate_built":
+            lines.append(
+                f"- candidate built `{label}` buckets "
+                f"{ev.get('buckets', [])}, AOT-compiled on the builder "
+                f"thread in {ev.get('wall_s', 0):.1f} s")
         else:
             note = ev.get("note")
             detail = f" — {note}" if note else ""
             lines.append(f"- {kind} `{label}`{detail}")
+    return lines
+
+
+def _loop_lines(loops: list[dict]) -> list[str]:
+    """Production-loop transitions: checkpoints, rollouts, rollbacks,
+    refusals — the train-to-serve narrative over the serve lifecycle."""
+    lines = []
+    for ev in loops:
+        kind = ev.get("kind", "?")
+        who = ev.get("model", "?")
+        if kind == "checkpoint":
+            lines.append(
+                f"- checkpoint @ round {ev.get('round', '?')} (iter "
+                f"{ev.get('iteration', '?')}) -> `{ev.get('path', '?')}`"
+                " — atomic npz commit")
+        elif kind == "rollout":
+            lines.append(
+                f"- rollout `{who}` -> version {ev.get('version', '?')}"
+                f" from round {ev.get('round', '?')} checkpoint "
+                f"({ev.get('drained', 0)} in-flight ticket(s) drained)")
+        elif kind == "rollback":
+            lines.append(
+                f"- rollback `{who}` -> version {ev.get('version', '?')}"
+                " — previous generation restored bitwise")
+        elif kind == "refused":
+            lines.append(
+                f"- **REFUSED rollout** `{who}` — "
+                f"{ev.get('note', 'admission pricing')}")
+        elif kind == "summary":
+            lines.append(
+                f"- summary: {ev.get('round', 0)} elastic round(s), "
+                f"{ev.get('rollouts', 0)} rollout(s), "
+                f"{ev.get('rollbacks', 0)} rollback(s), "
+                f"{ev.get('checkpoints', 0)} checkpoint(s), "
+                f"{ev.get('compiles', 0)} serving-path compile(s)")
+        else:
+            note = ev.get("note")
+            detail = f" — {note}" if note else ""
+            lines.append(f"- {kind} `{who}`{detail}")
     return lines
 
 
@@ -285,7 +342,7 @@ def render(events: list[dict], source: str = "journal") -> str:
             by_run[run_id] = {"start": [], "round": [], "span": [],
                               "member": [], "feed": [], "recompile": [],
                               "bench": [], "bank": [], "end": [],
-                              "serve": [], "request": []}
+                              "serve": [], "loop": [], "request": []}
         kind = ev.get("event")
         key = {"run_start": "start", "run_end": "end",
                "worker_lost": "member", "worker_joined": "member",
@@ -317,6 +374,9 @@ def render(events: list[dict], source: str = "journal") -> str:
         if group["serve"]:
             lines += ["", "### serving engine", ""]
             lines += _serve_lines(group["serve"])
+        if group["loop"]:
+            lines += ["", "### production loop (train-to-serve)", ""]
+            lines += _loop_lines(group["loop"])
         if group["request"]:
             lines += ["", "### request latency (p50/p99 per model × "
                           "bucket)", ""]

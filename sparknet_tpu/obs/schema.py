@@ -169,14 +169,37 @@ EVENTS: dict[str, tuple[dict, dict]] = {
     # one engine lifecycle event, discriminated by ``kind``:
     # model_loaded / load_refused (the priced-residency admission gate,
     # serve/residency.py — the serving twin of ``preflight_oom``) /
-    # model_unloaded / shutdown / summary (a load-run roll-up)
+    # model_unloaded / shutdown / summary (a load-run roll-up) /
+    # candidate_built / rollout / rollback (the hot-reload protocol,
+    # sparknet_tpu/loop: ``version`` is the swap generation, ``drained``
+    # the retiring model's in-flight requests served by its OWN
+    # executables during the swap — the zero-dropped-tickets ledger)
     "serve": (
         {"run_id": str, "kind": str},
         {"model": str, "family": str, "arm": str, "buckets": list,
          "predicted_bytes": int, "resident_bytes": int,
          "budget_bytes": int, "requests": int, "batches": int,
          "padded": int, "compiles": int, "p50_ms": _NUM, "p99_ms": _NUM,
-         "rps": _NUM, "wall_s": _NUM, "note": str},
+         "rps": _NUM, "wall_s": _NUM, "version": int, "drained": int,
+         "note": str},
+    ),
+    # -- production loop (sparknet_tpu/loop) ----------------------------
+    # one train-to-serve loop lifecycle event, discriminated by
+    # ``kind``: checkpoint (atomic solverstate write after
+    # sync_to_solver) / candidate (deploy-arm variables read back from
+    # the checkpoint artifact) / rollout / rollback (mirrors of the
+    # engine's serve events, carrying the loop's round/iteration
+    # provenance) / refused (AdmissionRefused candidate — incumbent
+    # keeps serving, journaled not fatal) / summary (a loop-run
+    # roll-up).  ``version`` is the serve-side swap generation;
+    # ``path`` the checkpoint artifact a candidate was built from.
+    "loop": (
+        {"run_id": str, "kind": str},
+        {"model": str, "family": str, "arm": str, "round": int,
+         "iteration": int, "version": int, "path": str,
+         "loss": _NUM, "wall_s": _NUM, "drained": int, "requests": int,
+         "compiles": int, "rollouts": int, "rollbacks": int,
+         "checkpoints": int, "note": str},
     ),
     # one served request's latency decomposition (the p50/p99 material):
     # queue_wait (submit -> flush) + batch_assembly (pad/fill) + device

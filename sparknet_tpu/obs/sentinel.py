@@ -19,6 +19,14 @@ flagged.
 jax's listener registry has no stability guarantee; if the hook is
 missing the sentinel degrades to ``available=False`` and counts stay 0
 (observability must never take the training run down with it).
+
+Per-thread attribution: the monitoring listener runs synchronously on
+the thread that performed the compilation, so the sentinel can also
+keep a per-thread count (``thread_count``).  That is the serving
+loop's proof obligation (sparknet_tpu/loop): a rollout legitimately
+compiles fresh bucket executables on its BUILDER thread while the
+serving thread's own count must not move — a process-wide total
+cannot tell those apart, the per-thread ledger can.
 """
 
 from __future__ import annotations
@@ -37,6 +45,7 @@ class RecompileSentinel:
     def __init__(self):
         self._lock = threading.Lock()
         self._count = 0
+        self._by_thread: dict[int, int] = {}
         self._installed = False
         self.available = False
 
@@ -53,8 +62,11 @@ class RecompileSentinel:
 
             def _on_duration(name: str, duration: float, **_kw) -> None:
                 if name == _COMPILE_EVENT:
+                    tid = threading.get_ident()
                     with self._lock:
                         self._count += 1
+                        self._by_thread[tid] = \
+                            self._by_thread.get(tid, 0) + 1
 
             monitoring.register_event_duration_secs_listener(_on_duration)
             self.available = True
@@ -68,6 +80,16 @@ class RecompileSentinel:
     def count(self) -> int:
         with self._lock:
             return self._count
+
+    def thread_count(self, tid: int | None = None) -> int:
+        """Backend compilations attributed to one thread (default: the
+        calling thread).  The listener fires on the compiling thread,
+        so a serving thread that never compiles reads 0 here even while
+        a concurrent rollout builder's count climbs."""
+        if tid is None:
+            tid = threading.get_ident()
+        with self._lock:
+            return self._by_thread.get(tid, 0)
 
 
 _sentinel: RecompileSentinel | None = None

@@ -171,6 +171,22 @@ class DynamicBatcher:
                 self._cv.wait(timeout=min(waits) if waits else None)
             return self._due(self.clock())
 
+    def drain(self) -> list[list[Ticket]]:
+        """Hand every pending request to the caller as final batches
+        WITHOUT closing — the hot-swap drain (serve/engine.py
+        ``swap_model``): the retiring model executes them with its own
+        executables, and the batcher stays open so a later rollback can
+        route new submits through it again.  The caller must hold
+        whatever lock keeps new submits away (the engine's pump lock)
+        or freshly-submitted tickets race the drain."""
+        batches: list[list[Ticket]] = []
+        while True:
+            batch = self.take(force=True)
+            if batch is None:
+                break
+            batches.append(batch)
+        return batches
+
     def close(self, drain: bool = True) -> list[list[Ticket]]:
         """Refuse new submits; return every in-flight request as final
         batches (``drain=True``, the zero-loss contract) or fail them

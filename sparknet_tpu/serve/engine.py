@@ -31,6 +31,19 @@ lands a ``request`` event (queue_wait / batch_assembly / device /
 total) — the p50/p99 material tools/serve_bench.py and the obs report
 roll up.
 
+Hot reload (the sparknet_tpu/loop production path): ``build_candidate``
+AOT-compiles a replacement's whole bucket ladder on the CALLER's thread
+— a rollout builder, never the request path — then ``swap_model``
+replaces the incumbent atomically under the engine's pump lock and
+drains the incumbent's pending tickets with the incumbent's OWN
+executables (zero dropped tickets, none served by a torn model).  The
+retired model stays resident for one generation so ``rollback``
+restores it — same object, same executables, bitwise-identical scores.
+Both transitions journal ``serve`` rollout/rollback events, and
+``serve_path_compiles`` counts backend compilations attributed (per
+thread, obs/sentinel.py) to executable calls — the loop dryrun pins it
+at zero across swaps.
+
 ref: apps/FeaturizerApp.scala:1 (the reference's batch-scoring
 inference app — RDD-throughput-shaped; the queue/deadline/AOT machinery
 is new TPU-first surface).
@@ -39,6 +52,7 @@ is new TPU-first surface).
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 
 import jax
@@ -195,12 +209,20 @@ def build_serve_program(family_name: str = "cifar10_quick",
 
 class ServedModel:
     """One resident model: arm-transformed variables, a compiled
-    executable per bucket, and its own request batcher."""
+    executable per bucket, and its own request batcher.
+
+    ``variables`` injects trained weights (a blob-wise ``NetVars`` —
+    e.g. the loop's checkpoint round-trip, loop/deploy.py) instead of
+    the seed init; the arm transforms (fold/calibrate) apply to them
+    identically.  ``version``/``previous`` are the hot-reload lineage
+    the engine maintains: a swapped-in candidate points at the model it
+    replaced until the next swap retires it or a rollback restores it.
+    """
 
     def __init__(self, name: str, family_name: str, arm: str,
                  buckets: tuple, max_wait_ms: float, clock,
                  predicted_bytes: int, seed: int = 0,
-                 calibration_batches: int = 2):
+                 calibration_batches: int = 2, variables=None):
         from sparknet_tpu.common import Phase
         from sparknet_tpu.compiler.graph import Network, NetVars
         from sparknet_tpu.ops.layout import internal_shape
@@ -212,6 +234,8 @@ class ServedModel:
         self.predicted_bytes = int(predicted_bytes)
         self.batcher = DynamicBatcher(self.buckets, max_wait_ms, clock)
         self.qstate: dict | None = None
+        self.version = 0
+        self.previous: "ServedModel | None" = None
 
         family = _family(family_name)
         self.family = family
@@ -224,7 +248,17 @@ class ServedModel:
             self.item_dtype = np.float32
 
         base = Network(family.net(self.buckets[0]), Phase.TEST)
-        self.variables = base.init(jax.random.key(seed))
+        if variables is None:
+            self.variables = base.init(jax.random.key(seed))
+        else:
+            # trained weights, host-materialized blob-wise: the serve
+            # programs lower against THIS pytree, so the signature is
+            # consistent between build and execute by construction
+            self.variables = NetVars(
+                params={ln: [np.asarray(p) for p in plist]
+                        for ln, plist in variables.params.items()},
+                state={ln: {k: np.asarray(v) for k, v in s.items()}
+                       for ln, s in variables.state.items()})
 
         def network_for(bucket: int):
             net_param = family.net(exec_batch(bucket))
@@ -327,6 +361,19 @@ class ServeEngine:
         self._models: dict[str, ServedModel] = {}
         self._resident_bytes = 0
         self._closed = False
+        # the pump lock: makes a hot swap atomic against submits — a
+        # ticket lands either in the retiring model's queue (drained by
+        # the swap, served by the OLD executables) or the candidate's,
+        # never in a drained queue.  Execution itself runs outside the
+        # lock (a captured ServedModel is immutable after construction),
+        # so the swap-gap is the dict flip + queue steal, not a device
+        # call.
+        self._lock = threading.RLock()
+        # backend compilations attributed to executable calls (the
+        # serving path), per-thread-accounted via obs/sentinel.py; the
+        # AOT contract — and the loop dryrun's gate — is that this
+        # never moves after warmup, rollouts included.
+        self.serve_path_compiles = 0
 
     # -- model lifecycle ---------------------------------------------------
 
@@ -367,8 +414,9 @@ class ServeEngine:
             name, family, arm, buckets, self.max_wait_ms, self.clock,
             verdict["predicted_bytes"], seed=seed,
             calibration_batches=self.calibration_batches)
-        self._models[name] = model
-        self._resident_bytes += model.predicted_bytes
+        with self._lock:
+            self._models[name] = model
+            self._resident_bytes += model.predicted_bytes
         rec.emit(
             "serve", kind="model_loaded", model=name, family=family,
             arm=arm, buckets=list(model.buckets),
@@ -383,25 +431,159 @@ class ServeEngine:
     def unload_model(self, name: str) -> None:
         from sparknet_tpu.obs.recorder import get_recorder
 
-        model = self._models.pop(name)
+        with self._lock:
+            model = self._models.pop(name)
+            self._resident_bytes -= model.predicted_bytes
+            if model.previous is not None:
+                self._resident_bytes -= model.previous.predicted_bytes
+                model.previous = None
         model.batcher.close(drain=False)
-        self._resident_bytes -= model.predicted_bytes
         get_recorder().emit(
             "serve", kind="model_unloaded", model=name,
             family=model.family_name, arm=model.arm,
             resident_bytes=self._resident_bytes)
 
+    # -- hot reload (the sparknet_tpu/loop rollout path) -------------------
+
+    def build_candidate(self, name: str, family: str = "cifar10_quick",
+                        arm: str = "f32", buckets: tuple | None = None,
+                        variables=None, seed: int = 0) -> ServedModel:
+        """AOT-compile a replacement for resident model ``name`` OFF the
+        request path: every bucket executable compiles on the CALLER's
+        thread (the rollout builder) before anything touches the live
+        engine.  Priced first against the CURRENT resident set — the
+        incumbent stays resident through the rollback window, so both
+        generations must fit; an over-budget candidate raises
+        :class:`AdmissionRefused` with the verdict journaled and the
+        incumbent untouched (refused, not fatal)."""
+        from sparknet_tpu.obs.recorder import get_recorder
+
+        if arm not in _ARMS:
+            raise ValueError(f"unknown arm {arm!r}; one of {_ARMS}")
+        if name not in self._models:
+            raise ValueError(
+                f"no resident model {name!r} to replace — use "
+                "load_model for the first generation")
+        buckets = tuple(sorted(set(buckets or self.buckets)))
+        rec = get_recorder()
+        verdict = self.policy.admit(family, buckets[-1],
+                                    self._resident_bytes)
+        if not verdict["fits"]:
+            rec.emit(
+                "serve", kind="load_refused", model=name, family=family,
+                arm=arm, buckets=list(buckets),
+                predicted_bytes=verdict["predicted_bytes"],
+                resident_bytes=verdict["resident_bytes"],
+                budget_bytes=verdict["budget_bytes"],
+                note="rollout candidate refused by the batch-fit "
+                     "pricing — incumbent keeps serving, zero compile "
+                     "seconds spent")
+            raise AdmissionRefused(verdict)
+        candidate = ServedModel(
+            name, family, arm, buckets, self.max_wait_ms, self.clock,
+            verdict["predicted_bytes"], seed=seed,
+            calibration_batches=self.calibration_batches,
+            variables=variables)
+        rec.emit(
+            "serve", kind="candidate_built", model=name, family=family,
+            arm=arm, buckets=list(candidate.buckets),
+            predicted_bytes=candidate.predicted_bytes,
+            wall_s=round(candidate.compile_wall_s, 6),
+            note="all buckets AOT-compiled on the builder thread — "
+                 "zero request-path compiles")
+        return candidate
+
+    def swap_model(self, name: str, candidate: ServedModel) -> dict:
+        """Atomically replace resident model ``name`` with a
+        pre-compiled ``candidate`` (from :meth:`build_candidate`).
+
+        Under the pump lock: the routing flips (new submits land in the
+        candidate's batcher) and the incumbent's pending tickets are
+        stolen; the lock is then released and those tickets execute with
+        the incumbent's OWN executables — every submitted ticket
+        resolves, none through a half-swapped model.  The incumbent is
+        retained as ``candidate.previous`` (one rollback generation;
+        the grandparent retires and its bytes are released).  Journals a
+        ``serve`` rollout event; returns swap telemetry."""
+        from sparknet_tpu.obs.recorder import get_recorder
+
+        t0 = time.perf_counter()
+        with self._lock:
+            old = self._models[name]
+            grand, old.previous = old.previous, None
+            candidate.version = old.version + 1
+            candidate.previous = old
+            self._models[name] = candidate
+            self._resident_bytes += candidate.predicted_bytes
+            if grand is not None:
+                self._resident_bytes -= grand.predicted_bytes
+            stale = old.batcher.drain()
+        drained = 0
+        for batch in stale:
+            self._execute(old, batch)
+            drained += len(batch)
+        wall = time.perf_counter() - t0
+        get_recorder().emit(
+            "serve", kind="rollout", model=name,
+            family=candidate.family_name, arm=candidate.arm,
+            buckets=list(candidate.buckets), version=candidate.version,
+            drained=drained, predicted_bytes=candidate.predicted_bytes,
+            resident_bytes=self._resident_bytes,
+            wall_s=round(wall, 6),
+            note="hot swap under the pump lock — incumbent drained "
+                 "with its own executables, zero dropped tickets")
+        return {"version": candidate.version, "drained": drained,
+                "swap_wall_s": wall}
+
+    def rollback(self, name: str) -> ServedModel:
+        """Restore the previous generation of resident model ``name`` —
+        the SAME ``ServedModel`` object the last swap retired, its
+        executables and variables untouched, so post-rollback scores are
+        bitwise-identical to pre-rollout scores.  The rolled-back
+        candidate's pending tickets drain through the candidate's own
+        executables first (zero dropped tickets, symmetrically with the
+        swap).  Journals a ``serve`` rollback event."""
+        from sparknet_tpu.obs.recorder import get_recorder
+
+        with self._lock:
+            cur = self._models[name]
+            prev = cur.previous
+            if prev is None:
+                raise RuntimeError(
+                    f"model {name!r} has no previous generation to "
+                    "roll back to")
+            cur.previous = None
+            self._models[name] = prev
+            self._resident_bytes -= cur.predicted_bytes
+            stale = cur.batcher.drain()
+        drained = 0
+        for batch in stale:
+            self._execute(cur, batch)
+            drained += len(batch)
+        get_recorder().emit(
+            "serve", kind="rollback", model=name,
+            family=prev.family_name, arm=prev.arm,
+            buckets=list(prev.buckets), version=prev.version,
+            drained=drained, resident_bytes=self._resident_bytes,
+            note="previous ServedModel restored bitwise (same object, "
+                 "same executables); rolled-back candidate drained "
+                 "with its own executables")
+        return prev
+
     # -- request path ------------------------------------------------------
 
     def submit(self, model_name: str, item) -> Ticket:
-        """Enqueue one request (a single example, item-shaped)."""
-        model = self._models[model_name]
-        item = np.asarray(item, model.item_dtype)
-        if item.shape != model.item_shape:
-            raise ValueError(
-                f"request shape {item.shape} != model item shape "
-                f"{model.item_shape}")
-        return model.batcher.submit(item)
+        """Enqueue one request (a single example, item-shaped).  Holds
+        the pump lock across lookup + enqueue so a concurrent hot swap
+        can never strand the ticket in an already-drained queue."""
+        with self._lock:
+            model = self._models[model_name]
+            item = np.asarray(item, model.item_dtype)
+            if item.shape != model.item_shape:
+                raise ValueError(
+                    f"request shape {item.shape} != model item shape "
+                    f"{model.item_shape}")
+            return model.batcher.submit(item)
 
     def infer(self, model_name: str, item,
               timeout: float | None = 60.0):
@@ -475,6 +657,10 @@ class ServeEngine:
             data[i] = t.payload
         label = np.zeros((n,), np.int32)
         asm_ms = (time.perf_counter() - asm0) * 1e3
+        from sparknet_tpu.obs.sentinel import get_sentinel
+
+        sentinel = get_sentinel()
+        compiles0 = sentinel.thread_count()
         dev0 = time.perf_counter()
         try:
             with rec.span("serve_device",
@@ -492,6 +678,11 @@ class ServeEngine:
                 t.resolve(error=e)
             raise
         device_ms = (time.perf_counter() - dev0) * 1e3
+        # per-THREAD attribution: a concurrent rollout builder's
+        # compiles land on its own thread's counter, so a nonzero delta
+        # here can only mean the executable call itself compiled — the
+        # exact AOT violation the loop dryrun gates on
+        self.serve_path_compiles += sentinel.thread_count() - compiles0
         now = self.clock()
         model.batches += 1
         model.padded_rows += bucket - len(tickets)

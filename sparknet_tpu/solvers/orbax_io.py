@@ -33,8 +33,14 @@ def _write_meta(path: str, meta: dict) -> None:
     then a barrier so no process returns from save() — and possibly
     races into restore's validation — before the sidecar is visible."""
     if jax.process_index() == 0:
-        with open(os.path.join(path, "sparknet_meta.json"), "w") as f:
+        # same atomic-commit discipline as the npz save: temp file in
+        # the checkpoint dir, then os.replace — a watcher that sees the
+        # sidecar name sees complete JSON
+        final = os.path.join(path, "sparknet_meta.json")
+        tmp = final + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(meta, f)
+        os.replace(tmp, final)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 

@@ -12,9 +12,11 @@ weight IO, ref: Net.scala:131-171) — on TPU the weights never leave HBM.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import os
+import tempfile
 import time
 from typing import Any, Callable, Iterator
 
@@ -1020,7 +1022,23 @@ class Solver:
             for i, slot in enumerate(slist):
                 for j, h in enumerate(slot):
                     flat[f"hist/{lname}/{i}/{j}"] = np.asarray(h)
-        np.savez(path, **flat)
+        # atomic commit: write the archive to a temp file in the SAME
+        # directory, then os.replace — a poller (loop/watcher.py) that
+        # lists the final name gets a complete archive or nothing,
+        # never a torn zip.  np.savez appends ".npz" to suffix-less
+        # string paths, so the temp write goes through an open file
+        # object to keep the name literal.
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(path) or ".",
+            prefix=os.path.basename(path) + ".tmp.")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez(f, **flat)
+            os.replace(tmp, path)
+        except BaseException:
+            with contextlib.suppress(OSError):
+                os.unlink(tmp)
+            raise
         return path
 
     def _export_model_pair(self, prefix: str) -> None:

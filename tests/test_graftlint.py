@@ -35,6 +35,7 @@ EXPECTED_RULES = {
     "fused-update-manifest",
     "elastic-manifest-fresh",
     "serve-manifest-fresh",
+    "loop-manifest-fresh",
     "queue-job-hygiene",
     "obs-fenced-span",
     "feed-shm-cleanup",
@@ -761,6 +762,67 @@ def test_serve_manifest_fresh_ignores_other_packages(tmp_path):
     other.write_text(FRESH_SRC)
     assert not hits(FRESH_SRC, "serve-manifest-fresh", path=str(other))
     assert not hits(FRESH_SRC, "serve-manifest-fresh")
+
+
+# -- loop-manifest-fresh ----------------------------------------------------
+
+
+def _loop_tree(tmp_path, record=True, covered=True,
+               families=("graph_contracts", "mem_contracts")):
+    """A fake repo around loop/controller.py: SOURCES.json per family,
+    optionally not covering it (the loop banks no twins of its own)."""
+    import hashlib
+    import json as _json
+
+    rel = "sparknet_tpu/loop/controller.py"
+    mod = tmp_path / rel
+    mod.parent.mkdir(parents=True, exist_ok=True)
+    mod.write_text(FRESH_SRC)
+    digest = hashlib.sha256(FRESH_SRC.encode()).hexdigest()
+    for fam in families:
+        cdir = tmp_path / "docs" / fam
+        cdir.mkdir(parents=True, exist_ok=True)
+        if record:
+            entry = {rel: digest} if covered else {"other.py": digest}
+            (cdir / "SOURCES.json").write_text(_json.dumps(entry))
+    return str(mod)
+
+
+def test_loop_manifest_fresh_clean_when_banked(tmp_path):
+    path = _loop_tree(tmp_path)
+    assert not hits(FRESH_SRC, "loop-manifest-fresh", path=path)
+
+
+def test_loop_manifest_fresh_positive_when_never_banked(tmp_path):
+    path = _loop_tree(tmp_path, record=False)
+    found = hits(FRESH_SRC, "loop-manifest-fresh", path=path)
+    assert len(found) == 2  # one per family
+    assert "SOURCES.json missing" in found[0].message
+
+
+def test_loop_manifest_fresh_positive_when_not_folded_in(tmp_path):
+    # manifests exist but predate the loop layer: controller.py absent
+    # from the fingerprint — the silent-non-coverage hole
+    path = _loop_tree(tmp_path, covered=False)
+    found = hits(FRESH_SRC, "loop-manifest-fresh", path=path)
+    assert len(found) == 2
+    assert all("not folded into" in f.message for f in found)
+
+
+def test_loop_manifest_fresh_suppressed(tmp_path):
+    path = _loop_tree(tmp_path, record=False)
+    src = ("# graftlint: disable-file=loop-manifest-fresh -- "
+           "manifest regen follows in this PR\n" + FRESH_SRC)
+    assert not hits(src, "loop-manifest-fresh", path=path)
+    assert suppressed_hits(src, "loop-manifest-fresh", path=path)
+
+
+def test_loop_manifest_fresh_ignores_other_packages(tmp_path):
+    other = tmp_path / "sparknet_tpu" / "serve" / "engine.py"
+    other.parent.mkdir(parents=True, exist_ok=True)
+    other.write_text(FRESH_SRC)
+    assert not hits(FRESH_SRC, "loop-manifest-fresh", path=str(other))
+    assert not hits(FRESH_SRC, "loop-manifest-fresh")
 
 
 # -- queue-job-hygiene ------------------------------------------------------

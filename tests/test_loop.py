@@ -1,0 +1,363 @@
+"""Production-loop gates (sparknet_tpu/loop; ROADMAP item 3).
+
+Five contract families:
+
+1. **Hot-swap drain** — tickets submitted before a swap all resolve
+   (through the incumbent's OWN executables), the batcher stays open
+   (drain != close), and the version lineage advances.
+2. **Bitwise rollback** — ``rollback`` restores the SAME retained
+   ``ServedModel``: post-rollback scores are bit-identical to
+   pre-rollout scores.
+3. **Priced rollout refusal** — an over-HBM candidate raises
+   ``AdmissionRefused`` with the verdict journaled and the incumbent
+   serving untouched (refused, not fatal).
+4. **Atomic checkpoints** — ``Solver.save`` npz commits via temp +
+   ``os.replace``: a reader polling DURING a slow save never sees a
+   partial archive, and the loop's checkpoint->deploy round-trip
+   (loop/deploy.py) restores byte-identical weights.
+5. **Per-thread compile attribution** — the sentinel separates a
+   builder thread's compiles from the serving thread's
+   (obs/sentinel.py), the ledger behind the loop dryrun's
+   zero-serving-path-compiles gate.
+
+ref: apps/FeaturizerApp.scala:1 (the reference's single driver app
+owning both training and scoring; hot reload is new TPU-first surface).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.serve import AdmissionRefused, DynamicBatcher, ServeEngine
+
+
+def _serve_items(engine, name, n, seed=3):
+    from sparknet_tpu.serve.loadgen import synthetic_items
+
+    return synthetic_items(engine._models[name],
+                           n, np.random.RandomState(seed))
+
+
+# -- batcher drain (jax-free) -----------------------------------------------
+
+
+@pytest.mark.smoke
+def test_drain_returns_pending_and_stays_open():
+    """drain() hands back every pending ticket WITHOUT closing — the
+    hot-swap steal; a rolled-back model's batcher must accept new
+    submits afterwards (close() is permanent, drain() is not)."""
+    b = DynamicBatcher(buckets=(1, 8), max_wait_ms=5.0,
+                       clock=lambda: 0.0)
+    tickets = [b.submit(i) for i in range(11)]
+    batches = b.drain()
+    drained = [t for batch in batches for t in batch]
+    assert sorted(t.id for t in drained) == sorted(t.id for t in tickets)
+    assert b.pending() == 0
+    assert not b.closed
+    late = b.submit("after-drain")  # would raise if drain had closed
+    assert late.id > tickets[-1].id
+
+
+# -- hot swap / rollback ----------------------------------------------------
+
+
+def test_swap_zero_dropped_and_version_lineage():
+    """Tickets pending at swap time all resolve (drained through the
+    incumbent's own executables); routing flips to the candidate; the
+    incumbent is retained one generation for rollback."""
+    engine = ServeEngine(buckets=(1, 8))
+    engine.load_model("m", family="lenet", seed=0)
+    incumbent = engine._models["m"]
+    pending = [engine.submit("m", it) for it in
+               _serve_items(engine, "m", 3)]
+    assert not any(t.done() for t in pending)
+
+    candidate = engine.build_candidate("m", family="lenet", seed=1)
+    info = engine.swap_model("m", candidate)
+
+    assert all(t.done() for t in pending), "swap dropped tickets"
+    assert all(t.error is None for t in pending)
+    assert info["drained"] == 3
+    assert info["version"] == 1
+    assert engine._models["m"] is candidate
+    assert candidate.previous is incumbent
+    # both generations priced resident during the rollback window
+    assert engine.resident_bytes() == (candidate.predicted_bytes
+                                       + incumbent.predicted_bytes)
+    engine.shutdown()
+
+
+def test_rollback_restores_scores_bitwise():
+    """The retired generation comes back as the same object with the
+    same executables — pre-rollout and post-rollback scores are
+    bit-identical; tickets pending at rollback drain through the
+    rolled-back candidate's own executables."""
+    engine = ServeEngine(buckets=(1, 8))
+    engine.load_model("m", family="lenet", seed=0)
+    probe = _serve_items(engine, "m", 1)[0]
+    s0 = np.asarray(engine.infer("m", probe))
+
+    candidate = engine.build_candidate("m", family="lenet", seed=1)
+    engine.swap_model("m", candidate)
+    s1 = np.asarray(engine.infer("m", probe))
+    assert not np.array_equal(s0, s1), "candidate must score differently"
+
+    pending = [engine.submit("m", it) for it in
+               _serve_items(engine, "m", 2)]
+    prev = engine.rollback("m")
+    assert all(t.done() for t in pending), "rollback dropped tickets"
+    assert prev.version == 0 and engine._models["m"] is prev
+    s2 = np.asarray(engine.infer("m", probe))
+    assert np.array_equal(s0, s2), "rollback is not bitwise"
+    # candidate's bytes released; new submits ride the restored batcher
+    assert engine.resident_bytes() == prev.predicted_bytes
+    engine.shutdown()
+
+
+def test_refused_candidate_leaves_incumbent_serving(tmp_path):
+    """An over-HBM rollout candidate refuses BEFORE any compile, the
+    verdict lands in the journal, and the incumbent keeps serving the
+    same scores — refused, not fatal."""
+    from sparknet_tpu.obs.recorder import Recorder, set_recorder
+    from sparknet_tpu.serve.engine import SERVE_BUCKETS
+
+    path = str(tmp_path / "refusal.jsonl")
+    rec = set_recorder(Recorder(path, run_id="loop-test"))
+    try:
+        engine = ServeEngine(buckets=(1,))  # banked fit table
+        engine.load_model("m", family="lenet", seed=0)
+        probe = _serve_items(engine, "m", 1)[0]
+        s0 = np.asarray(engine.infer("m", probe))
+        with pytest.raises(AdmissionRefused) as ei:
+            engine.build_candidate("m", family="resnet50",
+                                   buckets=(SERVE_BUCKETS[-1],))
+        assert ei.value.verdict["predicted_bytes"] > 0
+        # incumbent untouched: same object, same scores, version 0
+        assert engine._models["m"].version == 0
+        assert np.array_equal(
+            s0, np.asarray(engine.infer("m", probe)))
+        engine.shutdown()
+    finally:
+        rec.close()
+        set_recorder(None)
+    kinds = [json.loads(line) for line in open(path)]
+    refusals = [e for e in kinds if e.get("event") == "serve"
+                and e.get("kind") == "load_refused"]
+    assert len(refusals) == 1
+    assert "incumbent keeps serving" in refusals[0]["note"]
+
+
+def test_unload_releases_retained_generation():
+    """unload_model releases BOTH generations' residency when a
+    previous generation is still retained (a priced fit-table row so
+    the ledger carries real bytes)."""
+    fit = {"families": {"lenet": {"f32": {
+        "c0": 1 << 20, "c1": 1 << 10,
+        "params_bytes": 1 << 20, "slots_bytes": 0}}}}
+    engine = ServeEngine(buckets=(1,), fit_table=fit)
+    engine.load_model("m", family="lenet", seed=0)
+    candidate = engine.build_candidate("m", family="lenet", seed=1)
+    assert candidate.predicted_bytes > 0
+    engine.swap_model("m", candidate)
+    assert engine.resident_bytes() > candidate.predicted_bytes
+    engine.unload_model("m")
+    assert engine.resident_bytes() == 0
+
+
+# -- atomic checkpoints -----------------------------------------------------
+
+
+def _small_solver():
+    from sparknet_tpu.models import zoo
+    from sparknet_tpu.solvers.solver import Solver
+
+    return Solver(zoo.lenet_solver(), zoo.lenet(2))
+
+
+def test_atomic_save_never_shows_a_torn_archive(tmp_path, monkeypatch):
+    """A reader polling the final npz name during a SLOW save must see
+    either nothing or a complete archive — the os.replace commit.  The
+    slow writer dribbles the archive bytes into the temp file, so any
+    torn-window bug (writing the final name in place) would surface as
+    a zipfile error in the poller."""
+    import sparknet_tpu.solvers.solver as solver_mod
+
+    solver = _small_solver()
+    prefix = str(tmp_path / "snap")
+    final = f"{prefix}.solverstate.npz"
+    real_savez = np.savez
+
+    def slow_savez(f, **arrays):
+        buf = io.BytesIO()
+        real_savez(buf, **arrays)
+        payload = buf.getvalue()
+        step = max(1, len(payload) // 20)
+        for i in range(0, len(payload), step):
+            f.write(payload[i:i + step])
+            time.sleep(0.002)
+
+    monkeypatch.setattr(solver_mod.np, "savez", slow_savez)
+    torn: list[str] = []
+    stop = threading.Event()
+
+    def poller():
+        while not stop.is_set():
+            if os.path.exists(final):
+                try:
+                    with np.load(final) as data:
+                        assert "__iter__" in data.files
+                except Exception as e:  # torn archive = the bug
+                    torn.append(repr(e))
+            time.sleep(0.001)
+
+    t = threading.Thread(target=poller, daemon=True)
+    t.start()
+    try:
+        out = solver.save(prefix)
+    finally:
+        stop.set()
+        t.join(timeout=5.0)
+    assert out == final and os.path.exists(final)
+    assert not torn, f"poller saw a torn archive: {torn[:3]}"
+    # the temp file was committed, not left behind
+    leftovers = [n for n in os.listdir(tmp_path) if ".tmp." in n]
+    assert not leftovers, leftovers
+
+
+def test_checkpoint_watcher_sees_only_complete_new_files(tmp_path):
+    from sparknet_tpu.loop.watcher import CheckpointWatcher
+
+    w = CheckpointWatcher(str(tmp_path))
+    assert w.poll() == []
+    solver = _small_solver()
+    path = solver.save(str(tmp_path / "round00001"))
+    assert w.poll() == [path]
+    assert w.poll() == []  # never the same path twice
+    path2 = solver.save(str(tmp_path / "round00002"))
+    assert w.poll() == [path2]
+
+
+def test_checkpoint_deploy_roundtrip_bitwise(tmp_path):
+    """loop/deploy.py restores byte-identical weights from the saved
+    archive — the checkpoint is the durable train->serve hand-off."""
+    from sparknet_tpu.loop.deploy import variables_from_checkpoint
+
+    solver = _small_solver()
+    path = solver.save(str(tmp_path / "snap"))
+    variables = variables_from_checkpoint(path)
+    for lname, plist in solver.variables.params.items():
+        got = variables.params[lname]
+        assert len(got) == len(plist)
+        for a, b in zip(got, plist):
+            assert np.array_equal(a, np.asarray(b)), lname
+    for lname, state in solver.variables.state.items():
+        for k, v in state.items():
+            assert np.array_equal(variables.state[lname][k],
+                                  np.asarray(v)), (lname, k)
+
+
+def test_deploy_rejects_paramless_archive(tmp_path):
+    from sparknet_tpu.loop.deploy import variables_from_checkpoint
+
+    path = str(tmp_path / "empty.npz")
+    np.savez(path, **{"__iter__": np.asarray(0)})
+    with pytest.raises(ValueError, match="no param/"):
+        variables_from_checkpoint(path)
+
+
+# -- shard feed -------------------------------------------------------------
+
+
+@pytest.mark.smoke
+def test_shard_batches_adapts_source_to_shard_ids():
+    from sparknet_tpu.data.pipeline import (SyntheticImageSource,
+                                            shard_batches)
+
+    fn = shard_batches(SyntheticImageSource(4, shape=(3, 8, 8), seed=1))
+    a, b = fn(0), fn(1)
+    assert a["data"].shape == (4, 3, 8, 8)
+    assert not np.array_equal(a["data"], b["data"])
+    assert np.array_equal(fn(0)["data"], a["data"])  # deterministic
+
+
+@pytest.mark.smoke
+def test_synthetic_shard_feed_shapes_and_determinism():
+    from sparknet_tpu.loop.feed import synthetic_shard_feed
+    from sparknet_tpu.models.zoo import GRAPH_SWEEP_FAMILIES
+
+    fam = GRAPH_SWEEP_FAMILIES["cifar10_quick"]
+    fn = synthetic_shard_feed(fam, 2, seed=0)
+    feed = fn(7)
+    assert feed["data"].dtype == np.float32
+    assert feed["data"].shape[0] == 2
+    assert feed["label"].dtype == np.int32
+    assert np.array_equal(fn(7)["data"], feed["data"])
+    assert not np.array_equal(fn(8)["data"], feed["data"])
+    assert float(np.abs(feed["data"]).max()) <= 0.5
+
+    tok = GRAPH_SWEEP_FAMILIES["transformer"]
+    tfn = synthetic_shard_feed(tok, 2, seed=0)
+    tfeed = tfn(3)
+    assert tfeed["data"].shape == (2, tok.seq_len)
+    assert tfeed["data"].dtype == np.int32
+    assert int(tfeed["data"].max()) < tok.vocab
+    assert np.array_equal(tfn(3)["data"], tfeed["data"])
+
+
+# -- per-thread compile attribution -----------------------------------------
+
+
+def test_sentinel_attributes_compiles_per_thread():
+    """The listener fires on the COMPILING thread: a builder thread's
+    fresh jit compile moves its own counter, never the caller's — the
+    mechanism behind engine.serve_path_compiles."""
+    import jax
+
+    from sparknet_tpu.obs.sentinel import get_sentinel
+
+    sentinel = get_sentinel().install()
+    if not sentinel.available:
+        pytest.skip("jax monitoring hook unavailable")
+    main0 = sentinel.thread_count()
+    builder_delta: list[int] = []
+
+    def builder():
+        b0 = sentinel.thread_count()
+        # a shape never used elsewhere in the suite forces a compile
+        x = np.arange(137, dtype=np.float32)
+        np.asarray(jax.jit(lambda v: v * 3 + 1)(x))
+        builder_delta.append(sentinel.thread_count() - b0)
+
+    t = threading.Thread(target=builder)
+    t.start()
+    t.join(timeout=120.0)
+    assert builder_delta and builder_delta[0] >= 1
+    assert sentinel.thread_count() == main0  # caller's ledger untouched
+
+
+# -- the full loop (chip-free) ----------------------------------------------
+
+
+def test_loop_run_gates(tmp_path):
+    """The integrated drive at minimal scale: every gate the dryrun
+    mode 19 pins — zero serving-path compiles, zero dropped, scores
+    change on rollout and restore bitwise on rollback, refusal
+    journaled with the incumbent intact."""
+    from sparknet_tpu.loop.dryrun import loop_run
+
+    summary = loop_run(iterations=1, rounds_per_rollout=1, width=2,
+                       tau=1, requests=6, per_worker_batch=2,
+                       workdir=str(tmp_path / "loop"))
+    assert summary["ok"], summary
+    assert summary["serve_path_compiles"] == 0
+    assert summary["dropped"] == 0
+    assert summary["scores_changed"] and summary["scores_restored"]
+    assert summary["refused"] and summary["incumbent_intact_after_refusal"]
+    assert summary["checkpoints"] == 1 and summary["rollouts"] == 1

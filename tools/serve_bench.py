@@ -13,6 +13,14 @@ per arm, then a combined gate record (banked to
   load is that NO request's queue wait exceeds max_wait_ms by more
   than one scheduler tick (arrivals don't wait for service — the
   generator enqueues on schedule even when the engine lags).
+* **swap** (``--swap``) — the hot-reload arm: a full rollout
+  (candidate AOT-compiled on a builder thread, ``swap_model`` under
+  the pump lock — sparknet_tpu/loop protocol) lands mid-stream under
+  the same Poisson load; reports the swap-gap (max request stall and
+  p99 over requests overlapping the swap) next to the lock-hold wall.
+  With this arm the compile gate moves to the per-thread ledger
+  (``engine.serve_path_compiles`` must read 0 — builder compiles are
+  by design), and any unresolved ticket voids the record.
 
 House rules: the recompile sentinel must read 0 post-warmup compiles
 across both arms (AOT buckets — any recompile voids the run);
@@ -130,6 +138,93 @@ def bench_open_loop(engine, model, rate: float, seconds: float,
     }
 
 
+def bench_swap_gap(engine, model, rate: float, seconds: float,
+                   family: str, arm: str, buckets: tuple,
+                   seed: int = 11) -> dict:
+    """The hot-reload arm: open-loop Poisson load with a full rollout
+    mid-stream (sparknet_tpu/loop protocol — candidate AOT-compiled on
+    a builder thread, ``swap_model`` under the pump lock).
+
+    The swap-gap claim: the candidate's compile cost never reaches the
+    request path — the only request-visible stall is the pump-lock hold
+    (queue steal + dict flip, microseconds) plus natural device
+    contention from draining the incumbent.  Reported as the max total
+    latency over requests whose lifetime OVERLAPS the swap interval,
+    next to the run's overall p99 and the lock-hold wall itself.
+    """
+    import threading
+
+    from sparknet_tpu.serve.loadgen import synthetic_items
+
+    served = engine._models[model]
+    n0 = len(served.lat_total_ms)
+    rs = np.random.RandomState(seed)
+    n = max(1, int(rate * seconds))
+    items = synthetic_items(served, min(n, 64), rs)
+    gaps = rs.exponential(1.0 / rate, n)
+    stop = threading.Event()
+    worker = threading.Thread(
+        target=lambda: engine.serve_forever(until=stop.is_set),
+        daemon=True)
+    worker.start()
+
+    swap: dict = {}
+
+    def builder() -> None:
+        # build + swap land mid-run; engine.clock stamps the interval
+        # in the same timebase as the tickets' t_submit/t_done
+        time.sleep(seconds * 0.4)
+        b0 = time.perf_counter()
+        cand = engine.build_candidate(model, family=family, arm=arm,
+                                      buckets=buckets, seed=seed)
+        swap["build_s"] = time.perf_counter() - b0
+        swap["t0"] = engine.clock()
+        swap.update(engine.swap_model(model, cand))
+        swap["t1"] = engine.clock()
+
+    bthread = threading.Thread(target=builder, daemon=True)
+    tickets = []
+    t0 = time.perf_counter()
+    bthread.start()
+    for i in range(n):
+        target = t0 + float(np.sum(gaps[:i + 1]))
+        delay = target - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        tickets.append(engine.submit(model, items[i % len(items)]))
+    for t in tickets:
+        t.wait(timeout=60.0)
+    bthread.join(timeout=120.0)
+    stop.set()
+    worker.join(timeout=5.0)
+
+    overlap = [t for t in tickets
+               if t.t_done is not None and t.t_done >= swap["t0"]
+               and t.t_submit <= swap["t1"]]
+    stalls = [(t.t_done - t.t_submit) * 1e3 for t in overlap]
+    # every request the swap could have touched resolved — the
+    # zero-dropped-tickets half of the hot-reload contract
+    dropped = sum(1 for t in tickets if not t.done())
+    lats = [ms for m in (engine._models[model],
+                         engine._models[model].previous) if m
+            for ms in m.lat_total_ms[n0 if m is served else 0:]]
+    swap_wall_ms = swap.get("swap_wall_s", 0.0) * 1e3
+    return {
+        "metric": "serve_swap_gap_ms",
+        "value": round(max(stalls) if stalls else swap_wall_ms, 3),
+        "unit": f"ms max request stall overlapping the hot swap "
+                f"(open loop, {rate:g} req/s Poisson, {n} requests)",
+        "p99_ms_during": round(_pctl(stalls, 99), 3) if stalls else 0.0,
+        "p99_ms_overall": round(_pctl(lats, 99), 3),
+        "swap_wall_ms": round(swap_wall_ms, 3),
+        "candidate_build_s": round(swap.get("build_s", 0.0), 3),
+        "overlapping_requests": len(overlap),
+        "drained": swap.get("drained", 0),
+        "version": swap.get("version", 0),
+        "dropped": dropped,
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--family", default="cifar10_quick")
@@ -143,6 +238,12 @@ def main() -> int:
     ap.add_argument("--seconds", type=float, default=5.0,
                     help="open-loop duration")
     ap.add_argument("--max-wait-ms", type=float, default=5.0)
+    ap.add_argument("--swap", action="store_true",
+                    help="add the hot-reload arm: a full "
+                    "build_candidate + swap_model rollout mid-stream "
+                    "under open-loop Poisson load, measuring the "
+                    "swap-gap (max request stall and p99 during the "
+                    "hot reload — sparknet_tpu/loop protocol)")
     ap.add_argument("--platform", default="",
                     help="force a jax platform (the config route wins "
                     "over JAX_PLATFORMS site pins); cpu = host-side run")
@@ -197,7 +298,16 @@ def main() -> int:
     open_arm = bench_open_loop(engine, "m", args.rate, args.seconds,
                                args.max_wait_ms)
     print(json.dumps(open_arm))
-    compiles_post = sentinel.count - compiles0
+    swap_arm = None
+    if args.swap:
+        swap_arm = bench_swap_gap(engine, "m", args.rate, args.seconds,
+                                  args.family, args.arm, buckets)
+        print(json.dumps(swap_arm))
+    # with --swap the builder thread's candidate compiles are by design;
+    # what must stay zero is the engine's serving-path ledger (per-thread
+    # sentinel attribution, obs/sentinel.py)
+    compiles_post = (engine.serve_path_compiles if args.swap
+                     else sentinel.count - compiles0)
     engine.shutdown()
 
     best = max(arms, key=lambda r: r["value"])
@@ -212,6 +322,7 @@ def main() -> int:
         "closed_loop": {r["metric"]: {k: r[k] for k in
                         ("value", "p50_ms", "p99_ms")} for r in arms},
         "open_loop": open_arm,
+        **({"swap": swap_arm} if swap_arm else {}),
         "compiles_post_warmup": compiles_post,
         "max_wait_ms": args.max_wait_ms,
         "platform": platform,
@@ -227,6 +338,11 @@ def main() -> int:
             f"{compiles_post} backend compile(s) during steady-state "
             "traffic — the AOT-bucket contract is broken; latencies "
             "include compile walls and are not evidence")
+    if swap_arm is not None and swap_arm["dropped"] != 0:
+        record["measured"] = False
+        record["swap_inconsistency"] = (
+            f"{swap_arm['dropped']} ticket(s) unresolved across the "
+            "hot swap — the zero-dropped drain contract is broken")
     print(json.dumps(record))
     if args.bank:
         from sparknet_tpu.common import bank_guard
