@@ -183,7 +183,7 @@ def _internalize(fn):
     def wrapped(it):
         return feeds_to_internal(fn(it))
 
-    for attr in ("device_fn", "pipeline_factory"):
+    for attr in ("device_fn", "trainer_device_fn", "pipeline_factory"):
         if hasattr(fn, attr):
             setattr(wrapped, attr, getattr(fn, attr))
     return wrapped
@@ -192,7 +192,10 @@ def _internalize(fn):
 def _attach_device_augment(train_fn, cfg, pid, seed=None):
     """Attach the in-XLA transform as the async feed's ``device_fn`` —
     the key policy lives in :meth:`DeviceAugment.device_fn`, shared by
-    the threaded prefetcher and the process pipeline's device stage."""
+    the threaded prefetcher and the process pipeline's device stage —
+    plus the trainer-path twin (``trainer_device_fn``): the hook
+    ``ParallelTrainer``/``ElasticTrainer`` apply after their own feed
+    placement, so the uint8 wire reaches the chip on the tau path too."""
     from sparknet_tpu.data import DeviceAugment
 
     try:
@@ -200,6 +203,7 @@ def _attach_device_augment(train_fn, cfg, pid, seed=None):
     except ValueError as e:
         raise SystemExit(f"transform_param: {e}") from None
     train_fn.device_fn = aug.device_fn(pid, seed)
+    train_fn.trainer_device_fn = aug.trainer_device_fn(pid, seed)
     return train_fn
 
 
@@ -211,19 +215,24 @@ def _feed_mode() -> str:
 
 
 def _device_augment_guards(args):
-    """Shared preconditions for --augment device (any source)."""
+    """Shared preconditions for --augment device (any source).
+
+    The distributed trainer path (tau > 1 / --distributed /
+    --elastic-alpha) needs NO async-feed precondition: the trainer owns
+    its own feed placement and applies the augment post-placement
+    (``trainer_device_fn`` -> ``ParallelTrainer.feed_device_fn``), so
+    uint8 wire batches work with the threaded AND process feeds alike.
+    Only the solo step loop requires an async device stage to dispatch
+    the augment on."""
+    if (getattr(args, "tau", 1) > 1
+            or getattr(args, "distributed", False)
+            or getattr(args, "elastic_alpha", 0.0) > 0):
+        return
     if getattr(args, "prefetch", 0) <= 0 and _feed_mode() != "process":
         raise SystemExit(
             "--augment device rides the async feed: pass --prefetch N "
             "or --feed process (the DeviceAugment dispatch belongs on "
             "the feed's device stage, not the step loop)")
-    if (getattr(args, "tau", 1) > 1
-            or getattr(args, "distributed", False)
-            or getattr(args, "elastic_alpha", 0.0) > 0):
-        raise SystemExit(
-            "--augment device is wired to the single-replica prefetch "
-            "path; the distributed trainer packs its own tau feeds "
-            "(use --augment host there)")
 
 
 def _auto_data(args, net) -> str:
@@ -611,6 +620,75 @@ def _data_fns(args, net, test_net=None):
                 scale=scale, mirror=mirror, crop_size=crop,
                 mean_value=mean_vals, mean_image=mean_img,
             ), pid, seed=getattr(args, "seed", None))
+
+        def _db_pipeline_factory(num_batches, start_index=0, workers=None):
+            """Process-feed twin of the threaded db cursor: a
+            RecordShardSource byte-offset index makes the DB epoch-
+            addressable (data/records.py), decode runs IN the ring
+            workers (the `decode` stage — the parallelizable host
+            work), and the wire is built in the internal layout
+            natively.  Host-transform arm composes a worker-side
+            TransformStage; the device arm ships raw uint8 and augments
+            post-placement in XLA."""
+            from sparknet_tpu.data.createdb import peek_db_shape
+            from sparknet_tpu.data.pipeline import (
+                ProcessPipeline,
+                TransformStage,
+            )
+            from sparknet_tpu.data.records import RecordShardSource
+            from sparknet_tpu.ops.layout import canonical_shape, is_nhwc
+
+            lay = "nhwc" if is_nhwc() else "nchw"
+            try:
+                src = RecordShardSource(
+                    train_path, batch, layout=lay,
+                    stride=nproc if shared else 1,
+                    offset=pid if shared else 0)
+            except (OSError, ValueError) as e:
+                raise SystemExit(
+                    f"--data db: {train_path}: {e}") from None
+            # DB records are canonical (C, H, W); compare against the
+            # canonical view of the net's (internal) blob.  With a crop
+            # declared, EITHER arm (worker TransformStage or device
+            # augment) crops records down to the net size — raw records
+            # just need matching channels and enough spatial extent.
+            got = tuple(peek_db_shape(train_path))
+            want = tuple(canonical_shape(data_shape)[1:])
+            if trainp["crop"]:
+                ok = (got[0] == want[0]
+                      and got[1] >= want[1] and got[2] >= want[2])
+            else:
+                ok = got == want
+            if not ok:
+                raise SystemExit(
+                    f"{train_path}: db images {got} do not match the "
+                    f"net's data blob {want}")
+            stage = None
+            if not device_aug:
+                from sparknet_tpu.data import TransformConfig
+
+                try:
+                    stage = TransformStage(TransformConfig(
+                        scale=trainp["scale"], mirror=trainp["mirror"],
+                        crop_size=trainp["crop"],
+                        mean_value=trainp["mean_vals"],
+                        mean_image=trainp["mean_img"],
+                        seed=1234 + pid + (getattr(args, "seed", 0) or 0),
+                    ), train=True, layout=lay)
+                except ValueError as e:
+                    raise SystemExit(f"transform_param: {e}") from None
+            return ProcessPipeline(
+                src, stage, num_batches=num_batches,
+                start_index=start_index, workers=workers,
+                name="feed.db")
+
+        from sparknet_tpu.data.records import probe_record_backend
+
+        if probe_record_backend(train_path) in ("record", "lmdb"):
+            # LevelDB keeps the threaded cursor: snappy blocks have no
+            # per-record byte offsets to index (RecordShardSource's
+            # refusal names convert_db as the migration)
+            train_fn.pipeline_factory = _db_pipeline_factory
         return (_internalize(train_fn),
                 _internalize(db_stream(test_path, train=False)))
 
@@ -716,10 +794,13 @@ def _process_feed(train_fn, num_batches, start_index, args, log,
     factory = getattr(train_fn, "pipeline_factory", None)
     if factory is None:
         raise SystemExit(
-            "--feed process is wired to the synthetic and cifar: sources "
-            "(index-addressable streams a worker process can re-produce "
-            "deterministically); db:/proto cursors are stateful — keep "
-            "--feed threaded there")
+            "--feed process needs an index-addressable source a worker "
+            "process can re-produce deterministically: synthetic, cifar:, "
+            "and db: record/LMDB files (RecordShardSource byte-offset "
+            "index, data/records.py) ride the ring; the remaining "
+            "stateful cursors (proto listfiles, LevelDB) keep --feed "
+            "threaded — convert LevelDB via data.createdb.convert_db to "
+            "join")
     stack = contextlib.ExitStack()
     pipe = stack.enter_context(factory(
         num_batches=num_batches, start_index=start_index,
@@ -733,10 +814,11 @@ def _process_feed(train_fn, num_batches, start_index, args, log,
         it = iter(pf)
         fn = lambda _it: next(it)  # noqa: E731 — the solver feed contract
     else:
-        # trainer feeds stay host-side; _stack_tau/_widen_batch consume
-        # via np.concatenate before the next call, inside the ring's
-        # view-lifetime window
-        fn = pipe.as_data_fn()
+        # trainer feeds stay host-side; _stack_tau/_widen_batch hold
+        # tau*workers batches before concatenating, which outlives the
+        # ring's view-lifetime window — they need stable copies (cheap:
+        # the wire is uint8 under --augment device)
+        fn = pipe.as_data_fn(copy=True)
     log(f"feed: process pipeline ({pipe.workers} worker(s), "
         f"{pipe.slots} slots x {pipe.spec.slot_bytes:,} B"
         f"{', device stage' if device_stage else ''})")
@@ -834,6 +916,15 @@ def cmd_train(args) -> int:
             trainer = ParallelTrainer(
                 solver, tau=args.tau, elastic_alpha=args.elastic_alpha
             )
+            # --augment device on the trainer path: the wire stays uint8
+            # all the way through _put_feeds; the augment runs post-
+            # placement, outside the jitted round program.  Capture the
+            # adapter BEFORE _process_feed swaps train_fn for the ring's
+            # attr-less as_data_fn.
+            aug_fn = getattr(train_fn, "trainer_device_fn", None)
+            if aug_fn is not None:
+                trainer.feed_device_fn = aug_fn
+                log("augment: device (post-placement, tau wire uint8)")
             outer = -(-iters // max(args.tau, 1))  # ceil: run >= requested
             feed_ctx = contextlib.nullcontext()
             if _feed_mode() == "process":

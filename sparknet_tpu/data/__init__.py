@@ -36,3 +36,4 @@ from sparknet_tpu.data.pipeline import (  # noqa: F401
     TransformStage,
     device_feed,
 )
+from sparknet_tpu.data.records import RecordShardSource  # noqa: F401

@@ -124,3 +124,43 @@ class DeviceAugment:
                                    jax.random.fold_in(base_key, it))}
 
         return fn
+
+    def trainer_device_fn(self, pid: int = 0, seed: int | None = None,
+                          key_name: str = "data"):
+        """The distributed-feed adapter: a ``fn(feeds, it)`` applied by
+        ``ParallelTrainer``/``ElasticTrainer`` AFTER their own feed
+        placement (``_put_feeds``/``_place_feeds``) and BEFORE the
+        jitted round program — the tau path's uint8-wire hook, kept
+        OUTSIDE the round program so every banked graph/mem manifest
+        stays byte-identical.
+
+        Key policy is the :meth:`device_fn` family unchanged — base key
+        ``1234 + pid + seed``, ``fold_in(base, it)`` per round — with
+        one extra fold for the leading axis: rank-5 feeds
+        ([tau, B, ...] tau rounds, or [n, B, ...] scanned rounds) vmap
+        the rank-4 augment with per-slot keys
+        ``fold_in(fold_in(base, it), t)``, so slot t of round ``it``
+        draws independently of every other slot and of any rank-4 run.
+        Both arities are jitted per shape (the augment compiles once per
+        feed geometry, off the round program)."""
+        import jax
+
+        base_key = jax.random.key(1234 + pid + (seed or 0))
+
+        @jax.jit
+        def aug4(x, key):
+            return self(x, key)
+
+        @jax.jit
+        def aug5(x, key):
+            keys = jax.vmap(lambda t: jax.random.fold_in(key, t))(
+                jnp.arange(x.shape[0]))
+            return jax.vmap(lambda xs, ks: self(xs, ks))(x, keys)
+
+        def fn(feeds, it):
+            x = feeds[key_name]
+            k = jax.random.fold_in(base_key, it)
+            out = aug5(x, k) if jnp.ndim(x) == 5 else aug4(x, k)
+            return {**feeds, key_name: out}
+
+        return fn

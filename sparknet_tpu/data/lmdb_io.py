@@ -171,6 +171,49 @@ class LmdbReader:
         else:
             raise ValueError(f"{self._path}: page {pgno} flags {flags:#x}")
 
+    def iter_locators(self):
+        """``(key, absolute_value_offset, value_size)`` per record, in
+        key order — the byte-offset shard index the process-ring record
+        source builds once at open (``data/records.py``): the bytes at
+        ``[offset, offset + size)`` of the data file are exactly the
+        value ``__iter__`` yields.  Inline values locate inside their
+        leaf page; ``F_BIGDATA`` values at their overflow run's payload
+        (one page header, then the value contiguous — the writer's
+        OVPAGES rule)."""
+        if self._root == P_INVALID:
+            return
+        yield from self._walk_locators(self._root)
+
+    def _walk_locators(self, pgno: int):
+        page = self._page(pgno)
+        _, _, flags, lower, _ = _PAGEHDR.unpack_from(page)
+        if flags & P_LEAF2:
+            raise NotImplementedError("LEAF2 (fixed-key) pages unsupported")
+        n = (lower - PAGEHDRSZ) // 2
+        ptrs = struct.unpack_from(f"<{n}H", page, PAGEHDRSZ)
+        base = pgno * PAGESIZE
+        if flags & P_LEAF:
+            for off in ptrs:
+                lo, hi, nflags, ksize = _NODEHDR.unpack_from(page, off)
+                if nflags & (F_SUBDATA | F_DUPDATA):
+                    raise NotImplementedError("DUPSORT nodes unsupported")
+                key = bytes(
+                    page[off + _NODEHDR.size : off + _NODEHDR.size + ksize])
+                dsize = lo | (hi << 16)
+                dstart = off + _NODEHDR.size + ksize
+                if nflags & F_BIGDATA:
+                    (ovf,) = struct.unpack_from("<Q", page, dstart)
+                    yield key, ovf * PAGESIZE + PAGEHDRSZ, dsize
+                else:
+                    yield key, base + dstart, dsize
+        elif flags & P_BRANCH:
+            for off in ptrs:
+                lo, hi, nflags, _ = _NODEHDR.unpack_from(page, off)
+                child = lo | (hi << 16) | (nflags << 32)
+                yield from self._walk_locators(child)
+        else:
+            raise ValueError(f"{self._path}: page {pgno} flags {flags:#x}")
+
     def _leaf_node(self, page: memoryview, off: int) -> tuple[bytes, bytes]:
         lo, hi, nflags, ksize = _NODEHDR.unpack_from(page, off)
         if nflags & (F_SUBDATA | F_DUPDATA):

@@ -29,7 +29,12 @@ def decode_jpeg(data: bytes, height: int, width: int,
 
     Decoders produce HWC: the nhwc wire order is the decoder's NATIVE
     output and skips the per-image transpose entirely — the host half of
-    the zero-transpose channels-last feed (``ops/layout.py`` contract)."""
+    the zero-transpose channels-last feed (``ops/layout.py`` contract).
+
+    Ring placement: :class:`~sparknet_tpu.data.records.RecordShardSource`
+    calls this INSIDE the pipeline worker that owns the batch, so JPEG
+    decode scales with ``Config.feed_workers`` (journaled as the feed's
+    ``decode`` stage) instead of serializing in the consumer."""
     from PIL import Image  # outside the guard: a missing dep must fail loud
 
     try:

@@ -38,10 +38,13 @@ arXiv:1605.08695 §4.2 input pipeline overlapped with compute):
   worker that dies without a word (OOM-kill, segfault) is caught by
   exitcode polling instead of hanging the feed.
 * **Per-stage obsnet telemetry** (``obs/schema.py`` event ``feed``):
-  slot-wait, source, transform, write and put walls are aggregated and
-  journaled when ``SPARKNET_OBS`` is armed, so a feed stall is
-  attributable to its stage.  All host-side work — spans carry
-  ``host`` semantics, no fence needed.
+  slot-wait, source, decode, transform, write and put walls are
+  aggregated and journaled when ``SPARKNET_OBS`` is armed, so a feed
+  stall is attributable to its stage.  All host-side work — spans carry
+  ``host`` semantics, no fence needed.  Sources that decode records
+  in-worker (``data/records.py``) report that wall separately through
+  ``consume_decode_s`` — the ``decode`` stage is the part of the feed
+  that scales with ``Config.feed_workers``.
 * **A double-buffered ``device_put`` stage** (:func:`device_feed`)
   keeps host→HBM transfer overlapping the previous step's compute, and
   releases ring slots only after the transfer that read them completed.
@@ -88,11 +91,14 @@ __all__ = [
 
 # the journal stage vocabulary (docs/OBSERVABILITY.md "Feed stages"):
 # slot_wait  consumer blocked waiting for the next in-order full slot
-# source     worker: raw batch production (reader / decode / synthesis)
+# source     worker: raw batch production minus decode (read / synthesis)
+# decode     worker: record/JPEG decode inside source.get (sources that
+#            decode report the wall via ``consume_decode_s``; zero for
+#            decode-free sources) — host semantics, scales with workers
 # transform  worker: host DataTransformer (crop/mirror/mean/scale)
 # write      worker: memcpy of the finished batch into its ring slot
 # put        device stage: host->device transfer (device_feed only)
-FEED_STAGES = ("slot_wait", "source", "transform", "write", "put")
+FEED_STAGES = ("slot_wait", "source", "decode", "transform", "write", "put")
 
 
 def feed_workers(cap: int = 4) -> int:
@@ -208,10 +214,20 @@ class BatchSource:
 
 class DataFnSource(BatchSource):
     """Wraps an INDEX-ADDRESSABLE ``data_fn(it) -> feeds`` (the solver
-    feed contract) as a source.  Only correct for fns whose output is a
-    pure function of ``it`` — the CLI marks those with
-    ``fn.indexable = True``; stateful cursors (db streams) are not, and
-    the process feed refuses them upstream."""
+    feed contract) as a source.
+
+    The ``fn.indexable`` contract: a data fn is *indexable* iff calling
+    it with the same ``it`` always returns the same feeds — no hidden
+    cursor, no consumed iterator, no sequential RandomState — so any
+    worker process can (re)produce batch ``it`` without having produced
+    ``0..it-1`` first.  That is the property the whole ring rests on:
+    deterministic ``g % workers == w`` shard assignment AND a respawned
+    worker resuming a dead worker's shard bit-identically.  The CLI
+    marks compliant fns with ``fn.indexable = True``; stateful cursors
+    that cannot be made index-pure stay on the threaded feed (or
+    migrate through :class:`~sparknet_tpu.data.records.
+    RecordShardSource`, which converts a record DB's cursor into an
+    index by byte offset)."""
 
     def __init__(self, fn: Callable[[int], dict[str, np.ndarray]],
                  batches_per_epoch: int = 0):
@@ -392,7 +408,9 @@ def _worker_loop(wid: int, nworkers: int, source: BatchSource,
                        start_index + num_batches, nworkers):
             epoch, index = divmod(g, bpe) if bpe else (0, g)
             t0 = time.perf_counter()
+            dec0 = getattr(source, "consume_decode_s", 0.0)
             raw = source.get(epoch, index)
+            dec_s = getattr(source, "consume_decode_s", 0.0) - dec0
             t1 = time.perf_counter()
             batch = transform(raw) if transform is not None else raw
             t2 = time.perf_counter()
@@ -410,7 +428,8 @@ def _worker_loop(wid: int, nworkers: int, source: BatchSource,
                 np.copyto(view[name], batch[name], casting="no")
             t3 = time.perf_counter()
             full_q.put(("batch", wid, g, slot,
-                        (t1 - t0, t2 - t1, t3 - t2)))
+                        (max(t1 - t0 - dec_s, 0.0), dec_s,
+                         t2 - t1, t3 - t2)))
         full_q.put(("done", wid, 0, 0, ()))
     except BaseException:
         try:
@@ -451,14 +470,15 @@ class _StageClock:
         self.workers = workers
         self.images = images_per_batch
         self.every = max(int(every), 1)
-        self.stages = {s: 0.0 for s in FEED_STAGES[:4]}
+        self.stages = {s: 0.0 for s in FEED_STAGES[:5]}
         self.totals = totals if totals is not None else {}
         self.batches = 0
         self._t0 = time.perf_counter()
 
-    def add(self, slot_wait: float, source: float, transform: float,
-            write: float) -> None:
+    def add(self, slot_wait: float, source: float, decode: float,
+            transform: float, write: float) -> None:
         for key, val in (("slot_wait", slot_wait), ("source", source),
+                         ("decode", decode),
                          ("transform", transform), ("write", write)):
             self.stages[key] += val
             self.totals[key] = self.totals.get(key, 0.0) + val
@@ -480,7 +500,7 @@ class _StageClock:
             if wall > 0 else 0.0,
             workers=self.workers,
         )
-        self.stages = {s: 0.0 for s in FEED_STAGES[:4]}
+        self.stages = {s: 0.0 for s in FEED_STAGES[:5]}
         self.batches = 0
         self._t0 = time.perf_counter()
 
@@ -646,8 +666,9 @@ class ProcessPipeline:
                             wid, f"feed worker {wid} raised:\n{extra}")
                     # "done" needs no handling: the loop bound already
                     # knows how many batches are owed
-                slot, (src_s, tr_s, wr_s) = pending.pop(g)
-                clock.add(time.perf_counter() - t0, src_s, tr_s, wr_s)
+                slot, (src_s, dec_s, tr_s, wr_s) = pending.pop(g)
+                clock.add(time.perf_counter() - t0, src_s, dec_s, tr_s,
+                          wr_s)
                 held.append(slot)
                 while len(held) > self.hold:
                     self._release(held.pop(0))

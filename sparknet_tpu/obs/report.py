@@ -86,7 +86,8 @@ def _span_rows(spans: list[dict]) -> list[str]:
 def _feed_rows(feeds: list[dict]) -> list[str]:
     """Per-stage feed telemetry (host-side walls — no fence applies;
     the table's value is ATTRIBUTION: which stage ate the wall)."""
-    stage_names = ["slot_wait", "source", "transform", "write", "put"]
+    stage_names = ["slot_wait", "source", "decode", "transform", "write",
+                   "put"]
     lines = [
         "| feed | batches | images | wall s | img/s | "
         + " | ".join(f"{s} s" for s in stage_names) + " |",

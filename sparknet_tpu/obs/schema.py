@@ -140,7 +140,9 @@ EVENTS: dict[str, tuple[dict, dict]] = {
     # per-stage host-feed telemetry (data/pipeline.py): one aggregated
     # record per reporting window, ``stages`` mapping a stage name from
     # the docs/OBSERVABILITY.md "Feed stages" vocabulary (slot_wait /
-    # source / transform / write / put) to its summed wall seconds.
+    # source / decode / transform / write / put) to its summed wall
+    # seconds — ``decode`` is the in-worker record/JPEG decode split out
+    # of ``source`` so ring scaling is attributable per stage.
     # Entirely HOST-side work — feed walls carry span ``host`` semantics
     # (no fence stamp exists or is needed), and a feed stall in the
     # journal is attributable to exactly one stage.

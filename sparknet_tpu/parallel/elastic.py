@@ -271,6 +271,13 @@ class ElasticTrainer:
         self.iter = 0  # solver iterations (advances by tau per round)
         self.round = 0  # averaging rounds completed
         self.cursor = 0  # global shard ids consumed
+        # Optional post-placement feed hook (``fn(feeds, it) -> feeds``,
+        # DeviceAugment.trainer_device_fn): runs after _place_feeds and
+        # before the width-W round program — the uint8-wire augment on
+        # the elastic path, outside every banked elastic_w* twin.  A
+        # width change changes the feed geometry, so the hook's jitted
+        # augment compiles once per width (like the round program).
+        self.feed_device_fn = None
         self._average = jax.jit(
             lambda v: jax.tree_util.tree_map(lambda x: x.mean(0), v))
 
@@ -499,6 +506,8 @@ class ElasticTrainer:
         W = self.width
         feeds_np = self._round_feeds(data_fn, W)
         feeds = self._place_feeds(feeds_np, self.mesh)
+        if self.feed_device_fn is not None:
+            feeds = self.feed_device_fn(feeds, self.iter)
         weights = jax.device_put(
             jnp.asarray(self._round_weights),
             NamedSharding(self.mesh, P(self._axis)))

@@ -90,6 +90,13 @@ class ParallelTrainer:
         # driver loop should build batches for)
         self.num_local_workers = max(self.num_workers // self._mesh_procs, 1)
         self.iter = 0
+        # Optional post-placement feed hook (``fn(feeds, it) -> feeds``,
+        # e.g. DeviceAugment.trainer_device_fn): applied AFTER _put_feeds
+        # and BEFORE the jitted round program, so the uint8 wire's
+        # device-resident augment runs on-device without touching the
+        # round program itself (banked graph/mem manifests stay
+        # byte-identical whether or not the hook is armed).
+        self.feed_device_fn = None
         self._step_fn = solver._make_train_step(debug=False)
         self._rules = rules or ShardingRules()
         self._pshard = param_shardings(
@@ -369,6 +376,8 @@ class ParallelTrainer:
         raw = data_fn(self.iter)
         if self._elastic:
             feeds = self._put_feeds(raw, with_tau_axis=True)
+            if self.feed_device_fn is not None:
+                feeds = self.feed_device_fn(feeds, self.iter)
             self.variables, self.slots, self.center, loss = self._train(
                 self.variables, self.slots, self.center, self.iter, feeds,
                 self.solver._key,
@@ -376,6 +385,8 @@ class ParallelTrainer:
             self.iter += self.tau
         elif self.tau == 1:
             feeds = self._put_feeds(raw, with_tau_axis=False)
+            if self.feed_device_fn is not None:
+                feeds = self.feed_device_fn(feeds, self.iter)
             with self._sp_context():
                 self.variables, self.slots, loss = self._train(
                     self.variables, self.slots, self.iter, feeds,
@@ -384,6 +395,8 @@ class ParallelTrainer:
             self.iter += 1
         else:
             feeds = self._put_feeds(raw, with_tau_axis=True)
+            if self.feed_device_fn is not None:
+                feeds = self.feed_device_fn(feeds, self.iter)
             self.variables, self.slots, loss = self._train(
                 self.variables, self.slots, self.iter, feeds, self.solver._key
             )
@@ -505,6 +518,10 @@ class ParallelTrainer:
         # 'data' and leaves the round axis unsharded — exactly the scan
         # xs layout
         feeds = self._put_feeds(stacked, with_tau_axis=True)
+        if self.feed_device_fn is not None:
+            # the rank-5 arm of the hook: [n, B, ...] scanned rounds
+            # take per-slot keys exactly like a [tau, B, ...] round
+            feeds = self.feed_device_fn(feeds, self.iter)
         with self._sp_context():
             self.variables, self.slots, losses = self._round_scan_fns[n](
                 self.variables, self.slots, self.iter, feeds,

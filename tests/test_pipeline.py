@@ -400,8 +400,8 @@ def test_feed_events_are_schema_valid(tmp_path):
     feed_events = list(schema.iter_events(journal, "feed"))
     assert feed_events, "no feed telemetry journaled"
     for ev in feed_events:
-        assert set(ev["stages"]) <= {"slot_wait", "source", "transform",
-                                     "write", "put"}
+        assert set(ev["stages"]) <= {"slot_wait", "source", "decode",
+                                     "transform", "write", "put"}
         assert ev["batches"] > 0 and ev["images"] > 0
 
 

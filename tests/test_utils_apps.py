@@ -387,9 +387,14 @@ def test_cli_device_augment_guards(cifar_dir, tmp_path, monkeypatch):
     with pytest.raises(SystemExit, match="cifar"):
         main(base + ["--data", "synthetic", "--prefetch", "2",
                      "--augment", "device"])
-    with pytest.raises(SystemExit, match="distributed"):
-        main(base + ["--data", f"cifar:{cifar_dir}", "--prefetch", "2",
-                     "--augment", "device", "--tau", "2"])
+    # the trainer path needs NO async-feed precondition: the augment
+    # runs post-placement via ParallelTrainer.feed_device_fn, so
+    # --augment device --tau trains end-to-end (uint8 tau wire)
+    rc = main(base + ["--data", f"cifar:{cifar_dir}", "--augment",
+                      "device", "--tau", "2", "--output",
+                      str(tmp_path / "aug_tau")])
+    assert rc == 0
+    assert (tmp_path / "aug_tau.solverstate.npz").exists()
 
 
 def test_cli_time_lenet(capsys):

@@ -17,10 +17,15 @@ shapes (b256 uint8 227x227), PURE ingest (prestaged batches, the
 workers' only per-batch work is the slot memcpy — the ring transport
 itself), sustained over >= 64 batches, vs the banked r5 headline
 12,290 img/s (docs/BENCHMARKS.md).  A threaded twin (same work on the
-legacy daemon-thread feed) and a decode+transform attribution arm print
-alongside; ``--bank`` routes the gate record through
-``common.bank_guard`` to docs/feed_bench_last.json.  Honors
-SPARKNET_BENCH_REQUIRE_MEASURED (rc 4 if armed and nothing measured).
+legacy daemon-thread feed), the in-worker host-transform attribution
+arm, and the DEVICE arm (raw uint8 ring, no worker transform — the
+augment runs post-placement in XLA; the gate pins its in-worker
+transform share <= 15% of the e2e wall vs the banked 81% host-arm
+wall) print alongside; ``--sweep-workers 1,2,4`` adds per-worker-count
+ingest + e2e rows (the multi-core scaling claim as one command);
+``--bank`` routes the gate record through ``common.bank_guard`` to
+docs/feed_bench_last.json.  Honors SPARKNET_BENCH_REQUIRE_MEASURED
+(rc 4 if armed and nothing measured).
 
 Timing-contract note (graftlint audit): every timed loop here is
 HOST-side — numpy/PIL transforms, the prefetcher's queue, and the
@@ -28,6 +33,10 @@ pipeline's shared-memory ring — so repeating identical args really does
 the work each call and no value fence is needed; nothing in this module
 dispatches to a device inside a timing window (the stale-args-dispatch
 rule is scoped to jax-importing modules for exactly this distinction).
+The device arm keeps that contract: its timed loop is the uint8 ring
+alone, and the XLA augment is rehearsed ONCE outside any timing window
+on a forced-CPU backend (zero chip time; jax is reached only through
+``sparknet_tpu.*`` imports).
 """
 
 from __future__ import annotations
@@ -275,6 +284,98 @@ def bench_pipeline_transform(batch: int, batches: int,
     }
 
 
+def bench_pipeline_device(batch: int, batches: int,
+                          workers: int | None = None,
+                          rehearse: bool = False,
+                          platform: str = "") -> dict:
+    """The device-arm e2e twin of :func:`bench_pipeline_transform`: the
+    SAME synthetic 256px wire, but the ring ships raw uint8 with NO
+    worker transform stage — crop/mirror/mean run post-placement in XLA
+    (``data/device_transform.py``), so the host's per-image work
+    collapses to decode + slot memcpy and the wire carries ~4x fewer
+    bytes than f32 crops.  The timed loop is the ring alone (host-side,
+    honest); with ``rehearse=True`` one delivered batch is copied out
+    BEFORE the timing window and pushed through ``DeviceAugment`` on a
+    forced-CPU backend afterwards — shape/dtype proof that the uint8
+    wire feeds the augment, zero chip time."""
+    from sparknet_tpu.data.pipeline import (
+        ProcessPipeline,
+        SyntheticImageSource,
+    )
+
+    src = SyntheticImageSource(batch, (3, 256, 256), seed=3,
+                               layout="nhwc")
+    sample = None
+    with ProcessPipeline(src, None, num_batches=batches + 1,
+                         workers=workers, name="feed.e2e_device") as pipe:
+        it = pipe.batches()
+        first = next(it)  # warm + the rehearsal copy, outside the timing
+        if rehearse:
+            sample = {k: np.array(v, copy=True) for k, v in first.items()}
+        _consume(first)
+        t0 = time.perf_counter()
+        for feeds in it:
+            _consume(feeds)
+        dt = time.perf_counter() - t0
+        stats = dict(pipe.stats)
+        nworkers = pipe.workers
+    n = max(int(stats.get("batches", 1)), 1)
+    row = {
+        "metric": "feed_pipeline_e2e_device_img_s",
+        "value": round(batch * batches / dt, 1),
+        "unit": f"img/s (b{batch} 256px synth raw uint8 wire, augment "
+                "deferred to XLA post-placement)",
+        "workers": nworkers,
+        "stages_ms_per_batch": {
+            k: round(v / n * 1e3, 3) for k, v in stats.items()
+            if k != "batches"},
+    }
+    if rehearse and sample is not None:
+        row["device_rehearsal"] = _rehearse_device_augment(sample, platform)
+    return row
+
+
+def _rehearse_device_augment(sample: dict, platform: str = "") -> dict:
+    """One forced-CPU DeviceAugment pass over a copied wire batch —
+    proves the raw uint8 ring output is exactly what the XLA augment
+    consumes (HWC uint8 in, f32 crops out), without any device work
+    inside a timing window and without dialing the site-pinned relay."""
+    from sparknet_tpu.common import force_platform
+    from sparknet_tpu.data.device_transform import DeviceAugment
+    from sparknet_tpu.data.transform import TransformConfig
+
+    if not platform:
+        # zero-chip by contract: the site hook pins "axon,cpu" and the
+        # env var alone does not override it — force the config route
+        force_platform("cpu")
+    rs = np.random.RandomState(1)
+    mean = rs.rand(3, 256, 256).astype(np.float32) * 255
+    aug = DeviceAugment(
+        TransformConfig(mean_image=mean, crop_size=227, mirror=True),
+        layout="nhwc")
+    out = np.asarray(aug.device_fn(pid=0)(sample, 0)["data"])
+    assert out.shape == (sample["data"].shape[0], 227, 227, 3), out.shape
+    assert out.dtype == np.float32, out.dtype
+    u8 = sum(int(np.asarray(v).nbytes) for v in sample.values())
+    f32 = (int(out.nbytes)
+           + int(np.asarray(sample["label"]).nbytes))
+    return {
+        "in": list(sample["data"].shape) + ["|u1"],
+        "out": list(out.shape) + ["<f4"],
+        "wire_bytes_u8": u8,
+        "f32_crop_bytes": f32,
+        # full-size u8 wire vs the f32 crops the host arm would ship
+        "wire_ratio_u8_vs_f32": round(f32 / max(u8, 1), 3),
+    }
+
+
+def _transform_share(row: dict, batch: int) -> float:
+    """In-worker transform wall as a fraction of the arm's e2e wall
+    (ms/batch from img/s — the acceptance gate's 15% denominator)."""
+    wall_ms = batch / max(row["value"], 1e-9) * 1e3
+    return row["stages_ms_per_batch"].get("transform", 0.0) / wall_ms
+
+
 def host_roofline(batch: int) -> dict:
     """The box's physical ingest ceiling: one straight memcpy of the
     wire batch into a preallocated buffer — no ring, no queues, no
@@ -327,9 +428,36 @@ def run_pipeline_arms(args) -> int:
     e2e = bench_pipeline_transform(args.batch, max(batches // 8, 4),
                                    workers=args.workers or None)
     print(json.dumps(e2e))
+    e2e_dev = bench_pipeline_device(args.batch, max(batches // 8, 4),
+                                    workers=args.workers or None,
+                                    rehearse=True,
+                                    platform=getattr(args, "platform", ""))
+    print(json.dumps(e2e_dev))
+    sweep = []
+    for w in sorted({int(s) for s in
+                     (args.sweep_workers or "").split(",") if s.strip()}):
+        sb = max(batches // 4, 16)
+        ing_w = bench_pipeline_ingest(args.batch, sb, workers=w)
+        host_w = bench_pipeline_transform(args.batch, max(sb // 4, 4),
+                                          workers=w)
+        dev_w = bench_pipeline_device(args.batch, max(sb // 4, 4),
+                                      workers=w)
+        row = {
+            "metric": "feed_workers_sweep_row",
+            "workers": w,
+            "ingest_img_s": ing_w["value"],
+            "e2e_host_img_s": host_w["value"],
+            "e2e_device_img_s": dev_w["value"],
+            "e2e_host_stages_ms_per_batch": host_w["stages_ms_per_batch"],
+            "e2e_device_stages_ms_per_batch": dev_w["stages_ms_per_batch"],
+        }
+        sweep.append(row)
+        print(json.dumps(row))
     roof = host_roofline(args.batch)
 
     met = ingest["value"] >= HEADLINE_IMG_S
+    host_share = _transform_share(e2e, args.batch)
+    dev_share = _transform_share(e2e_dev, args.batch)
     record = {
         "metric": "feed_pipeline_gate",
         "value": ingest["value"],
@@ -346,6 +474,15 @@ def run_pipeline_arms(args) -> int:
         "workers": ingest["workers"],
         "stages_ms_per_batch": ingest["stages_ms_per_batch"],
         "e2e_stages_ms_per_batch": e2e["stages_ms_per_batch"],
+        # the device arm: raw uint8 ring, augment deferred to XLA — the
+        # acceptance gate pins its in-worker transform share <= 15%
+        "e2e_device_img_s": e2e_dev["value"],
+        "e2e_device_stages_ms_per_batch": e2e_dev["stages_ms_per_batch"],
+        "host_transform_share": round(host_share, 4),
+        "device_transform_share": round(dev_share, 4),
+        "device_arm_met": dev_share <= 0.15,
+        "device_rehearsal": e2e_dev.get("device_rehearsal"),
+        **({"workers_sweep": sweep} if sweep else {}),
         **roof,
         # host-side measurement: real walls on this box, no chip involved
         "measured": True,
@@ -373,10 +510,23 @@ def run_pipeline_arms(args) -> int:
             f"{record['process_vs_threaded']} is scheduling noise "
             f"around transport parity; ingest wall is the slot memcpy "
             f"itself (per-stage ms {ingest['stages_ms_per_batch']}, "
-            f"bare-memcpy bound {bound:,.0f} img/s); the parallel win "
-            f"needs cores > 1 where the e2e transform stage "
+            f"bare-memcpy bound {bound:,.0f} img/s); the host-arm "
+            f"transform "
             f"({e2e['stages_ms_per_batch'].get('transform', 0):.0f} "
-            f"ms/batch) leaves the consumer's GIL")
+            f"ms/batch, {host_share:.0%} of its e2e wall) is the "
+            f"serialized stage the DEVICE arm removes entirely "
+            f"({dev_share:.0%} in-worker transform share — the augment "
+            f"is chip work), and the remaining in-worker decode is the "
+            f"stage --sweep-workers scales on a cores > 1 host")
+    elif roof["cores"] > 1:
+        record["attribution"] = (
+            f"{roof['cores']} cores: in-worker decode+transform "
+            f"parallelize across ring workers (workers_sweep rows bank "
+            f"the per-count scaling); the device arm drops the host "
+            f"transform share from {host_share:.0%} to {dev_share:.0%} "
+            f"of the e2e wall and ships ~4x fewer wire bytes (uint8 vs "
+            f"f32 crops) — what remains on the host is decode + slot "
+            f"memcpy only")
     print(json.dumps(record))
     if args.bank:
         from sparknet_tpu.common import bank_guard
@@ -398,6 +548,11 @@ def main() -> int:
                     "threaded twin, per-stage attribution")
     ap.add_argument("--workers", type=int, default=0,
                     help="pipeline worker processes (0 = auto)")
+    ap.add_argument("--sweep-workers", default="",
+                    help="comma-separated worker counts (e.g. 1,2,4): "
+                    "adds per-count ingest + e2e host/device rows to "
+                    "the --pipeline gate record (the multi-core scaling "
+                    "claim as one banked command)")
     ap.add_argument("--bank", action="store_true",
                     help="bank the --pipeline gate record to "
                     f"{LAST_PATH} via common.bank_guard")

@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""On-chip feed-starvation gate: train through the process ring and
+require obsnet ``slot_wait`` ~ 0.
+
+The r7 queue's zero-chip ``feed_e2e_device_arm`` setup job proves the
+ring's host-side throughput; this job closes the loop ON the chip: a
+short real train (``--feed process --augment device``, record source
+via ``data/records.py``) with ``SPARKNET_OBS`` armed, then the journal's
+feed events are summed and the consumer-side ``slot_wait`` share of the
+feed wall must stay under ``--gate-share`` (default 5%).  slot_wait is
+the time ``ProcessPipeline.batches()`` sat blocked for the next in-order
+slot — the one stage that directly translates into training-step
+starvation, so "~ 0" here means the uint8 ring kept ahead of the chip.
+
+Queue-runner contract (CLAUDE.md): ``SPARKNET_BENCH_REQUIRE_MEASURED=1``
+exits rc 4 when an accelerator was expected but the backend fell back to
+CPU (window death, uncounted), and a CPU run (``--platform cpu``) is
+labeled host-side and must never be read as chip evidence.  Exit 1 =
+gate failed on a real measurement (slot_wait share over budget).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _sum_feed_events(journal_path: str, ring: str) -> dict:
+    """Aggregate the ring's feed events: total wall, per-stage walls."""
+    wall = 0.0
+    batches = 0
+    images = 0
+    stages: dict[str, float] = {}
+    with open(journal_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            ev = json.loads(line)
+            if ev.get("event") != "feed" or ev.get("name") != ring:
+                continue
+            wall += float(ev.get("wall_s", 0.0))
+            batches += int(ev.get("batches", 0))
+            images += int(ev.get("images", 0))
+            for k, v in (ev.get("stages") or {}).items():
+                stages[k] = stages.get(k, 0.0) + float(v)
+    return {"wall_s": wall, "batches": batches, "images": images,
+            "stages": stages}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--solver", default="zoo:cifar10_quick")
+    ap.add_argument("--data", default="db:/tmp/e2e_tpu/cifar_lmdb",
+                    help="record/LMDB source (tools/setup_e2e_db.py "
+                    "materializes the default fixture host-side)")
+    ap.add_argument("--batch", type=int, default=100)
+    ap.add_argument("--iterations", type=int, default=40)
+    ap.add_argument("--augment", default="device",
+                    help="device = uint8 wire + in-graph transform "
+                    "(the tentpole arm); host = f32 wire control")
+    ap.add_argument("--gate-share", type=float, default=0.05,
+                    help="max slot_wait fraction of the feed wall")
+    ap.add_argument("--obs-out", default="",
+                    help="journal path (default: <evidence>/"
+                    "feed_train_slotwait.jsonl next to cwd)")
+    ap.add_argument("--platform", default="",
+                    help="force a jax platform (cpu = host-side "
+                    "rehearsal, never chip evidence)")
+    args = ap.parse_args()
+
+    if args.platform:
+        from sparknet_tpu.common import force_platform
+
+        force_platform(args.platform)
+    import jax
+
+    platform = jax.devices()[0].platform
+    on_accel = platform != "cpu"
+    want_accel = args.platform != "cpu"
+    if (os.environ.get("SPARKNET_BENCH_REQUIRE_MEASURED") == "1"
+            and want_accel and not on_accel):
+        print(json.dumps({"metric": "feed_train_slotwait", "skipped":
+                          f"accelerator required, got {platform}"}))
+        return 4
+
+    obs_path = os.path.abspath(
+        args.obs_out or "feed_train_slotwait.jsonl")
+    if os.path.exists(obs_path):
+        os.unlink(obs_path)  # a stale journal would double-count stages
+    os.environ["SPARKNET_OBS"] = obs_path
+
+    from sparknet_tpu import cli
+
+    argv = []
+    if args.platform:
+        argv += ["--platform", args.platform]
+    argv += ["train", "--solver", args.solver, "--data", args.data,
+             "--batch", str(args.batch),
+             "--iterations", str(args.iterations),
+             "--feed", "process", "--augment", args.augment,
+             "--output", os.path.join(
+                 os.path.dirname(obs_path) or ".", "slotwait_model")]
+    rc = cli.main(argv)
+    if rc:
+        print(json.dumps({"metric": "feed_train_slotwait",
+                          "train_rc": rc, "measured": False}))
+        return rc
+
+    ring = "feed.db"  # _db_pipeline_factory's ProcessPipeline name
+    agg = _sum_feed_events(obs_path, ring)
+    if not agg["batches"]:
+        print(json.dumps({"metric": "feed_train_slotwait",
+                          "error": f"no '{ring}' feed events in "
+                          f"{obs_path} — was the process feed active?",
+                          "measured": False}))
+        return 1
+    slot_wait = agg["stages"].get("slot_wait", 0.0)
+    share = slot_wait / agg["wall_s"] if agg["wall_s"] > 0 else 0.0
+    record = {
+        "metric": "feed_train_slotwait_share",
+        "value": round(share, 6),
+        "unit": "fraction",
+        "gate_share": args.gate_share,
+        "gate_met": share <= args.gate_share,
+        "slot_wait_s": round(slot_wait, 6),
+        "feed_wall_s": round(agg["wall_s"], 6),
+        "batches": agg["batches"],
+        "images": agg["images"],
+        "stages_s": {k: round(v, 6) for k, v in
+                     sorted(agg["stages"].items())},
+        "augment": args.augment,
+        "journal": obs_path,
+        "platform": platform,
+        "measured": True,
+        "host_side": not on_accel,
+        "chip_measured": on_accel,
+    }
+    print(json.dumps(record))
+    return 0 if record["gate_met"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
