@@ -928,6 +928,85 @@ def check_loop_manifest_fresh(ctx: ModuleContext) -> Iterator[tuple[int, str]]:
 
 
 # ---------------------------------------------------------------------------
+# replica-manifest-fresh
+# ---------------------------------------------------------------------------
+
+# The replica router (serve/router.py) is the pod-scale layer over the
+# engine: K single-device copies whose zero-collective placement is its
+# OWN contract claim, pinned by the width-parameterized serve_r* twins
+# (like the elastic trainer's elastic_w* widths).  serve-manifest-fresh
+# already checks that router.py is folded into the SOURCES fingerprints
+# (it sits on the serve/ surface); what it cannot see is whether the
+# replica-width twins were ever banked — one width would only re-prove
+# the single-copy serve_b* case.  Anchored on router.py alone so the
+# pool-coverage finding lands once, not once per serve/ file.
+_REPLICA_SOURCE = "sparknet_tpu/serve/router.py"
+_REPLICA_MIN_WIDTHS = 2
+_REPLICA_REGEN = _ELASTIC_REGEN
+
+
+def _replica_source_rel(path: str) -> tuple[str, str] | None:
+    norm = os.path.abspath(path).replace(os.sep, "/")
+    idx = norm.rfind("/sparknet_tpu/")
+    if idx < 0:
+        return None
+    root, rel = norm[:idx], norm[idx + 1:]
+    if rel == _REPLICA_SOURCE:
+        return root, rel
+    return None
+
+
+@rule(
+    "replica-manifest-fresh",
+    "the replica router (serve/router.py) must be folded into the "
+    "graph+mem SOURCES fingerprints with serve_r* twin manifests "
+    "banked at >= 2 pool widths in both families",
+)
+def check_replica_manifest_fresh(ctx: ModuleContext) -> Iterator[tuple[int, str]]:
+    """The serve_r* twins pin the pod placement contract — K replicas'
+    forwards lower with ZERO collectives between them (serving is
+    embarrassingly parallel; any cross-replica comm is a placement
+    bug).  One banked width would only re-prove the single-copy case,
+    so each manifest family must carry >= ``_REPLICA_MIN_WIDTHS``
+    widths, and the banked SOURCES.json must record router.py at all.
+    Blind spot (deliberate): hash staleness is NOT re-checked here —
+    that belongs to graph-/mem-manifest-fresh on the serve/ surface.
+    """
+    hit = _replica_source_rel(ctx.path)
+    if hit is None:
+        return
+    root, rel = hit
+    for fam, regen in _REPLICA_REGEN.items():
+        cdir = os.path.join(root, "docs", fam)
+        src = os.path.join(cdir, "SOURCES.json")
+        if not os.path.exists(src):
+            yield (1, f"{rel} is pod-serving contract source but no "
+                      f"manifests are banked (docs/{fam}/SOURCES.json "
+                      f"missing) — {regen}")
+            continue
+        try:
+            with open(src, encoding="utf-8") as f:
+                recorded = json.load(f)
+        except (OSError, ValueError):
+            yield (1, f"docs/{fam}/SOURCES.json unreadable — {regen}")
+            continue
+        if rel not in recorded:
+            yield (1, f"{rel} is not folded into the docs/{fam} SOURCES "
+                      f"fingerprint — the banked manifests predate the "
+                      f"replica layer; {regen}")
+        try:
+            twins = [n for n in os.listdir(cdir)
+                     if n.startswith("serve_r") and n.endswith(".json")]
+        except OSError:
+            twins = []
+        if len(twins) < _REPLICA_MIN_WIDTHS:
+            yield (1, f"docs/{fam} banks {len(twins)} serve_r* twin "
+                      f"manifest(s); the width-parameterized pool "
+                      f"contract needs >= {_REPLICA_MIN_WIDTHS} "
+                      f"widths — {regen}")
+
+
+# ---------------------------------------------------------------------------
 # queue-job-hygiene
 # ---------------------------------------------------------------------------
 

@@ -875,11 +875,15 @@ def cmd_train(args) -> int:
             solver, args.weights, strict_shapes=False, require_match=False
         )
         print(json.dumps({"finetune_from": args.weights, "layers_loaded": loaded}))
-    # Default "." mirrors the reference (logs land where you run), but
-    # ad-hoc runs from the repo root kept littering checkouts with
-    # tpunet_train_<ts>.txt (gitignored since PR 6; six deleted across
-    # two PRs) — SPARKNET_TRAIN_LOG_DIR reroutes the whole class.
-    log = EventLogger(os.environ.get("SPARKNET_TRAIN_LOG_DIR", "."),
+    # The reference logs where you run, but ad-hoc runs from the repo
+    # root kept littering checkouts with tpunet_train_<ts>.txt (eight
+    # deleted across three PRs) — default under the system tempdir;
+    # SPARKNET_TRAIN_LOG_DIR reroutes explicitly.
+    import tempfile
+
+    default_log_dir = os.path.join(tempfile.gettempdir(), "tpunet_logs")
+    log = EventLogger(os.environ.get("SPARKNET_TRAIN_LOG_DIR",
+                                     default_log_dir),
                       prefix="tpunet_train")
     train_fn, test_fn = _data_fns(args, solver.train_net,
                                   test_net=solver.test_net)
@@ -1802,13 +1806,30 @@ def cmd_serve(args) -> int:
     recompile sentinel must read ZERO post-warmup compiles or the run
     exits 1.
 
+    With ``--replicas K`` (K > 1) the run goes through the
+    ``ReplicaRouter`` pod instead: K ServedModel copies, projected-wait
+    routing, deadline shedding, open-loop arrivals — zero post-warmup
+    compiles AND zero dropped tickets or exit 1 (docs/SERVING.md
+    "Replication & elasticity").
+
     ref: apps/FeaturizerApp.scala:1 (the reference's batch scoring app;
     dynamic request batching is new TPU-first surface)."""
     import json as _json
 
-    from sparknet_tpu.serve.loadgen import load_run
+    from sparknet_tpu.serve.loadgen import load_run, pod_run
 
     buckets = tuple(int(b) for b in args.buckets.split(","))
+    if args.replicas > 1:
+        summary = pod_run(
+            replicas=args.replicas, family=args.family, arm=args.arm,
+            buckets=buckets, max_wait_ms=args.max_wait_ms,
+            rate=args.rate, seconds=args.seconds,
+            log=lambda m: print(f"serve: {m}", file=sys.stderr))
+        print(_json.dumps(
+            {k: v for k, v in summary.items() if k != "per_replica"}))
+        ok = (summary["compiles_post_warmup"] == 0
+              and summary["dropped"] == 0)
+        return 0 if ok else 1
     summary = load_run(
         requests=args.requests, family=args.family, arm=args.arm,
         buckets=buckets, max_wait_ms=args.max_wait_ms,
@@ -2180,6 +2201,13 @@ def main(argv=None) -> int:
                     help="comma-separated AOT bucket ladder")
     sp.add_argument("--max-wait-ms", type=float, default=5.0,
                     help="deadline bound on any request's queue wait")
+    sp.add_argument("--replicas", type=int, default=1,
+                    help="K > 1 serves through the replica pod "
+                         "(ReplicaRouter, open-loop arrivals)")
+    sp.add_argument("--rate", type=float, default=2000.0,
+                    help="pod mode: offered open-loop req/s")
+    sp.add_argument("--seconds", type=float, default=1.0,
+                    help="pod mode: open-loop run length")
     sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser(

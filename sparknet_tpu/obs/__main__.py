@@ -22,7 +22,13 @@
   checkpoint -> hot swap into the live engine -> over-HBM refusal ->
   bitwise rollback, with traffic in flight; exit 1 unless every gate
   holds (zero serving-path compiles, zero dropped tickets, scores
-  change then restore).  Render with ``report``.
+  change then restore).  ``--replica`` drives the pod-serving fault
+  plan (dryrun mode 20): a K-replica pool under open-loop Poisson load
+  takes a deterministic kill with a known backlog (stolen tickets
+  re-routed, zero dropped), holds queue p99 inside max_wait + one pump
+  tick on a steady no-fault leg, survives live join/kill/swap with
+  zero serving-path compiles, and pins continuous-batching exactness;
+  exit 1 unless every gate holds.  Render with ``report``.
 """
 
 from __future__ import annotations
@@ -125,6 +131,16 @@ def dryrun_main(argv: list[str]) -> int:
         "pass — still zero chip time")
     ap.add_argument("--iterations", type=int, default=1,
                     help="train->rollout cycles for --loop (default 1)")
+    ap.add_argument(
+        "--replica", action="store_true",
+        help="run the pod-serving fault plan INSTEAD of the training "
+        "legs (serve/router.py): K replicas under open-loop Poisson "
+        "load with a kill/join/swap plan firing mid-stream, zero-drop "
+        "ticket re-route, deadline-aware shedding, and the "
+        "continuous-batching exactness gate; exit 1 unless all gates "
+        "pass — still zero chip time")
+    ap.add_argument("--replicas", type=int, default=4,
+                    help="pool width for --replica (default 4)")
     args = ap.parse_args(argv)
 
     # pin the CPU platform via the config route (the env var alone does
@@ -143,6 +159,30 @@ def dryrun_main(argv: list[str]) -> int:
     from sparknet_tpu.obs.recorder import Recorder, set_recorder
 
     rec = set_recorder(Recorder(args.out))
+
+    if args.replica:
+        from sparknet_tpu.serve.dryrun import replica_run
+
+        summary = replica_run(
+            replicas=args.replicas,
+            log=lambda m: print(f"obs dryrun [replica]: {m}",
+                                file=sys.stderr))
+        rec.close()
+        set_recorder(None)
+        print(
+            f"obs dryrun [replica]: {summary['replicas_start']} -> "
+            f"{summary['replicas_end']} replica(s) through faults "
+            f"{summary['faults_fired']}, {summary['requests']} "
+            f"request(s) ({summary['dropped']} dropped, "
+            f"{summary['shed']} shed, {summary['rerouted']} "
+            f"re-routed), queue p99 {summary['queue_p99_ms']:.1f} ms "
+            f"(bound {summary['queue_bound_ms']:.0f} ms), "
+            f"{summary['serve_path_compiles']} serving-path "
+            f"compile(s), continuous exact: "
+            f"{summary['continuous_exact']}")
+        print(f"obs dryrun: journal at {args.out} — render with "
+              f"`python -m sparknet_tpu.obs report {args.out}`")
+        return 0 if summary["ok"] else 1
 
     if args.loop:
         from sparknet_tpu.loop.dryrun import loop_run

@@ -200,6 +200,53 @@ def _serve_lines(serves: list[dict]) -> list[str]:
     return lines
 
 
+def _replica_lines(replicas: list[dict]) -> list[str]:
+    """Pod membership and lifecycle: joins, kills (with the re-routed
+    ticket ledger — the zero-drop proof), per-replica rollouts, and the
+    aggregate load-run summary (serve/router.py)."""
+    lines = []
+    for ev in replicas:
+        kind = ev.get("kind", "?")
+        rep = ev.get("replica")
+        who = f"replica {rep}" if rep is not None else "pool"
+        if kind == "replica_up":
+            note = ev.get("note")
+            how = f" ({note})" if note else ""
+            lines.append(
+                f"- **UP** {who}: joined the pool at width "
+                f"{ev.get('width', '?')}{how}")
+        elif kind == "replica_down":
+            lines.append(
+                f"- **DOWN** {who}: {ev.get('rerouted', 0)} in-flight "
+                f"ticket(s) re-routed to survivors (outstanding "
+                f"{ev.get('outstanding', 0)}, dropped "
+                f"{ev.get('dropped', 0)}), pool width now "
+                f"{ev.get('width', '?')}")
+        elif kind == "resize":
+            lines.append(
+                f"- resize {ev.get('from_width', '?')} -> "
+                f"{ev.get('to_width', '?')}: serving mesh re-cut, "
+                "replicas re-placed")
+        elif kind == "rollout":
+            lines.append(
+                f"- rollout {who} -> version {ev.get('version', '?')}: "
+                f"hot swap under load, incumbent drained "
+                f"{ev.get('drained', 0)} ticket(s)")
+        elif kind == "summary":
+            lines.append(
+                f"- summary: width {ev.get('width', '?')}, "
+                f"{ev.get('requests', 0)} request(s) at "
+                f"{ev.get('rps', 0):g} req/s aggregate, "
+                f"{ev.get('shed', 0)} shed, "
+                f"{ev.get('dropped', 0)} dropped, "
+                f"{ev.get('rerouted', 0)} re-routed")
+        else:
+            note = ev.get("note")
+            detail = f" — {note}" if note else ""
+            lines.append(f"- {kind} {who}{detail}")
+    return lines
+
+
 def _loop_lines(loops: list[dict]) -> list[str]:
     """Production-loop transitions: checkpoints, rollouts, rollbacks,
     refusals — the train-to-serve narrative over the serve lifecycle."""
@@ -343,7 +390,8 @@ def render(events: list[dict], source: str = "journal") -> str:
             by_run[run_id] = {"start": [], "round": [], "span": [],
                               "member": [], "feed": [], "recompile": [],
                               "bench": [], "bank": [], "end": [],
-                              "serve": [], "loop": [], "request": []}
+                              "serve": [], "loop": [], "request": [],
+                              "replica": []}
         kind = ev.get("event")
         key = {"run_start": "start", "run_end": "end",
                "worker_lost": "member", "worker_joined": "member",
@@ -378,6 +426,9 @@ def render(events: list[dict], source: str = "journal") -> str:
         if group["loop"]:
             lines += ["", "### production loop (train-to-serve)", ""]
             lines += _loop_lines(group["loop"])
+        if group["replica"]:
+            lines += ["", "### replica pool (pod-scale serving)", ""]
+            lines += _replica_lines(group["replica"])
         if group["request"]:
             lines += ["", "### request latency (p50/p99 per model × "
                           "bucket)", ""]

@@ -176,6 +176,11 @@ EVENTS: dict[str, tuple[dict, dict]] = {
     # sparknet_tpu/loop: ``version`` is the swap generation, ``drained``
     # the retiring model's in-flight requests served by its OWN
     # executables during the swap — the zero-dropped-tickets ledger)
+    # ``shed`` events are THROTTLED: the engine aggregates rejected
+    # tickets and emits one line per reporting interval with ``shed``
+    # the count since the last line and ``projected_wait_ms`` the EWMA
+    # queue-wait projection that tripped the gate — one line per
+    # rejected ticket under saturation would swamp the journal.
     "serve": (
         {"run_id": str, "kind": str},
         {"model": str, "family": str, "arm": str, "buckets": list,
@@ -183,7 +188,29 @@ EVENTS: dict[str, tuple[dict, dict]] = {
          "budget_bytes": int, "requests": int, "batches": int,
          "padded": int, "compiles": int, "p50_ms": _NUM, "p99_ms": _NUM,
          "rps": _NUM, "wall_s": _NUM, "version": int, "drained": int,
-         "note": str},
+         "shed": int, "projected_wait_ms": _NUM, "tick_ms": _NUM,
+         "replicas": int, "dropped": int, "note": str},
+    ),
+    # -- replica router (sparknet_tpu/serve/router.py) ------------------
+    # one pod-scale membership/lifecycle event, discriminated by
+    # ``kind``: replica_up (a ServedModel copy joined the pool — fresh
+    # boot or elastic join copying the live weights) / replica_down
+    # (killed or drained; ``rerouted`` counts the in-flight tickets
+    # stolen from its batcher and adopted by a survivor — the
+    # zero-dropped-tickets ledger at pod scope) / resize (the serving
+    # mesh re-cut via sized_data_mesh, mirroring elastic's mesh_resize)
+    # / rollout (per-replica hot-swap under load, PR 10's candidate
+    # protocol) / summary (an aggregate load-run roll-up: ``rps`` is
+    # pod throughput, ``shed`` the deadline-shed total, ``dropped``
+    # MUST be 0).
+    "replica": (
+        {"run_id": str, "kind": str},
+        {"replica": int, "model": str, "family": str, "arm": str,
+         "width": int, "from_width": int, "to_width": int,
+         "rerouted": int, "outstanding": int, "version": int,
+         "drained": int, "requests": int, "shed": int, "dropped": int,
+         "predicted_bytes": int, "resident_bytes": int, "rps": _NUM,
+         "p50_ms": _NUM, "p99_ms": _NUM, "wall_s": _NUM, "note": str},
     ),
     # -- production loop (sparknet_tpu/loop) ----------------------------
     # one train-to-serve loop lifecycle event, discriminated by

@@ -525,6 +525,61 @@ def _mode_serve(devices, bucket: int) -> TraceTarget:
     )
 
 
+SERVE_REPLICA_WIDTHS = (1, 2, 4)
+
+
+def _mode_serve_replica(devices, width: int) -> TraceTarget:
+    """Width-parameterized pod-serving twin (ISSUE 13): K replica
+    copies of the transformer steady-state bucket forward (b64) as ONE
+    data-sharded program over ``sized_data_mesh(width)`` — params
+    REPLICATED (every replica serves the same weights, serve/router.py
+    copies them on join), feeds sharded along the batch axis (each
+    replica's bucket rides its own mesh device).  Serving is
+    embarrassingly parallel: the comm contract is ZERO collectives at
+    every width (a collective here would mean a replica's forward
+    depends on another's traffic — the lowering bug the twins exist to
+    catch).  The alt-args lowering pins shape-stable tracing exactly
+    like the serve_b* twins."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sparknet_tpu.common import Phase
+    from sparknet_tpu.compiler.graph import Network
+    from sparknet_tpu.parallel.mesh import sized_data_mesh
+    from sparknet_tpu.serve.engine import (
+        _end_layer, _family, _forward_fn, _score_blob,
+        _synthetic_feeds, exec_batch)
+
+    if width > len(devices):
+        raise RuntimeError(
+            f"serve_r{width} needs {width} devices, got {len(devices)}")
+    mesh = sized_data_mesh(width, devices=devices)
+    family = _family("transformer")
+    batch = width * exec_batch(64)
+    network = Network(family.net(batch), Phase.TEST)
+    variables = jax.device_put(network.init(jax.random.key(0)),
+                               NamedSharding(mesh, P()))
+    blob = _score_blob(network)
+    fn = jax.jit(_forward_fn(network, blob, _end_layer(network, blob)))
+
+    def _place(seed: int):
+        sharding = NamedSharding(mesh, P("data"))
+        return {k: jax.device_put(jnp.asarray(v), sharding)
+                for k, v in _synthetic_feeds(family, batch,
+                                             seed).items()}
+
+    return TraceTarget(
+        name=f"serve_r{width}", fn=fn,
+        args=(variables, _place(0)),
+        alt_args=(variables, _place(1)),
+        meta={"family": "transformer", "mesh": {"data": width},
+              "tau": 1, "batch": batch, "dtype": "f32",
+              "layout": "nchw", "serve": True, "serve_bucket": 64,
+              "replicas": width},
+        param_bytes=_tree_bytes(variables.params),
+        state_bytes=_tree_bytes(variables.state),
+    )
+
+
 MODES: dict[str, Callable] = {
     "solo": _mode_solo,
     "solo_nhwc": _mode_solo_nhwc,
@@ -556,6 +611,13 @@ from sparknet_tpu.serve.engine import SERVE_BUCKETS  # noqa: E402
 MODES.update({
     f"serve_b{b}": partial(_mode_serve, bucket=b)
     for b in SERVE_BUCKETS
+})
+
+# replica-width pod-serving twins (ISSUE 13): the K-copy steady-state
+# forward per banked width — zero collectives at every width
+MODES.update({
+    f"serve_r{w}": partial(_mode_serve_replica, width=w)
+    for w in SERVE_REPLICA_WIDTHS
 })
 
 

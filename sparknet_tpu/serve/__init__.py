@@ -32,10 +32,20 @@ Deploy arms ride the existing paths unchanged: ``f32`` (plain TEST
 forward), ``fold_bn`` (models/fold_bn.py), ``int8`` (quant.py PTQ,
 folded first per the DeployNet ordering contract).
 
+Pod scale (ROADMAP item 2): ``router.py``'s :class:`ReplicaRouter`
+sprays tickets across K single-device engine copies
+(least-outstanding-work), with elastic membership (kill/join between
+flushes, zero-drop steal/adopt re-route), deadline-aware shedding
+(``DynamicBatcher.shed``), and per-replica hot swap;
+``continuous.py``'s :class:`ContinuousDecoder` batches the charlm
+family at SLOT granularity per decode step over one fixed-shape AOT
+arena program.
+
 See docs/SERVING.md for the architecture and latency vocabulary.
 """
 
 from sparknet_tpu.serve.batcher import DynamicBatcher, Ticket
+from sparknet_tpu.serve.continuous import ContinuousDecoder
 from sparknet_tpu.serve.engine import (
     AdmissionRefused,
     ServeEngine,
@@ -47,11 +57,15 @@ from sparknet_tpu.serve.residency import (
     load_fit_table,
     price_residency,
 )
+from sparknet_tpu.serve.router import Replica, ReplicaRouter
 
 __all__ = [
     "AdmissionPolicy",
     "AdmissionRefused",
+    "ContinuousDecoder",
     "DynamicBatcher",
+    "Replica",
+    "ReplicaRouter",
     "ServeEngine",
     "ServedModel",
     "Ticket",

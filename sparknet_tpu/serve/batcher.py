@@ -14,6 +14,25 @@ The batching policy, stated once (docs/SERVING.md "Bucket policy"):
   not in half-full buckets.
 * ``close(drain=True)`` hands every in-flight request to the caller as
   final batches: shutdown loses zero requests (tests/test_serve.py).
+* ``shed()`` is the overload valve (docs/SERVING.md "Shedding rule"):
+  the batcher keeps a drain-rate EWMA from its own take() history and
+  REJECTS a submit whose projected queue wait (pending / drained rows
+  per second, plus one take period for the flush cut) already exceeds
+  ``max_wait_ms`` + one pump tick — p99 stays bounded by construction
+  instead of growing with the backlog.  Below one largest-bucket
+  quantum nothing is ever shed (one pump visit clears it), and before
+  the first drain sample exists pending is capped at two quanta — a
+  saturating cold-start burst can't park a deep backlog while the
+  estimator is still blind.
+* ``submit_many()`` admits a whole arrival chunk under one lock with
+  one timestamp (the pod-rate path, serve/router.py), applying the
+  same shed rule vectorized: earlier arrivals admitted first, the
+  over-deadline tail rejected.
+* ``steal()`` / ``adopt()`` move pending tickets between batchers
+  WITHOUT resolving or re-stamping them — the router's zero-drop
+  re-route when a replica dies (serve/router.py): the SAME Ticket
+  objects keep their original ``t_submit``, so re-routed requests pay
+  their true queue wait in the latency ledger.
 
 Deliberately jax-free: payloads are opaque to the batcher (the engine
 owns device work), the clock is injectable (``clock=``) so the deadline
@@ -35,11 +54,23 @@ __all__ = ["DynamicBatcher", "Ticket"]
 
 
 class Ticket:
-    """One in-flight request: submit-side handle + result rendezvous."""
+    """One in-flight request: submit-side handle + result rendezvous.
+
+    The rendezvous Event is created LAZILY on the first ``wait`` — at
+    pod-scale offered rates the ~2.5 us threading.Event construction
+    per submit is measurable against the ~85 us/row serving budget,
+    and pump-loop consumers (the bench, the router) poll ``done()``
+    without ever blocking on the event.
+    """
 
     __slots__ = ("id", "payload", "t_submit", "t_batch", "t_done",
                  "bucket", "batch_n", "deadline_flush", "result",
-                 "error", "_done")
+                 "error", "_done", "_done_flag")
+
+    # guards lazy event creation against a concurrent resolve; class
+    # level (one lock for all tickets) keeps the per-ticket footprint
+    # at a plain bool, and the critical section is a few loads
+    _lock = threading.Lock()
 
     def __init__(self, rid: int, payload, t_submit: float):
         self.id = rid
@@ -52,19 +83,37 @@ class Ticket:
         self.deadline_flush = False
         self.result = None
         self.error: BaseException | None = None
-        self._done = threading.Event()
+        self._done: threading.Event | None = None
+        self._done_flag = False
 
     def done(self) -> bool:
-        return self._done.is_set()
+        return self._done_flag
 
     def resolve(self, result=None, error: BaseException | None = None):
+        # lock-free on the pump's hot path: the flag store happens
+        # AFTER result/error land and BEFORE the event read, so a
+        # waiter either sees the flag in wait() / _event(), or created
+        # the event early enough for the read below to observe it —
+        # both orders signal exactly once (the lock lives in _event,
+        # guarding create-once only)
         self.result = result
         self.error = error
-        self._done.set()
+        self._done_flag = True
+        ev = self._done
+        if ev is not None:
+            ev.set()
+
+    def _event(self) -> threading.Event:
+        with Ticket._lock:
+            if self._done is None:
+                self._done = threading.Event()
+                if self._done_flag:
+                    self._done.set()
+            return self._done
 
     def wait(self, timeout: float | None = None):
         """Block for the result (raises the execution error, if any)."""
-        if not self._done.wait(timeout):
+        if not self._done_flag and not self._event().wait(timeout):
             raise TimeoutError(
                 f"request {self.id} still pending after {timeout}s")
         if self.error is not None:
@@ -93,6 +142,28 @@ class DynamicBatcher:
         self._ids = itertools.count()
         self._cv = threading.Condition()
         self.closed = False
+        # drain-rate EWMA (rows/s), sampled over >= _WIN_S windows of
+        # take() history during which a backlog persisted throughout.
+        # Windowing matters: a pod pump drains one replica in a burst
+        # of back-to-back takes and then sweeps the OTHER replicas, so
+        # per-take intervals measure the burst's instantaneous rate —
+        # several times this queue's real share of pump bandwidth —
+        # while a window spanning whole sweeps measures the sustained
+        # rate the projection needs.  Smoothing is asymmetric (fast
+        # down, slow up): the estimate chases slowdowns and distrusts
+        # speedups, so the shed projection errs toward over-predicting
+        # waits — the conservative side of the deadline bound.
+        self._ewma_rate: float | None = None
+        self._ewma_take_ms = 0.0
+        self._win_t0: float | None = None
+        self._win_rows = 0
+        self._win_takes = 0
+        self.shed_count = 0
+        self.last_projected_ms = 0.0
+
+    _WIN_S = 0.05  # min sampling window (s): spans several pod sweeps
+    _ALPHA_DOWN = 0.5  # sample below the estimate: adopt quickly
+    _ALPHA_UP = 0.2    # sample above the estimate: adopt reluctantly
 
     # -- submit side -------------------------------------------------------
 
@@ -106,9 +177,135 @@ class DynamicBatcher:
             self._cv.notify_all()
             return t
 
+    def _projected_wait_ms_locked(self) -> float:
+        if self._ewma_rate is None or self._ewma_rate <= 0.0:
+            return 0.0
+        return (len(self._q) / self._ewma_rate * 1e3
+                + self._ewma_take_ms)
+
+    def projected_wait_ms(self) -> float:
+        """Projected queue wait for a request submitted NOW: pending
+        rows over the drain-rate EWMA, PLUS one take period — the
+        queue must drain to this request AND its own flush must be cut,
+        which costs up to one more pump visit (the conservative tail
+        choice; using the mean would halve it).  0.0 until the first
+        drain sample exists (no evidence of overload yet)."""
+        with self._cv:
+            return self._projected_wait_ms_locked()
+
+    def projected_wait_snapshot(self) -> float:
+        """Lock-free :meth:`projected_wait_ms` for the router's pick
+        loop: a stale read mis-ranks one chunk by one position, it
+        never corrupts state — same contract as the depth snapshot."""
+        rate = self._ewma_rate
+        if rate is None or rate <= 0.0:
+            return 0.0
+        return len(self._q) / rate * 1e3 + self._ewma_take_ms
+
+    def shed(self, payload, tick_ms: float = 0.0) -> Ticket | None:
+        """Deadline-aware admission: enqueue like :meth:`submit`, or
+        return None WITHOUT enqueueing when the projected queue wait
+        already exceeds ``max_wait_ms + tick_ms`` (one pump tick of
+        grace — a flush decision is at most one tick away).  A shed
+        request never enters the queue, so the p99 of ADMITTED
+        requests stays inside the deadline bound under any offered
+        rate.  The caller journals the rejection (throttled
+        ``serve/shed`` lines — serve/engine.py)."""
+        with self._cv:
+            if self.closed:
+                raise RuntimeError("batcher is closed")
+            projected = self._projected_wait_ms_locked()
+            if ((projected > self.max_wait_ms + float(tick_ms)
+                 and len(self._q) >= self.buckets[-1])
+                    or (self._ewma_rate is None
+                        and len(self._q) >= 2 * self.buckets[-1])):
+                # two guard rails around the projection: (a) the
+                # largest-bucket floor — below one take's quantum the
+                # queue drains in a single pump visit no matter what
+                # the (possibly stale-low) EWMA claims, so admission
+                # never chokes itself into an evidence drought; (b)
+                # the cold-start cap — with NO rate evidence yet,
+                # pending is held to two take quanta (two pump visits'
+                # worth) instead of unbounded, so a saturating arrival
+                # burst can't park a deep backlog before the first
+                # window sample teaches the projection otherwise
+                self.shed_count += 1
+                self.last_projected_ms = projected
+                return None
+            t = Ticket(next(self._ids), payload, self.clock())
+            self._q.append(t)
+            self._cv.notify_all()
+            return t
+
+    def submit_many(self, payloads: list, shed: bool = False,
+                    tick_ms: float = 0.0) -> tuple[list[Ticket], int]:
+        """Chunked admission: one lock, one timestamp, the whole
+        arrival chunk — the pod-rate submit path (serve/router.py
+        ``submit_many``), where per-request locking is measurable
+        against the serving budget.  Returns ``(tickets, shed_n)``.
+
+        With ``shed=True`` the chunk passes the same drain-rate rule as
+        :meth:`shed`, vectorized: the queue admits arrivals IN ORDER up
+        to the pending depth whose projected wait reaches
+        ``max_wait_ms + tick_ms`` and rejects the tail (earlier
+        arrivals win — FIFO fairness survives chunking).  No rate
+        evidence yet admits everything, exactly like :meth:`shed`."""
+        with self._cv:
+            if self.closed:
+                raise RuntimeError("batcher is closed")
+            k = len(payloads)
+            if shed:
+                if self._ewma_rate is not None and self._ewma_rate > 0.0:
+                    # admit up to the pending depth whose projection
+                    # hits the bound (drain term + one take period),
+                    # floored at one largest-bucket quantum (the same
+                    # guard rails as :meth:`shed`)
+                    bound_s = max(0.0, self.max_wait_ms + float(tick_ms)
+                                  - self._ewma_take_ms) / 1e3
+                    cap = max(int(self._ewma_rate * bound_s),
+                              self.buckets[-1])
+                else:
+                    cap = 2 * self.buckets[-1]  # cold-start cap
+                k = min(k, max(0, cap - len(self._q)))
+            now = self.clock()
+            tickets = [Ticket(next(self._ids), p, now)
+                       for p in payloads[:k]]
+            if tickets:
+                self._q.extend(tickets)
+                self._cv.notify_all()
+            n_shed = len(payloads) - k
+            if n_shed:
+                self.shed_count += n_shed
+                self.last_projected_ms = self._projected_wait_ms_locked()
+            return tickets, n_shed
+
     def pending(self) -> int:
         with self._cv:
             return len(self._q)
+
+    # -- re-route side (serve/router.py) -----------------------------------
+
+    def steal(self) -> list[Ticket]:
+        """Remove and return every pending ticket WITHOUT stamping or
+        resolving it — the dying replica's queue, headed for a
+        survivor's :meth:`adopt`.  Distinct from :meth:`drain` (which
+        stamps batch geometry for immediate execution)."""
+        with self._cv:
+            stolen, self._q = self._q, []
+            return stolen
+
+    def adopt(self, tickets: list[Ticket]) -> int:
+        """Enqueue stolen tickets, merged by original submit time so
+        FIFO deadline accounting survives the re-route.  The SAME
+        Ticket objects resolve — nobody re-submits, nothing drops."""
+        with self._cv:
+            if self.closed:
+                raise RuntimeError("batcher is closed")
+            if tickets:
+                self._q.extend(tickets)
+                self._q.sort(key=lambda t: t.t_submit)
+                self._cv.notify_all()
+            return len(tickets)
 
     # -- pump side ---------------------------------------------------------
 
@@ -140,6 +337,44 @@ class DynamicBatcher:
                 return None
             n = min(len(self._q), self.buckets[-1])
             batch, self._q = self._q[:n], self._q[n:]
+            # drain-rate sampling: a window OPENS at a take that leaves
+            # backlog behind (the queue is provably drain-limited from
+            # here), accumulates the rows of subsequent takes, and
+            # CLOSES into a rate sample once >= _WIN_S has elapsed —
+            # long enough to span whole pod sweeps.  Any take that
+            # empties the queue invalidates the window: the gap after
+            # it would measure idle time, not drain capability.
+            if not self._q:
+                self._win_t0 = None
+            elif self._win_t0 is None:
+                self._win_t0 = now
+                self._win_rows = 0
+                self._win_takes = 0
+            else:
+                self._win_rows += len(batch)
+                self._win_takes += 1
+                dt = now - self._win_t0
+                if dt >= self._WIN_S:
+                    rate = self._win_rows / dt
+                    if self._ewma_rate is None:
+                        self._ewma_rate = rate
+                    else:
+                        a = (self._ALPHA_DOWN if rate < self._ewma_rate
+                             else self._ALPHA_UP)
+                        self._ewma_rate = (a * rate
+                                           + (1.0 - a) * self._ewma_rate)
+                    # take period (ms): how long a cut flush waits for
+                    # the pump to come around again — the projection's
+                    # additive term.  Same asymmetry, mirrored: a
+                    # LONGER period is the slowdown side.
+                    period = dt / self._win_takes * 1e3
+                    a = (self._ALPHA_DOWN if period > self._ewma_take_ms
+                         else self._ALPHA_UP)
+                    self._ewma_take_ms = (a * period
+                                          + (1.0 - a) * self._ewma_take_ms)
+                    self._win_t0 = now
+                    self._win_rows = 0
+                    self._win_takes = 0
             deadline = len(batch) < self.buckets[-1]
             bucket = self.bucket_for(len(batch))
             for t in batch:

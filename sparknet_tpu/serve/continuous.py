@@ -1,0 +1,210 @@
+"""Continuous batching: slot-level admission per decode step.
+
+Request-level batching (serve/engine.py) is right for one-shot scoring,
+but autoregressive decoding holds a batch slot for MANY steps — batching
+whole requests would make every short generation wait for the longest
+one in its batch (head-of-line blocking at generation granularity).
+Continuous batching admits at the SLOT level instead: the decoder owns a
+fixed-shape [slots, seq_len] int32 arena, runs ONE AOT-compiled forward
+per decode step, and between steps retires finished slots and admits
+waiting requests into the freed rows — the TF-serving lineage's batching
+refinement (PAPERS.md 1605.08695), shape-stable so the recompile
+sentinel stays at zero.
+
+Exactness: the arena forward is an eval-mode per-row computation — row
+``s`` attends only within its own sequence (causal mask) and sees
+nothing of other rows, so a request decoded interleaved with arbitrary
+neighbors produces the SAME greedy continuation as decoded alone
+(tests/test_serve_replica.py pins it bitwise; CPU compiles pin
+single-thread Eigen like the engine's EXACT gate).  The window follows
+``models/generate.py``: right-padded ids, logits read at the last real
+position — causal masking leaves that read independent of padding.
+
+ref: apps/FeaturizerApp.scala:1 (the reference's batch scoring — RDD
+granularity; slot-level decode admission is new TPU-first surface).
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import time
+
+import jax
+import numpy as np
+
+from sparknet_tpu.serve.batcher import Ticket
+from sparknet_tpu.serve.engine import _exactness_compiler_options
+
+__all__ = ["ContinuousDecoder"]
+
+
+class _Slot:
+    __slots__ = ("ticket", "ids", "n_prompt", "remaining")
+
+    def __init__(self, ticket: Ticket, ids: list[int], remaining: int):
+        self.ticket = ticket
+        self.ids = ids
+        self.n_prompt = len(ids)
+        self.remaining = remaining
+
+
+class ContinuousDecoder:
+    """Greedy decode over a fixed [slots, seq_len] arena, one AOT
+    program, slot-level admission between steps.
+
+    ``variables`` injects trained charlm weights (the serve-side use);
+    default is the seed init (the contract-gate use — exactness and
+    admission mechanics don't care whether the weights are trained).
+    """
+
+    def __init__(self, slots: int = 8, seq_len: int = 32,
+                 vocab: int = 64, embed_dim: int = 32, heads: int = 4,
+                 ffn_dim: int = 64, blocks: int = 1, seed: int = 0,
+                 variables=None, device=None):
+        from sparknet_tpu.common import Phase
+        from sparknet_tpu.compiler.graph import Network
+        from sparknet_tpu.models.zoo import charlm
+
+        if slots < 2:
+            # mirrors the engine's EXEC_FLOOR: a batch-1 program lowers
+            # to a different reduction order than the batched arena
+            raise ValueError(f"need >= 2 slots, got {slots}")
+        self.slots = int(slots)
+        self.seq_len = int(seq_len)
+        self.vocab = int(vocab)
+        self.device = device
+        net = charlm(batch=self.slots, seq_len=self.seq_len,
+                     vocab=self.vocab, embed_dim=embed_dim,
+                     heads=heads, ffn_dim=ffn_dim, blocks=blocks)
+        self.network = Network(net, Phase.TEST)
+        self.variables = (self.network.init(jax.random.key(seed))
+                          if variables is None else variables)
+        if device is not None:
+            self.variables = jax.device_put(self.variables, device)
+
+        def forward(vs, feeds):
+            blobs, _, _ = self.network.apply(
+                vs, feeds, rng=None, train=False, end="fc")
+            return blobs["fc"]
+
+        sharding = (jax.sharding.SingleDeviceSharding(device)
+                    if device is not None else None)
+        example = {
+            "data": jax.ShapeDtypeStruct(
+                (self.slots, self.seq_len), np.int32,
+                sharding=sharding),
+            "label": jax.ShapeDtypeStruct(
+                (self.slots, self.seq_len), np.int32,
+                sharding=sharding),
+        }
+        t0 = time.perf_counter()
+        self.executable = jax.jit(forward).lower(
+            self.variables, example).compile(
+                compiler_options=_exactness_compiler_options())
+        self.compile_wall_s = time.perf_counter() - t0
+
+        self._ids = itertools.count()
+        self._waiting: collections.deque[_Slot] = collections.deque()
+        self._active: dict[int, _Slot] = {}  # slot index -> state
+        self._free = list(range(self.slots - 1, -1, -1))
+        self.steps = 0
+        self.admitted = 0
+        self.completed = 0
+        self.decode_path_compiles = 0
+
+    # -- submit side -------------------------------------------------------
+
+    def submit(self, prompt_ids, max_new: int) -> Ticket:
+        """Queue one generation request; its Ticket resolves with the
+        greedy continuation (an int list of length ``max_new``).  The
+        request enters the arena at the next step with a free slot —
+        never displacing an in-flight generation."""
+        prompt = [int(i) for i in prompt_ids]
+        if not prompt:
+            raise ValueError("prompt must be non-empty")
+        if any(not 0 <= i < self.vocab for i in prompt):
+            raise ValueError(f"prompt ids outside [0, {self.vocab})")
+        if max_new <= 0:
+            raise ValueError(f"max_new must be positive, got {max_new}")
+        ticket = Ticket(next(self._ids), prompt, time.monotonic())
+        self._waiting.append(_Slot(ticket, prompt, int(max_new)))
+        return ticket
+
+    def pending(self) -> int:
+        return len(self._waiting)
+
+    def active(self) -> int:
+        return len(self._active)
+
+    # -- decode loop -------------------------------------------------------
+
+    def _admit(self) -> int:
+        """Slot-level admission: fill freed rows from the waiting queue
+        (FIFO) — the continuous-batching move, between steps only."""
+        n = 0
+        while self._free and self._waiting:
+            slot = self._free.pop()
+            self._active[slot] = self._waiting.popleft()
+            n += 1
+        self.admitted += n
+        return n
+
+    def step(self) -> int:
+        """One decode step: admit into free slots, ONE arena forward,
+        append a greedy token per active slot, retire finished slots.
+        Returns tokens produced (0 = arena idle)."""
+        from sparknet_tpu.obs.sentinel import get_sentinel
+
+        self._admit()
+        if not self._active:
+            return 0
+        data = np.zeros((self.slots, self.seq_len), np.int32)
+        last = {}
+        for s, st in self._active.items():
+            window = st.ids[-self.seq_len:]
+            data[s, :len(window)] = window  # right-pad: causal-safe
+            last[s] = len(window) - 1
+        label = np.zeros((self.slots, self.seq_len), np.int32)
+        sentinel = get_sentinel()
+        compiles0 = sentinel.thread_count()
+        logits = np.asarray(self.executable(
+            self.variables, {"data": data, "label": label}))
+        self.decode_path_compiles += (
+            sentinel.thread_count() - compiles0)
+        self.steps += 1
+        produced = 0
+        for s in list(self._active):
+            st = self._active[s]
+            nxt = int(np.argmax(logits[s, last[s]]))
+            st.ids.append(nxt)
+            st.remaining -= 1
+            produced += 1
+            if st.remaining == 0:
+                st.ticket.resolve(result=st.ids[st.n_prompt:])
+                del self._active[s]
+                self._free.append(s)
+                self.completed += 1
+        return produced
+
+    def run(self, max_steps: int = 10_000) -> int:
+        """Step until every queued request completes; returns tokens
+        produced.  ``max_steps`` is a runaway bound, not a policy."""
+        produced = 0
+        for _ in range(max_steps):
+            n = self.step()
+            if n == 0 and not self._waiting:
+                return produced
+            produced += n
+        raise RuntimeError(
+            f"decode did not drain within {max_steps} steps "
+            f"({len(self._waiting)} waiting, {len(self._active)} "
+            "active)")
+
+    def stats(self) -> dict:
+        return {
+            "slots": self.slots, "seq_len": self.seq_len,
+            "steps": self.steps, "admitted": self.admitted,
+            "completed": self.completed,
+            "decode_path_compiles": self.decode_path_compiles,
+        }

@@ -1,0 +1,219 @@
+"""The chip-free pod-serving drive: kill, join, swap under Poisson load.
+
+One deterministic CPU-mesh run shared by its three consumers — ``python
+-m sparknet_tpu.obs dryrun --replica``, graft-entry dryrun mode 20, and
+tests/test_serve_replica.py — exercising the full elastic-serving story
+against a live K-replica pool:
+
+1. deterministic kill with a KNOWN backlog: tickets submitted without a
+   pump, one replica killed — its pending tickets are stolen and
+   adopted by a survivor, ``rerouted`` is pinned > 0 and every one of
+   them resolves (zero dropped),
+2. a STEADY open-loop Poisson leg (``loadgen.open_loop_schedule`` —
+   arrivals never wait for completions) with membership fixed: the
+   queue p99 of admitted requests must sit inside ``max_wait_ms`` +
+   one pump tick (the shed rule's whole point).  Faults are kept out
+   of this leg deliberately — join/rollout AOT-compiles starve a
+   single-core host's pump for seconds, and a p99 across that window
+   would measure compile starvation, not admission,
+3. the same open-loop traffic while the fault plan runs LIVE: a
+   replica joins (weights copied from a serving donor), another dies
+   mid-stream, and a hot-swap rollout walks the pool — the router
+   keeps serving through all three with ``dropped == 0`` (every
+   admitted ticket resolves) and ``serve_path_compiles == 0``
+   post-warmup (the AOT contract at pod scope — membership churn
+   compiles on builder/boot paths, never the request path),
+4. a continuous-batching exactness gate: a charlm request decoded
+   interleaved with churning neighbors yields the SAME greedy
+   continuation as decoded alone, with zero decode-path compiles
+   (the slot arena is one fixed-shape AOT program).
+
+All gates land in the summary (journaled as a ``replica``
+kind="summary" event); the CLI wrappers exit nonzero when any fails.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+__all__ = ["replica_run"]
+
+
+def replica_run(replicas: int = 4, family: str = "transformer",
+                arm: str = "f32", buckets: tuple = (1, 8, 64),
+                max_wait_ms: float = 25.0, rate: float = 2000.0,
+                seconds: float = 1.5, backlog: int = 40,
+                seed: int = 0, log=None) -> dict:
+    """Run the kill/join/swap fault plan under open-loop load on the
+    virtual CPU mesh (zero chip time); returns the gate summary."""
+    from sparknet_tpu.obs.recorder import get_recorder
+    from sparknet_tpu.obs.sentinel import get_sentinel
+    from sparknet_tpu.serve.continuous import ContinuousDecoder
+    from sparknet_tpu.serve.engine import SHED_TICK_MS
+    from sparknet_tpu.serve.loadgen import (open_loop_schedule,
+                                            synthetic_items)
+    from sparknet_tpu.serve.router import ReplicaRouter
+
+    def say(msg: str) -> None:
+        if log:
+            log(msg)
+
+    get_sentinel().install()
+    t_start = time.perf_counter()
+    say(f"booting {replicas} replica(s) ({family}/{arm}) — "
+        f"AOT-compiling {len(buckets)} bucket(s) each ...")
+    router = ReplicaRouter(
+        replicas=replicas, family=family, arm=arm, buckets=buckets,
+        max_wait_ms=max_wait_ms, seed=seed)
+    some_model = next(iter(router._replicas.values())).model
+    rs = np.random.RandomState(seed)
+
+    # warmup every bucket on every replica, then the compile ledger
+    # must not move again (load compiles are by design)
+    router.warmup(rs)
+
+    # -- phase 1: deterministic kill with a known backlog ---------------
+    pre = [router.submit(item)
+           for item in synthetic_items(some_model, backlog, rs)]
+    victim = router.replica_ids()[0]
+    rerouted = router.kill_replica(victim)
+    router.pump(force=True)
+    kill_resolved = all(t.done() for t in pre)
+    say(f"kill: replica {victim} died with {rerouted} in-flight "
+        f"ticket(s) re-routed; all resolved={kill_resolved}")
+
+    # -- phase 2a: steady open loop, membership fixed -------------------
+    # the deadline-bound gate lives HERE, with no faults in flight:
+    # phase 2b's join/rollout legs AOT-compile whole bucket ladders,
+    # which on a single-core host starves the pump for seconds — a p99
+    # gate spanning that window would measure compile starvation, not
+    # the shed rule it exists to pin
+    items = synthetic_items(some_model, 256, rs)
+    stop = threading.Event()
+    worker = threading.Thread(
+        target=router.serve_forever, kwargs={"until": stop.is_set},
+        daemon=True)
+    worker.start()
+    steady = []
+    shed = 0
+    sched_a = open_loop_schedule(rate, seconds, seed=seed + 3)
+    t0 = time.perf_counter()
+    for i, due in enumerate(sched_a):
+        now = time.perf_counter() - t0
+        if due > now:
+            time.sleep(due - now)
+        t = router.submit(items[i % len(items)], shed=True)
+        if t is None:
+            shed += 1
+        else:
+            steady.append(t)
+    for t in steady:
+        t.wait(timeout=60.0)
+    from sparknet_tpu.serve.engine import percentile
+
+    queue_p99 = percentile(
+        [(t.t_batch - t.t_submit) * 1e3 for t in steady
+         if t.t_batch is not None], 99)
+    bound_ms = max_wait_ms + SHED_TICK_MS
+    say(f"steady open loop: {len(steady)} admitted, {shed} shed, "
+        f"queue p99 {queue_p99:.1f} ms (bound {bound_ms:.0f} ms)")
+
+    # -- phase 2b: open-loop Poisson with live join/kill/swap -----------
+    schedule = open_loop_schedule(rate, seconds, seed=seed + 7)
+    faults = [(0.35 * seconds, "join"), (0.55 * seconds, "kill"),
+              (0.75 * seconds, "swap")]
+    tickets = []
+    fired = []
+    t0 = time.perf_counter()
+    for i, due in enumerate(schedule):
+        while faults and (time.perf_counter() - t0) >= faults[0][0]:
+            _, kind = faults.pop(0)
+            fired.append(kind)
+            if kind == "join":
+                router.join_replica()
+            elif kind == "kill":
+                router.kill_replica(router.replica_ids()[0])
+            else:
+                router.rollout(seed=seed + 1)
+            say(f"fault fired mid-stream: {kind} "
+                f"(width now {router.width()})")
+        now = time.perf_counter() - t0
+        if due > now:
+            time.sleep(due - now)
+        t = router.submit(items[i % len(items)], shed=True)
+        if t is None:
+            shed += 1
+        else:
+            tickets.append(t)
+    for due_fault in faults:  # short schedules: fire the tail anyway
+        kind = due_fault[1]
+        fired.append(kind)
+        if kind == "join":
+            router.join_replica()
+        elif kind == "kill":
+            router.kill_replica(router.replica_ids()[0])
+        else:
+            router.rollout(seed=seed + 1)
+    wall = time.perf_counter() - t0
+    stop.set()
+    worker.join(timeout=30.0)
+    router.shutdown()
+    for t in tickets:
+        t.wait(timeout=60.0)
+
+    stats = router.emit_summary(wall)
+    dropped = sum(1 for t in pre + steady + tickets if not t.done())
+    say(f"faulted open loop: {len(tickets)} admitted, "
+        f"compiles {stats['serve_path_compiles']}, "
+        f"dropped {dropped}")
+
+    # -- phase 3: continuous-batching exactness -------------------------
+    say("continuous batching: interleaved-vs-alone greedy gate ...")
+    alone = ContinuousDecoder(slots=4, seq_len=16, vocab=32, seed=seed)
+    t_alone = alone.submit([1, 2, 3], 8)
+    alone.run()
+    churn = ContinuousDecoder(slots=4, seq_len=16, vocab=32, seed=seed)
+    for i in range(6):  # staggered lengths force slot churn
+        churn.submit([5 + i], 4 + i)
+    t_mix = churn.submit([1, 2, 3], 8)
+    churn.run()
+    continuous_exact = t_alone.wait(5.0) == t_mix.wait(5.0)
+    continuous_compiles = churn.decode_path_compiles
+
+    summary = {
+        "replicas_start": replicas,
+        "replicas_end": router.width(),
+        "faults_fired": fired,
+        "requests": len(pre) + len(steady) + len(tickets),
+        "shed": shed,
+        "rerouted": stats["rerouted"],
+        "rerouted_deterministic": rerouted,
+        "kill_resolved": kill_resolved,
+        "dropped": dropped,
+        "queue_p99_ms": round(queue_p99, 3),
+        "queue_bound_ms": bound_ms,
+        "serve_path_compiles": stats["serve_path_compiles"],
+        "continuous_exact": continuous_exact,
+        "continuous_compiles": continuous_compiles,
+        "slot_churn": churn.stats()["admitted"] > churn.slots,
+        "wall_s": round(time.perf_counter() - t_start, 3),
+    }
+    summary["ok"] = bool(
+        dropped == 0 and rerouted > 0 and kill_resolved
+        and len(fired) == 3 and summary["serve_path_compiles"] == 0
+        and queue_p99 <= bound_ms and continuous_exact
+        and continuous_compiles == 0 and summary["slot_churn"])
+    get_recorder().emit(
+        "replica", kind="summary", model="dryrun", family=family,
+        arm=arm, width=router.width(),
+        requests=summary["requests"], shed=shed,
+        rerouted=stats["rerouted"], dropped=dropped,
+        p99_ms=round(stats["p99_ms"], 3),
+        wall_s=summary["wall_s"],
+        note=f"mode-20 fault plan {fired}: gates ok={summary['ok']} "
+             f"compiles={summary['serve_path_compiles']} "
+             f"dropped={dropped}")
+    return summary

@@ -63,6 +63,7 @@ from sparknet_tpu.serve.residency import AdmissionPolicy, load_fit_table
 
 __all__ = [
     "SERVE_BUCKETS",
+    "SHED_TICK_MS",
     "AdmissionRefused",
     "ServeEngine",
     "ServedModel",
@@ -88,6 +89,13 @@ EXEC_FLOOR = 2
 def exec_batch(bucket: int) -> int:
     """The batch a bucket's program is actually compiled at."""
     return max(int(bucket), EXEC_FLOOR)
+
+
+# one pump tick (ms): the grace the shed gate adds on top of
+# max_wait_ms — a flush decision is at most one scheduling tick away,
+# so an admitted request can legitimately wait max_wait_ms + one tick.
+# Matches tools/serve_bench.py's deadline-bound convention.
+SHED_TICK_MS = 15.0
 
 
 def _exactness_compiler_options() -> dict | None:
@@ -222,7 +230,8 @@ class ServedModel:
     def __init__(self, name: str, family_name: str, arm: str,
                  buckets: tuple, max_wait_ms: float, clock,
                  predicted_bytes: int, seed: int = 0,
-                 calibration_batches: int = 2, variables=None):
+                 calibration_batches: int = 2, variables=None,
+                 device=None):
         from sparknet_tpu.common import Phase
         from sparknet_tpu.compiler.graph import Network, NetVars
         from sparknet_tpu.ops.layout import internal_shape
@@ -232,6 +241,11 @@ class ServedModel:
         self.arm = arm
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         self.predicted_bytes = int(predicted_bytes)
+        # the replica-group placement (serve/router.py): each copy's
+        # variables and example shardings pin to ONE mesh device, so K
+        # replicas' executables dispatch to K distinct chips; None keeps
+        # the single-copy default-device behavior bit-identical
+        self.device = device
         self.batcher = DynamicBatcher(self.buckets, max_wait_ms, clock)
         self.qstate: dict | None = None
         self.version = 0
@@ -285,6 +299,9 @@ class ServedModel:
                  for s in range(calibration_batches)),
                 num_batches=calibration_batches)
 
+        if device is not None:
+            self.variables = jax.device_put(self.variables, device)
+
         self.score_blob = _score_blob(net0)
         self.executables: dict[int, object] = {}
         self.compile_wall_s = 0.0
@@ -316,9 +333,11 @@ class ServedModel:
         AOT compilation allocates nothing batch-sized.  Shaped at the
         EXEC batch (>= EXEC_FLOOR), not the ladder bucket."""
         n = exec_batch(bucket)
+        sharding = (jax.sharding.SingleDeviceSharding(self.device)
+                    if self.device is not None else None)
         data = jax.ShapeDtypeStruct((n, *self.item_shape),
-                                    self.item_dtype)
-        label = jax.ShapeDtypeStruct((n,), np.int32)
+                                    self.item_dtype, sharding=sharding)
+        label = jax.ShapeDtypeStruct((n,), np.int32, sharding=sharding)
         return {"data": data, "label": label}
 
 
@@ -348,13 +367,18 @@ class ServeEngine:
                  fit_table: dict | None = None,
                  hbm_bytes: int | None = None,
                  clock=time.monotonic,
-                 calibration_batches: int = 2):
+                 calibration_batches: int = 2,
+                 device=None):
         from sparknet_tpu.analysis.mem_model import V5E_HBM_BYTES
 
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         self.max_wait_ms = float(max_wait_ms)
         self.clock = clock
         self.calibration_batches = int(calibration_batches)
+        # replica placement: every model this engine loads pins its
+        # variables + executables to this one device (router.py gives
+        # each replica its own engine on its own mesh device)
+        self.device = device
         self.policy = AdmissionPolicy(
             fit_table if fit_table is not None else load_fit_table(),
             hbm_bytes=hbm_bytes or V5E_HBM_BYTES)
@@ -374,6 +398,14 @@ class ServeEngine:
         # AOT contract — and the loop dryrun's gate — is that this
         # never moves after warmup, rollouts included.
         self.serve_path_compiles = 0
+        # deadline-shed ledger (batcher.shed): rejections are journaled
+        # THROTTLED — at most one ``serve/shed`` line per interval with
+        # the count since the last line — so a saturating loadgen can't
+        # swamp the journal with per-ticket rejections
+        self.shed_total = 0
+        self._shed_pending = 0
+        self._shed_last_emit: float | None = None
+        self._shed_emit_interval_s = 0.25
 
     # -- model lifecycle ---------------------------------------------------
 
@@ -385,10 +417,13 @@ class ServeEngine:
 
     def load_model(self, name: str, family: str = "cifar10_quick",
                    arm: str = "f32", buckets: tuple | None = None,
-                   seed: int = 0) -> ServedModel:
+                   seed: int = 0, variables=None) -> ServedModel:
         """Price, maybe refuse, else AOT-compile every bucket.  The
         refusal happens BEFORE any jax work — a refused load journals
-        its verdict and costs zero compile seconds and zero dials."""
+        its verdict and costs zero compile seconds and zero dials.
+        ``variables`` seeds the load with existing weights instead of
+        the seed init — a JOINING replica copies the live copy's
+        weights so the pool stays score-consistent (router.py)."""
         from sparknet_tpu.obs.recorder import get_recorder
 
         if arm not in _ARMS:
@@ -413,7 +448,8 @@ class ServeEngine:
         model = ServedModel(
             name, family, arm, buckets, self.max_wait_ms, self.clock,
             verdict["predicted_bytes"], seed=seed,
-            calibration_batches=self.calibration_batches)
+            calibration_batches=self.calibration_batches,
+            variables=variables, device=self.device)
         with self._lock:
             self._models[name] = model
             self._resident_bytes += model.predicted_bytes
@@ -483,7 +519,7 @@ class ServeEngine:
             name, family, arm, buckets, self.max_wait_ms, self.clock,
             verdict["predicted_bytes"], seed=seed,
             calibration_batches=self.calibration_batches,
-            variables=variables)
+            variables=variables, device=self.device)
         rec.emit(
             "serve", kind="candidate_built", model=name, family=family,
             arm=arm, buckets=list(candidate.buckets),
@@ -572,10 +608,17 @@ class ServeEngine:
 
     # -- request path ------------------------------------------------------
 
-    def submit(self, model_name: str, item) -> Ticket:
+    def submit(self, model_name: str, item, *,
+               shed: bool = False) -> Ticket | None:
         """Enqueue one request (a single example, item-shaped).  Holds
         the pump lock across lookup + enqueue so a concurrent hot swap
-        can never strand the ticket in an already-drained queue."""
+        can never strand the ticket in an already-drained queue.
+
+        ``shed=True`` routes through the batcher's deadline-aware
+        admission (batcher.shed): a request whose projected queue wait
+        already exceeds ``max_wait_ms`` + one pump tick is REJECTED —
+        returns None, counts on ``shed_total``, and journals a
+        throttled ``serve/shed`` line — instead of growing p99."""
         with self._lock:
             model = self._models[model_name]
             item = np.asarray(item, model.item_dtype)
@@ -583,7 +626,64 @@ class ServeEngine:
                 raise ValueError(
                     f"request shape {item.shape} != model item shape "
                     f"{model.item_shape}")
-            return model.batcher.submit(item)
+            if not shed:
+                return model.batcher.submit(item)
+            ticket = model.batcher.shed(item, tick_ms=SHED_TICK_MS)
+            if ticket is not None:
+                return ticket
+            self._note_shed_locked(model_name, model, 1)
+        return None
+
+    def submit_many(self, model_name: str, items: list, *,
+                    shed: bool = False) -> tuple[list, int]:
+        """Chunked request path: the whole arrival chunk lands under
+        ONE pump-lock acquisition and one batcher lock (batcher
+        ``submit_many``) — the pod-rate submit path, where per-request
+        locking alone is measurable against the ~85 us/row serving
+        budget.  Returns ``(tickets, shed_n)``; the shed tail journals
+        through the same throttled ``serve/shed`` ledger as
+        :meth:`submit`."""
+        with self._lock:
+            model = self._models[model_name]
+            payloads = []
+            for item in items:
+                item = np.asarray(item, model.item_dtype)
+                if item.shape != model.item_shape:
+                    raise ValueError(
+                        f"request shape {item.shape} != model item "
+                        f"shape {model.item_shape}")
+                payloads.append(item)
+            tickets, n_shed = model.batcher.submit_many(
+                payloads, shed=shed, tick_ms=SHED_TICK_MS)
+            if n_shed:
+                self._note_shed_locked(model_name, model, n_shed)
+        return tickets, n_shed
+
+    def _note_shed_locked(self, model_name: str, model,
+                          n: int) -> None:
+        """Count ``n`` rejections and journal a throttled
+        ``serve/shed`` line (at most one per interval, carrying the
+        count since the previous line).  Caller holds the pump lock."""
+        self.shed_total += n
+        self._shed_pending += n
+        now = self.clock()
+        due = (self._shed_last_emit is None
+               or now - self._shed_last_emit
+               >= self._shed_emit_interval_s)
+        if not due:
+            return
+        pending, self._shed_pending = self._shed_pending, 0
+        self._shed_last_emit = now
+        projected = model.batcher.last_projected_ms
+        from sparknet_tpu.obs.recorder import get_recorder
+
+        get_recorder().emit(
+            "serve", kind="shed", model=model_name,
+            shed=pending, projected_wait_ms=round(projected, 3),
+            tick_ms=SHED_TICK_MS,
+            note="deadline-aware admission: projected queue wait over "
+                 "max_wait_ms + one pump tick — rejected, not queued "
+                 "(count aggregated since the previous shed line)")
 
     def infer(self, model_name: str, item,
               timeout: float | None = 60.0):
@@ -593,19 +693,29 @@ class ServeEngine:
         self.pump(force=True)
         return ticket.wait(timeout)
 
-    def pump(self, force: bool = False) -> int:
+    def pump(self, force: bool = False,
+             max_batches: int | None = None) -> int:
         """Drain every model's due batches on the caller's thread;
         returns the number of batches executed.  The synchronous twin of
         :meth:`serve_forever` — tests, the dryrun, and closed-loop
-        benches drive this directly."""
+        benches drive this directly.
+
+        ``max_batches`` caps the batches taken PER MODEL in this call.
+        A pod pump sweeping several replicas passes 1 (router.py): an
+        uncapped drain of a continuously-fed queue never exits — the
+        JSQ router keeps routing to the replica being drained (its
+        depth keeps hitting zero), and every other replica's tickets
+        age unserved for the whole feedback loop."""
         executed = 0
         for model in list(self._models.values()):
-            while True:
+            taken = 0
+            while max_batches is None or taken < max_batches:
                 batch = model.batcher.take(force=force)
                 if batch is None:
                     break
                 self._execute(model, batch)
-                executed += 1
+                taken += 1
+            executed += taken
         return executed
 
     def serve_forever(self, until=None, poll_s: float = 0.05) -> int:
@@ -686,6 +796,10 @@ class ServeEngine:
         now = self.clock()
         model.batches += 1
         model.padded_rows += bucket - len(tickets)
+        # the per-request emit is guarded, not just no-op'd: at pod
+        # offered rates the kwargs construction alone is measurable
+        # against the ~85 us/row budget when the journal is disarmed
+        emit = rec.emit if rec.enabled else None
         for i, t in enumerate(tickets):
             t.t_done = now
             queue_ms = max(0.0, (t.t_batch - t.t_submit) * 1e3)
@@ -695,14 +809,15 @@ class ServeEngine:
             model.lat_total_ms.append(total_ms)
             model.lat_queue_ms.append(queue_ms)
             model.lat_device_ms.append(device_ms)
-            rec.emit(
-                "request", model=model.name, bucket=bucket,
-                queue_wait_ms=round(queue_ms, 4),
-                batch_assembly_ms=round(asm_ms, 4),
-                device_ms=round(device_ms, 4),
-                total_ms=round(total_ms, 4),
-                batch_n=len(tickets), padded=bucket > len(tickets),
-                deadline_flush=bool(t.deadline_flush))
+            if emit is not None:
+                emit(
+                    "request", model=model.name, bucket=bucket,
+                    queue_wait_ms=round(queue_ms, 4),
+                    batch_assembly_ms=round(asm_ms, 4),
+                    device_ms=round(device_ms, 4),
+                    total_ms=round(total_ms, 4),
+                    batch_n=len(tickets), padded=bucket > len(tickets),
+                    deadline_flush=bool(t.deadline_flush))
 
     # -- telemetry ---------------------------------------------------------
 

@@ -29,7 +29,30 @@ from sparknet_tpu.serve.engine import (
     percentile,
 )
 
-__all__ = ["burst_plan", "load_run", "synthetic_items"]
+__all__ = ["burst_plan", "load_run", "open_loop_schedule", "pod_run",
+           "synthetic_items"]
+
+
+def open_loop_schedule(rate: float, seconds: float,
+                       seed: int = 7) -> np.ndarray:
+    """Deterministic open-loop (Poisson) arrival schedule: cumulative
+    offsets (s) of every arrival in ``[0, seconds)`` at mean ``rate``
+    req/s — exponential inter-arrival gaps from a seeded RNG, so the
+    same (rate, seconds, seed) always yields the SAME schedule
+    (tests/test_serve_replica.py pins it).  Shared by the serve bench's
+    open-loop arms and dryrun mode 20: arrivals don't wait for
+    completions, which is what makes the p99 honest under load."""
+    if rate <= 0 or seconds <= 0:
+        raise ValueError(
+            f"need positive rate/seconds, got {rate}/{seconds}")
+    rs = np.random.RandomState(seed)
+    n = max(16, int(rate * seconds * 1.5))
+    gaps = rs.exponential(1.0 / rate, n)
+    arrivals = np.cumsum(gaps)
+    while arrivals[-1] < seconds:
+        gaps = rs.exponential(1.0 / rate, n)
+        arrivals = np.append(arrivals, arrivals[-1] + np.cumsum(gaps))
+    return arrivals[arrivals < seconds]
 
 
 def synthetic_items(model, n: int, rs: np.random.RandomState) -> list:
@@ -54,6 +77,78 @@ def burst_plan(requests: int = 504,
     while sum(plan) < requests:
         plan.append(1)
     return plan
+
+
+def pod_run(replicas: int = 2, family: str = "transformer",
+            arm: str = "f32", buckets: tuple = (1, 8, 64),
+            max_wait_ms: float = 25.0, rate: float = 2000.0,
+            seconds: float = 1.0, seed: int = 0, chunk_s: float = 0.005,
+            log=None) -> dict:
+    """Steady open-loop load through a K-replica pod (no fault plan —
+    that is dryrun mode 20's job).  Backs ``tpunet serve --replicas K``:
+    boots a ``ReplicaRouter``, warms every bucket on every replica,
+    snapshots the recompile sentinel, then sprays a seeded Poisson
+    schedule in ``chunk_s`` horizons with deadline shedding on.
+
+    Returns the pod summary; ``compiles_post_warmup`` and ``dropped``
+    are the gates (both must be 0)."""
+    import threading
+
+    from sparknet_tpu.obs.sentinel import get_sentinel
+    from sparknet_tpu.serve.router import ReplicaRouter
+
+    def say(msg: str) -> None:
+        if log:
+            log(msg)
+
+    sentinel = get_sentinel().install()
+    say(f"booting {replicas} replica(s) ({family}/{arm}) — "
+        f"AOT-compiling {len(buckets)} bucket(s) each ...")
+    router = ReplicaRouter(replicas=replicas, family=family, arm=arm,
+                           buckets=buckets, max_wait_ms=max_wait_ms,
+                           seed=seed)
+    rs = np.random.RandomState(seed)
+    router.warmup(rs)
+    compiles0 = sentinel.count
+
+    schedule = open_loop_schedule(rate, seconds, seed=seed)
+    say(f"traffic: {len(schedule)} open-loop arrival(s) at "
+        f"{rate:g} req/s offered ...")
+    some_model = next(iter(router._replicas.values())).model
+    items = synthetic_items(some_model, 256, rs)
+    stop = threading.Event()
+    pump = threading.Thread(
+        target=router.serve_forever, kwargs={"until": stop.is_set},
+        daemon=True)
+    pump.start()
+    tickets = []
+    t0 = time.perf_counter()
+    i = 0
+    while i < len(schedule):
+        now = time.perf_counter() - t0
+        j = i
+        while j < len(schedule) and schedule[j] <= now + chunk_s:
+            j += 1
+        if j > i:
+            chunk = [items[k % len(items)] for k in range(i, j)]
+            admitted, _ = router.submit_many(chunk, shed=True)
+            tickets.extend(admitted)
+            i = j
+        else:
+            time.sleep(min(chunk_s, schedule[i] - now))
+    for t in tickets:
+        t.wait(timeout=60.0)
+    wall_s = time.perf_counter() - t0
+    stop.set()
+    pump.join(timeout=5.0)
+    router.pump(force=True)
+    summary = router.emit_summary(wall_s)
+    summary["offered"] = len(schedule)
+    summary["admitted"] = len(tickets)
+    summary["compiles_post_warmup"] = sentinel.count - compiles0
+    summary["wall_s"] = round(wall_s, 3)
+    router.shutdown()
+    return summary
 
 
 def load_run(requests: int = 504, family: str = "cifar10_quick",

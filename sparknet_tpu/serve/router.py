@@ -1,0 +1,466 @@
+"""Replica router: K served copies on the mesh, elastic between flushes.
+
+SparkNet's whole thesis is throughput from cheap replication over flaky
+workers (SURVEY.md §1); PR 9's engine is one model copy on one chip.
+This module is the pod-scale layer over it: a :class:`ReplicaRouter`
+holds K replicas — each its own :class:`~sparknet_tpu.serve.engine.
+ServeEngine` pinned to ONE mesh device, so K replicas' executables
+dispatch to K distinct chips with no collective between them (serving
+is embarrassingly parallel; the graph twins ``serve_r{1,2,4}`` pin the
+zero-collective contract per width).
+
+Routing policy (docs/SERVING.md "Replication & elasticity"):
+
+* ``submit`` sprays tickets to the replica with the LEAST outstanding
+  work (pending queue depth) — under uniform service rates this is the
+  classic join-shortest-queue policy, and it degrades gracefully when a
+  replica slows (its queue grows, new work flows around it).  The depth
+  read is a lock-free snapshot (a stale read mis-places one ticket by
+  one position, it never corrupts a queue).
+* Admission prices PER REPLICA: each engine carries its own
+  batch-fit-table policy against its own device's HBM, so pod capacity
+  scales with K instead of sharing one budget.
+* ``shed=True`` routes through the engines' deadline-aware admission
+  (batcher.shed) — overload rejects at the door with a journaled
+  ``serve/shed`` trail instead of growing every queue's p99.
+
+Elastic membership (the ``parallel/elastic.py`` machinery at serve
+time): replicas join/leave/die BETWEEN flushes.  A kill STEALS the dead
+replica's pending tickets (batcher.steal — unstamped, unresolved) and
+ADOPTS them onto the least-loaded survivor merged by original submit
+time: the SAME Ticket objects resolve, so zero tickets drop and the
+re-routed requests pay their true queue wait in the latency ledger.  A
+join copies the live weights (``load_model(variables=...)``) so the
+pool stays score-consistent, then re-cuts the placement mesh via
+``sized_data_mesh`` exactly like the elastic trainer's resize.  Every
+membership event journals to the ``replica`` obs vocabulary
+(replica_up / replica_down / resize / rollout / summary).
+
+Hot-swap under load composes PR 10's candidate protocol PER replica:
+``rollout`` walks the pool sequentially — while one replica builds and
+swaps (off its request path), the other K-1 keep serving.
+
+ref: caffe/src/caffe/parallel.cpp P2PSync (the reference's replica
+fan-out — gradient exchange across train replicas; routing, elastic
+serve membership, and zero-drop re-route are new TPU-first surface).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+import numpy as np
+
+from sparknet_tpu.parallel.mesh import sized_data_mesh
+from sparknet_tpu.serve.batcher import Ticket
+from sparknet_tpu.serve.engine import ServeEngine
+
+__all__ = ["ReplicaRouter", "Replica"]
+
+
+class Replica:
+    """One pool member: a stable id, a device, and a single-model
+    engine.  Ids never recycle (the elastic convention — the pool
+    renumbers positions on every resize, ids stay stable)."""
+
+    __slots__ = ("rid", "device", "engine", "model")
+
+    def __init__(self, rid: int, device, engine: ServeEngine, model):
+        self.rid = rid
+        self.device = device
+        self.engine = engine
+        self.model = model
+
+    def outstanding(self) -> int:
+        """Lock-free queue-depth snapshot (see module docstring)."""
+        return len(self.model.batcher._q)
+
+
+class ReplicaRouter:
+    """K-replica serving pool with least-outstanding-work routing,
+    elastic membership, and per-replica hot swap.
+
+    One model name serves across the whole pool (the pod serves one
+    logical model at K copies; multi-model pods would nest this).
+    """
+
+    def __init__(self, replicas: int = 4, family: str = "transformer",
+                 arm: str = "f32", buckets: tuple = (1, 8, 64),
+                 max_wait_ms: float = 25.0, *,
+                 model_name: str = "model", seed: int = 0,
+                 fit_table: dict | None = None,
+                 hbm_bytes: int | None = None, devices=None):
+        from sparknet_tpu.obs.recorder import get_recorder
+
+        if replicas < 1:
+            raise ValueError(f"need >= 1 replica, got {replicas}")
+        self.family = family
+        self.arm = arm
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        self.max_wait_ms = float(max_wait_ms)
+        self.model_name = model_name
+        self.seed = int(seed)
+        self._fit_table = fit_table
+        self._hbm_bytes = hbm_bytes
+        self._device_pool = (list(devices) if devices is not None
+                            else list(jax.devices()))
+        if replicas > len(self._device_pool):
+            raise ValueError(
+                f"cannot place {replicas} replicas on "
+                f"{len(self._device_pool)} device(s)")
+        self._lock = threading.RLock()
+        self._replicas: dict[int, Replica] = {}
+        self._next_rid = 0
+        self._closed = False
+        self.submitted = 0
+        self.rerouted_total = 0
+        # retired ledger: counters/latencies of models that left the
+        # pool (killed replicas, swapped-out generations) — pod stats
+        # must count EVERY resolved ticket or the zero-drop arithmetic
+        # (submitted - resolved) would blame membership churn for drops
+        self._retired_requests = 0
+        self._retired_shed = 0
+        self._retired_compiles = 0
+        self._retired_lat: list[float] = []
+        self._retired_queue: list[float] = []
+        rec = get_recorder()
+        for _ in range(replicas):
+            rep = self._boot_replica(variables=None)
+            rec.emit("replica", kind="replica_up", replica=rep.rid,
+                     model=model_name, family=family, arm=arm,
+                     width=len(self._replicas),
+                     predicted_bytes=rep.model.predicted_bytes,
+                     note="initial pool boot")
+        self.mesh = sized_data_mesh(len(self._replicas),
+                                    devices=self._live_devices())
+
+    # -- membership internals ----------------------------------------------
+
+    def _live_devices(self) -> list:
+        return [rep.device for rep in self._replicas.values()]
+
+    def _free_device(self):
+        used = {id(d) for d in self._live_devices()}
+        for d in self._device_pool:
+            if id(d) not in used:
+                return d
+        raise RuntimeError(
+            f"device pool exhausted ({len(self._device_pool)} devices, "
+            f"{len(self._replicas)} live replicas)")
+
+    def _boot_replica(self, variables=None) -> Replica:
+        """Build one replica: its own engine on its own device, the
+        model loaded (priced + AOT-compiled) before it joins the pool —
+        a booting replica never receives traffic half-built."""
+        device = self._free_device()
+        engine = ServeEngine(
+            self.buckets, self.max_wait_ms,
+            fit_table=self._fit_table, hbm_bytes=self._hbm_bytes,
+            device=device)
+        model = engine.load_model(
+            self.model_name, family=self.family, arm=self.arm,
+            buckets=self.buckets, seed=self.seed, variables=variables)
+        rid = self._next_rid
+        self._next_rid += 1
+        rep = Replica(rid, device, engine, model)
+        with self._lock:
+            self._replicas[rid] = rep
+        return rep
+
+    def _retire_counters(self, model, engine=None) -> None:
+        """Fold a departing model's ledger into the pod totals (call
+        with the router lock held)."""
+        self._retired_requests += model.requests
+        self._retired_lat.extend(model.lat_total_ms)
+        self._retired_queue.extend(model.lat_queue_ms)
+        if engine is not None:
+            self._retired_shed += engine.shed_total
+            self._retired_compiles += engine.serve_path_compiles
+
+    def _recut_mesh(self, from_width: int, reason: str) -> None:
+        from sparknet_tpu.obs.recorder import get_recorder
+
+        width = len(self._replicas)
+        self.mesh = sized_data_mesh(width,
+                                    devices=self._live_devices())
+        get_recorder().emit(
+            "replica", kind="resize", from_width=from_width,
+            to_width=width, note=reason)
+
+    # -- membership surface (between flushes) ------------------------------
+
+    def replica_ids(self) -> list[int]:
+        with self._lock:
+            return list(self._replicas)
+
+    def width(self) -> int:
+        return len(self._replicas)
+
+    def kill_replica(self, rid: int) -> int:
+        """A replica dies: steal its pending tickets and adopt them
+        onto the least-loaded survivor (zero dropped — the SAME Ticket
+        objects resolve there), then re-cut the mesh.  Returns the
+        re-routed ticket count."""
+        from sparknet_tpu.obs.recorder import get_recorder
+
+        with self._lock:
+            if len(self._replicas) <= 1:
+                raise RuntimeError(
+                    "cannot kill the last replica (the pool would "
+                    "drop its queue)")
+            from_width = len(self._replicas)
+            dead = self._replicas.pop(rid)
+            stolen = dead.model.batcher.steal()
+            dead.model.batcher.close(drain=False)
+            dead.engine._closed = True
+            self._retire_counters(dead.model, dead.engine)
+            target = min(self._replicas.values(),
+                         key=Replica.outstanding)
+            target.model.batcher.adopt(stolen)
+            self.rerouted_total += len(stolen)
+        get_recorder().emit(
+            "replica", kind="replica_down", replica=rid,
+            model=self.model_name, family=self.family, arm=self.arm,
+            width=len(self._replicas), rerouted=len(stolen),
+            outstanding=target.outstanding(),
+            note=f"pending tickets adopted by replica {target.rid} "
+                 "merged by original submit time — zero dropped")
+        self._recut_mesh(from_width, reason=f"replica {rid} killed")
+        return len(stolen)
+
+    def join_replica(self) -> int:
+        """A fresh replica joins: boots on a free pool device with the
+        live weights COPIED from a serving replica (score-consistent by
+        construction — tests pin bitwise agreement), then the mesh
+        re-cuts.  Returns the new replica id."""
+        from sparknet_tpu.obs.recorder import get_recorder
+
+        with self._lock:
+            from_width = len(self._replicas)
+            donor = next(iter(self._replicas.values()))
+            variables = donor.model.variables
+        rep = self._boot_replica(variables=variables)
+        get_recorder().emit(
+            "replica", kind="replica_up", replica=rep.rid,
+            model=self.model_name, family=self.family, arm=self.arm,
+            width=len(self._replicas),
+            predicted_bytes=rep.model.predicted_bytes,
+            note=f"elastic join — weights copied from replica "
+                 f"{donor.rid}")
+        self._recut_mesh(from_width, reason=f"replica {rep.rid} joined")
+        return rep.rid
+
+    def rollout(self, variables=None, seed: int | None = None) -> int:
+        """Hot-swap every replica to a new generation, sequentially —
+        PR 10's candidate protocol per replica: each candidate
+        AOT-compiles off the request path, then swaps under that
+        replica's pump lock while the OTHER replicas keep serving.
+        Returns total tickets drained through retiring models."""
+        from sparknet_tpu.obs.recorder import get_recorder
+
+        rec = get_recorder()
+        drained = 0
+        for rid in self.replica_ids():
+            with self._lock:
+                rep = self._replicas.get(rid)
+            if rep is None:  # killed while we walked the pool
+                continue
+            candidate = rep.engine.build_candidate(
+                self.model_name, family=self.family, arm=self.arm,
+                buckets=self.buckets, variables=variables,
+                seed=self.seed if seed is None else seed)
+            info = rep.engine.swap_model(self.model_name, candidate)
+            with self._lock:
+                # engine-level ledgers (shed, compiles) survive the
+                # swap with the engine; only the retiring MODEL's
+                # counters leave the pool
+                self._retire_counters(rep.model)
+                rep.model = candidate
+            drained += info["drained"]
+            rec.emit("replica", kind="rollout", replica=rid,
+                     model=self.model_name, family=self.family,
+                     arm=self.arm, version=info["version"],
+                     drained=info["drained"],
+                     wall_s=round(info["swap_wall_s"], 6),
+                     note="per-replica hot swap — pool kept serving "
+                          "through the build")
+        return drained
+
+    # -- request path ------------------------------------------------------
+
+    def warmup(self, rs: np.random.RandomState | None = None) -> int:
+        """Force one flush through every bucket on EVERY replica (each
+        engine AOT-compiled at load; warmup touches first-run work like
+        buffer donation paths), counting the traffic in the pod ledger
+        so the zero-drop arithmetic stays exact.  Returns requests."""
+        from sparknet_tpu.serve.loadgen import synthetic_items
+
+        rs = rs if rs is not None else np.random.RandomState(0)
+        n = 0
+        for rep in list(self._replicas.values()):
+            for b in self.buckets:
+                for item in synthetic_items(rep.model, max(1, b // 2),
+                                            rs):
+                    rep.engine.submit(self.model_name, item)
+                    with self._lock:
+                        self.submitted += 1
+                    n += 1
+                rep.engine.pump(force=True)
+        return n
+
+    def submit(self, item, *, shed: bool = False) -> Ticket | None:
+        """Route one request to the least-outstanding replica.  Returns
+        its Ticket, or None when ``shed=True`` and the chosen replica's
+        projected queue wait is over the deadline bound (the rejection
+        is counted and journaled by that engine)."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("router is closed")
+            best = self._pick_replica()
+            # enqueue inside the router lock: a concurrent kill (which
+            # also takes it) can never close the chosen batcher between
+            # the pick and the submit
+            ticket = best.engine.submit(self.model_name, item,
+                                        shed=shed)
+            if ticket is not None:
+                self.submitted += 1
+            return ticket
+
+    def submit_many(self, items: list, *,
+                    shed: bool = False) -> tuple[list[Ticket], int]:
+        """Route a whole arrival chunk to the least-outstanding replica
+        under one router-lock acquisition (engine ``submit_many`` takes
+        it from there) — the pod-rate arrival path: at >= 10k req/s the
+        per-request pick-and-lock of :meth:`submit` is measurable
+        against the serving budget, and JSQ at chunk granularity still
+        balances (a chunk raises its replica's depth, so the next chunk
+        flows elsewhere).  Returns ``(tickets, shed_n)``."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("router is closed")
+            best = self._pick_replica()
+            tickets, n_shed = best.engine.submit_many(
+                self.model_name, items, shed=shed)
+            self.submitted += len(tickets)
+            return tickets, n_shed
+
+    def _pick_replica(self) -> Replica:
+        """Least-PROJECTED-WAIT pick (depth over that replica's own
+        drain-rate EWMA, batcher ``projected_wait_snapshot``), with raw
+        depth as the tie-break before any rate evidence exists.  Raw
+        JSQ would misroute here: a replica whose rate estimate dipped
+        sheds hard, which keeps its queue short, which makes depth-JSQ
+        keep PICKING it — projected wait routes around slow evidence
+        instead of amplifying it, and equalizing projected waits across
+        the pool is exactly the bounded-p99 objective.  Caller holds
+        the router lock."""
+        best = None
+        best_key = None
+        for rep in self._replicas.values():
+            key = (rep.model.batcher.projected_wait_snapshot(),
+                   len(rep.model.batcher._q))
+            if best is None or key < best_key:
+                best, best_key = rep, key
+        return best
+
+    def pump(self, force: bool = False) -> int:
+        """One fair sweep: at most ONE batch per replica per pass, so a
+        deep queue can't starve its neighbors (an uncapped drain plus
+        JSQ feeding the drained replica is a starvation feedback loop —
+        engine.pump's ``max_batches`` note).  ``force=True`` sweeps
+        until every replica is empty — the drain-everything calls
+        (tests, phase boundaries) keep their semantics."""
+        executed = 0
+        while True:
+            swept = 0
+            for rep in list(self._replicas.values()):
+                swept += rep.engine.pump(force=force, max_batches=1)
+            executed += swept
+            if swept == 0 or not force:
+                return executed
+
+    def serve_forever(self, until=None, poll_s: float = 0.002) -> int:
+        """Pod pump loop: sweep all replicas; nap only when a sweep
+        drained nothing (busy pods never sleep between batches)."""
+        executed = 0
+        while not self._closed and not (until and until()):
+            n = self.pump()
+            executed += n
+            if n == 0:
+                time.sleep(poll_s)
+        return executed
+
+    def shutdown(self) -> int:
+        """Drain every replica (zero in-flight requests lost), close
+        the pool.  Returns requests served during the drain."""
+        with self._lock:
+            self._closed = True
+            reps = list(self._replicas.values())
+        drained = 0
+        for rep in reps:
+            for batch in rep.model.batcher.close(drain=True):
+                rep.engine._execute(rep.model, batch)
+                drained += len(batch)
+        return drained
+
+    # -- telemetry ---------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Pod-aggregate roll-up: latencies merged across replicas
+        (host-side walls), shed/reroute ledgers, per-replica detail."""
+        from sparknet_tpu.serve.engine import percentile
+
+        with self._lock:
+            reps = list(self._replicas.values())
+            lat = list(self._retired_lat)
+            queue = list(self._retired_queue)
+            requests = self._retired_requests
+            shed = self._retired_shed
+            compiles = self._retired_compiles
+        per_replica = {}
+        for rep in reps:
+            m = rep.model
+            lat.extend(m.lat_total_ms)
+            queue.extend(m.lat_queue_ms)
+            requests += m.requests
+            shed += rep.engine.shed_total
+            compiles += rep.engine.serve_path_compiles
+            per_replica[rep.rid] = {
+                "requests": m.requests, "batches": m.batches,
+                "outstanding": rep.outstanding(),
+            }
+        return {
+            "family": self.family, "arm": self.arm,
+            "buckets": list(self.buckets),
+            "replicas": len(reps), "requests": requests,
+            "submitted": self.submitted, "shed": shed,
+            "rerouted": self.rerouted_total,
+            "serve_path_compiles": compiles,
+            "p50_ms": percentile(lat, 50),
+            "p99_ms": percentile(lat, 99),
+            "queue_p99_ms": percentile(queue, 99),
+            "per_replica": per_replica,
+        }
+
+    def emit_summary(self, wall_s: float) -> dict:
+        """Journal the pod roll-up as a ``replica`` summary event;
+        ``dropped`` is submitted-minus-resolved and MUST be 0 (the
+        zero-drop ledger the dryrun gates on)."""
+        from sparknet_tpu.obs.recorder import get_recorder
+
+        s = self.stats()
+        dropped = self.submitted - s["requests"]
+        rps = s["requests"] / wall_s if wall_s > 0 else 0.0
+        get_recorder().emit(
+            "replica", kind="summary", model=self.model_name,
+            family=self.family, arm=self.arm, width=s["replicas"],
+            requests=s["requests"], shed=s["shed"],
+            rerouted=s["rerouted"], dropped=dropped,
+            rps=round(rps, 2), p50_ms=round(s["p50_ms"], 3),
+            p99_ms=round(s["p99_ms"], 3), wall_s=round(wall_s, 3),
+            note="pod aggregate (host-side walls)")
+        s["dropped"] = dropped
+        s["rps"] = rps
+        return s

@@ -36,6 +36,7 @@ EXPECTED_RULES = {
     "elastic-manifest-fresh",
     "serve-manifest-fresh",
     "loop-manifest-fresh",
+    "replica-manifest-fresh",
     "queue-job-hygiene",
     "obs-fenced-span",
     "feed-shm-cleanup",
@@ -762,6 +763,76 @@ def test_serve_manifest_fresh_ignores_other_packages(tmp_path):
     other.write_text(FRESH_SRC)
     assert not hits(FRESH_SRC, "serve-manifest-fresh", path=str(other))
     assert not hits(FRESH_SRC, "serve-manifest-fresh")
+
+
+# -- replica-manifest-fresh -------------------------------------------------
+
+
+def _replica_tree(tmp_path, record=True, covered=True, widths=(1, 2, 4),
+                  families=("graph_contracts", "mem_contracts")):
+    """A fake repo around serve/router.py: SOURCES.json (optionally not
+    covering it) + serve_r*.json pool-width twin manifests per family."""
+    import hashlib
+    import json as _json
+
+    rel = "sparknet_tpu/serve/router.py"
+    mod = tmp_path / rel
+    mod.parent.mkdir(parents=True, exist_ok=True)
+    mod.write_text(FRESH_SRC)
+    digest = hashlib.sha256(FRESH_SRC.encode()).hexdigest()
+    for fam in families:
+        cdir = tmp_path / "docs" / fam
+        cdir.mkdir(parents=True, exist_ok=True)
+        if record:
+            entry = {rel: digest} if covered else {"other.py": digest}
+            (cdir / "SOURCES.json").write_text(_json.dumps(entry))
+        for w in widths:
+            (cdir / f"serve_r{w}.json").write_text("{}")
+    return str(mod)
+
+
+def test_replica_manifest_fresh_clean_when_banked(tmp_path):
+    path = _replica_tree(tmp_path)
+    assert not hits(FRESH_SRC, "replica-manifest-fresh", path=path)
+
+
+def test_replica_manifest_fresh_positive_when_never_banked(tmp_path):
+    path = _replica_tree(tmp_path, record=False, widths=())
+    found = hits(FRESH_SRC, "replica-manifest-fresh", path=path)
+    assert len(found) == 2  # one per family
+    assert "SOURCES.json missing" in found[0].message
+
+
+def test_replica_manifest_fresh_positive_when_not_folded_in(tmp_path):
+    # manifests exist but predate the replica layer: router.py absent
+    # from the fingerprint — the silent-non-coverage hole
+    path = _replica_tree(tmp_path, covered=False)
+    found = hits(FRESH_SRC, "replica-manifest-fresh", path=path)
+    assert len(found) == 2
+    assert all("not folded into" in f.message for f in found)
+
+
+def test_replica_manifest_fresh_positive_below_min_widths(tmp_path):
+    path = _replica_tree(tmp_path, widths=(4,))
+    found = hits(FRESH_SRC, "replica-manifest-fresh", path=path)
+    assert len(found) == 2
+    assert all(">= 2" in f.message for f in found)
+
+
+def test_replica_manifest_fresh_suppressed(tmp_path):
+    path = _replica_tree(tmp_path, record=False, widths=())
+    src = ("# graftlint: disable-file=replica-manifest-fresh -- "
+           "manifest regen follows in this PR\n" + FRESH_SRC)
+    assert not hits(src, "replica-manifest-fresh", path=path)
+    assert suppressed_hits(src, "replica-manifest-fresh", path=path)
+
+
+def test_replica_manifest_fresh_ignores_other_serve_files(tmp_path):
+    other = tmp_path / "sparknet_tpu" / "serve" / "engine.py"
+    other.parent.mkdir(parents=True, exist_ok=True)
+    other.write_text(FRESH_SRC)
+    assert not hits(FRESH_SRC, "replica-manifest-fresh", path=str(other))
+    assert not hits(FRESH_SRC, "replica-manifest-fresh")
 
 
 # -- loop-manifest-fresh ----------------------------------------------------

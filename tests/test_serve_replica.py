@@ -1,0 +1,408 @@
+"""Pod-scale serving gates (sparknet_tpu/serve router + shed + decode).
+
+Four contract families, mirroring tests/test_serve.py's layering:
+
+1. **Shed rule** — stdlib-only batcher tests on a fake clock: the
+   windowed drain-rate EWMA (a window opens at a take that leaves
+   backlog, closes into a sample after >= _WIN_S, and is invalidated
+   by any take that empties the queue), the asymmetric smoothing
+   (slowdowns adopted fast, speedups reluctantly), the projection's
+   one-take-period term, the largest-bucket floor, the cold-start
+   two-quanta cap, and the vectorized ``submit_many`` FIFO-tail shed.
+   No jax, no sleeps.
+2. **Loadgen determinism** — ``open_loop_schedule`` is a pure function
+   of (rate, seconds, seed): same seed, same schedule, bitwise.
+3. **Router policy** — re-route-on-death pinned EXACTLY (the stolen
+   count equals the victim's pending depth, zero tickets drop, the
+   SAME Ticket objects resolve on a survivor), projected-wait pick
+   over raw depth-JSQ, the fair one-batch-per-model pump cap, the
+   chunked submit path, and join weight consistency (bitwise).
+4. **Continuous batching** — a request decoded interleaved with
+   churning neighbors equals the same request decoded alone, bitwise,
+   with ZERO decode-path compiles (one fixed-shape AOT arena).
+
+ref: caffe/src/caffe/parallel.cpp P2PSync (the reference's replica
+fan-out — train-side gradient exchange; serve-side routing, shedding,
+and slot-level decode admission are new TPU-first surface).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.serve.batcher import DynamicBatcher, Ticket
+
+
+class FakeClock:
+    """Injectable time: advances only on demand (no test sleeps)."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _feed(b: DynamicBatcher, n: int) -> list:
+    return [b.submit(f"p{i}") for i in range(n)]
+
+
+def _establish_rate(b: DynamicBatcher, clock: FakeClock):
+    """Drive one sampling window to a known close: 4 accumulating
+    takes of 8 rows over exactly _WIN_S seconds -> 640 rows/s, take
+    period 12.5 ms.  Leaves backlog so the next window is open."""
+    _feed(b, 100)
+    clock.t = 0.0
+    assert len(b.take(force=True)) == 8  # opens the window (rows not counted)
+    for k in range(1, 5):
+        clock.t = k * 0.0125
+        b.take(force=True)
+    assert b._ewma_rate == pytest.approx(32 / 0.05)  # 640 rows/s
+    # first take-period sample blends against the 0.0 init through the
+    # slow-down alpha (12.5 ms > 0): 0.5 * 12.5
+    assert b._ewma_take_ms == pytest.approx(6.25)
+
+
+# -- shed rule (jax-free) ----------------------------------------------------
+
+
+@pytest.mark.smoke
+def test_window_opens_only_when_backlog_persists():
+    """A take that empties the queue invalidates the window: the gap
+    after it would measure idle time, not drain capability."""
+    clock = FakeClock()
+    b = DynamicBatcher(buckets=(1, 8), max_wait_ms=5.0, clock=clock)
+    _feed(b, 8)
+    b.take(force=True)  # empties -> no window, no sample
+    assert b._win_t0 is None and b._ewma_rate is None
+    _feed(b, 20)
+    clock.t = 1.0
+    b.take(force=True)  # leaves 12 pending -> window opens
+    assert b._win_t0 == 1.0 and b._ewma_rate is None
+    clock.t = 1.2
+    b.take(force=True)  # leaves 4: dt 0.2 >= _WIN_S -> sample closes
+    assert b._ewma_rate == pytest.approx(8 / 0.2)
+    clock.t = 1.3
+    b.take(force=True)  # empties again -> window invalidated
+    assert b._win_t0 is None
+    assert b._ewma_rate == pytest.approx(8 / 0.2)  # estimate survives
+
+
+@pytest.mark.smoke
+def test_windowed_rate_and_projection_arithmetic():
+    clock = FakeClock()
+    b = DynamicBatcher(buckets=(1, 8), max_wait_ms=5.0, clock=clock)
+    _establish_rate(b, clock)
+    # 60 pending at 640 rows/s + one 6.25 ms take period
+    assert b.pending() == 60
+    expect = 60 / 640 * 1e3 + 6.25
+    assert b.projected_wait_ms() == pytest.approx(expect)
+    assert b.projected_wait_snapshot() == pytest.approx(expect)
+
+
+@pytest.mark.smoke
+def test_asymmetric_ewma_adopts_slowdowns_fast():
+    clock = FakeClock()
+    b = DynamicBatcher(buckets=(1, 8), max_wait_ms=5.0, clock=clock)
+    _establish_rate(b, clock)  # 640 rows/s, window re-opened at 0.05
+    _feed(b, 200)
+    # faster window: 10 takes x 8 rows over 0.05 s -> 1600 rows/s
+    # sample ABOVE the estimate -> reluctant alpha 0.2
+    for k in range(1, 11):
+        clock.t = 0.05 + k * 0.005
+        b.take(force=True)
+    assert b._ewma_rate == pytest.approx(0.2 * 1600 + 0.8 * 640)
+    before = b._ewma_rate
+    # slower window: 2 takes x 8 rows over 0.05 s -> 320 rows/s
+    # sample BELOW the estimate -> eager alpha 0.5
+    for k in range(1, 3):
+        clock.t = 0.1 + k * 0.025
+        b.take(force=True)
+    assert b._ewma_rate == pytest.approx(0.5 * 320 + 0.5 * before)
+
+
+@pytest.mark.smoke
+def test_shed_rejects_over_projection_and_counts():
+    clock = FakeClock()
+    b = DynamicBatcher(buckets=(1, 8), max_wait_ms=5.0, clock=clock)
+    _establish_rate(b, clock)  # 60 pending project ~100 ms >> 5 ms
+    assert b.shed("late") is None
+    assert b.shed_count == 1
+    assert b.last_projected_ms == pytest.approx(60 / 640 * 1e3 + 6.25)
+    # a pump tick of grace moves the bound, not the verdict here
+    assert b.shed("late2", tick_ms=15.0) is None
+    assert b.shed_count == 2
+
+
+@pytest.mark.smoke
+def test_shed_largest_bucket_floor_never_chokes():
+    """Below one largest-bucket quantum nothing sheds, no matter how
+    stale-low the EWMA reads — one pump visit clears the queue, and
+    admission must keep feeding the estimator."""
+    clock = FakeClock()
+    b = DynamicBatcher(buckets=(1, 8), max_wait_ms=5.0, clock=clock)
+    _establish_rate(b, clock)
+    while b.pending() >= b.buckets[-1]:
+        b.take(force=True)
+    assert 0 < b.pending() < 8
+    assert b.projected_wait_ms() > b.max_wait_ms  # projection says shed
+    t = b.shed("floor")  # ... the floor says admit
+    assert isinstance(t, Ticket)
+    assert b.shed_count == 0
+
+
+@pytest.mark.smoke
+def test_cold_start_cap_bounds_blind_backlog():
+    """With NO rate evidence, pending is capped at two largest-bucket
+    quanta — a saturating burst can't park a deep backlog while the
+    estimator is still blind."""
+    b = DynamicBatcher(buckets=(1, 8, 64), max_wait_ms=5.0,
+                       clock=FakeClock())
+    admitted = [b.shed(i) for i in range(130)]
+    assert sum(t is not None for t in admitted) == 128  # 2 * 64
+    assert admitted[-1] is None and b.shed_count == 2
+
+
+@pytest.mark.smoke
+def test_submit_many_cold_cap_and_fifo_tail():
+    clock = FakeClock()
+    b = DynamicBatcher(buckets=(1, 8), max_wait_ms=5.0, clock=clock)
+    tickets, n_shed = b.submit_many([f"p{i}" for i in range(20)],
+                                    shed=True)
+    assert len(tickets) == 16 and n_shed == 4  # cold cap: 2 * 8
+    # earlier arrivals win — FIFO fairness survives chunking
+    assert [t.payload for t in tickets] == [f"p{i}" for i in range(16)]
+    assert b.shed_count == 4
+    # without shed the chunk admits wholesale under one timestamp
+    more, none_shed = b.submit_many(["a", "b"])
+    assert none_shed == 0 and more[0].t_submit == more[1].t_submit
+
+
+@pytest.mark.smoke
+def test_submit_many_rate_cap_floors_at_one_quantum():
+    clock = FakeClock()
+    b = DynamicBatcher(buckets=(1, 8), max_wait_ms=5.0, clock=clock)
+    _establish_rate(b, clock)
+    while b.take(force=True):  # drain so cap headroom is visible
+        pass
+    # bound_s = max(0, 5 - 6.25) ms = 0 -> cap floors at buckets[-1]
+    tickets, n_shed = b.submit_many([f"p{i}" for i in range(20)],
+                                    shed=True)
+    assert len(tickets) == 8 and n_shed == 12
+
+
+@pytest.mark.smoke
+def test_ticket_event_is_lazy_and_resolve_lock_free():
+    t = Ticket(0, "x", 0.0)
+    assert t._done is None and not t.done()
+    t.resolve(result=41)  # resolve before any waiter: no event built
+    assert t._done is None and t.done()
+    assert t.wait(timeout=0.0) == 41  # fast path: no event even now
+    u = Ticket(1, "y", 0.0)
+    u._event()  # a waiter materialized the event first
+    u.resolve(error=RuntimeError("boom"))
+    assert u._done.is_set()
+    with pytest.raises(RuntimeError, match="boom"):
+        u.wait(timeout=0.0)
+    v = Ticket(2, "z", 0.0)
+    with pytest.raises(TimeoutError):
+        v.wait(timeout=0.0)
+
+
+# -- loadgen determinism -----------------------------------------------------
+
+
+@pytest.mark.smoke
+def test_open_loop_schedule_deterministic():
+    from sparknet_tpu.serve.loadgen import open_loop_schedule
+
+    a = open_loop_schedule(2000.0, 0.5, seed=11)
+    b = open_loop_schedule(2000.0, 0.5, seed=11)
+    assert np.array_equal(a, b)  # same seed -> same schedule, bitwise
+    c = open_loop_schedule(2000.0, 0.5, seed=12)
+    assert not np.array_equal(a, c)
+    assert np.all(np.diff(a) >= 0) and a[-1] < 0.5
+    # open-loop: the mean offered rate is the asked-for rate
+    assert len(a) == pytest.approx(1000, rel=0.2)
+    with pytest.raises(ValueError, match="positive"):
+        open_loop_schedule(0.0, 1.0)
+
+
+# -- router policy -----------------------------------------------------------
+
+
+def _router(replicas=2, **kw):
+    from sparknet_tpu.serve.router import ReplicaRouter
+
+    kw.setdefault("family", "lenet")
+    kw.setdefault("buckets", (1, 8))
+    kw.setdefault("max_wait_ms", 5.0)
+    return ReplicaRouter(replicas=replicas, **kw)
+
+
+def _items(router, n, seed=3):
+    from sparknet_tpu.serve.loadgen import synthetic_items
+
+    model = next(iter(router._replicas.values())).model
+    return synthetic_items(model, n, np.random.RandomState(seed))
+
+
+def test_kill_reroutes_pending_exactly_zero_drop():
+    """The dead replica's queue moves WHOLE: rerouted == its pending
+    depth at the kill, the SAME Ticket objects resolve on a survivor,
+    and the pod ledger shows zero dropped."""
+    router = _router(replicas=2)
+    tickets = [router.submit(it) for it in _items(router, 12)]
+    victim = router.replica_ids()[0]
+    pending = router._replicas[victim].outstanding()
+    assert pending > 0  # JSQ spread put work on both replicas
+    rerouted = router.kill_replica(victim)
+    assert rerouted == pending  # pinned exactly
+    assert router.width() == 1
+    router.pump(force=True)
+    assert all(t.done() for t in tickets)  # zero dropped, same objects
+    stats = router.emit_summary(wall_s=1.0)
+    assert stats["dropped"] == 0
+    assert stats["rerouted"] == rerouted
+    router.shutdown()
+
+
+def test_pick_replica_prefers_low_projected_wait():
+    """A replica whose drain-rate evidence collapsed projects long
+    waits even with a SHORT queue — projected-wait pick routes around
+    it where depth-JSQ would keep feeding it."""
+    router = _router(replicas=2)
+    slow, fast = list(router._replicas.values())
+    slow.model.batcher._ewma_rate = 10.0  # 1 pending -> 100 ms wait
+    slow.model.batcher.submit("stuck")
+    fast.model.batcher._ewma_rate = 10_000.0
+    for i in range(4):  # deeper queue, but ~0.4 ms projected
+        fast.model.batcher.submit(f"q{i}")
+    assert router._pick_replica() is fast
+    slow.model.batcher._ewma_rate = None  # no evidence: depth breaks tie
+    assert router._pick_replica() is slow
+    for rep in (slow, fast):  # junk payloads must not reach _execute
+        rep.model.batcher.steal()
+    router.shutdown()
+
+
+def test_pump_caps_one_batch_per_model_per_sweep():
+    """engine.pump(max_batches=1) takes at most ONE batch per model —
+    the fair-sweep primitive that stops a deep queue from starving its
+    pod neighbors; force-pump still drains everything."""
+    router = _router(replicas=1)
+    rep = next(iter(router._replicas.values()))
+    for it in _items(router, 20):
+        router.submit(it)
+    assert rep.engine.pump(force=True, max_batches=1) == 1
+    assert rep.outstanding() == 12  # one 8-batch taken, rest parked
+    assert router.pump(force=True) == 2  # sweeps until drained
+    assert rep.outstanding() == 0
+    router.shutdown()
+
+
+def test_submit_many_routes_chunk_and_counts():
+    router = _router(replicas=2)
+    tickets, n_shed = router.submit_many(_items(router, 10), shed=True)
+    assert len(tickets) == 10 and n_shed == 0
+    assert router.submitted == 10
+    # the whole chunk landed on ONE replica (chunk-granularity JSQ)
+    depths = sorted(r.outstanding() for r in router._replicas.values())
+    assert depths == [0, 10]
+    router.pump(force=True)
+    assert all(t.done() for t in tickets)
+    router.shutdown()
+
+
+def test_join_copies_live_weights_bitwise():
+    router = _router(replicas=1)
+    item = _items(router, 1)[0]
+    before = np.asarray(next(iter(
+        router._replicas.values())).engine.infer("model", item))
+    rid = router.join_replica()
+    assert router.width() == 2
+    joined = router._replicas[rid]
+    after = np.asarray(joined.engine.infer("model", item))
+    assert np.array_equal(before, after)  # score-consistent pool
+    router.shutdown()
+
+
+# -- continuous batching -----------------------------------------------------
+
+
+def test_continuous_decode_interleaved_matches_alone():
+    """Slot-level admission never changes a generation: decoded alone
+    == decoded among churning neighbors, bitwise, with zero
+    decode-path compiles (one fixed-shape AOT arena program)."""
+    from sparknet_tpu.serve.continuous import ContinuousDecoder
+
+    alone = ContinuousDecoder(slots=4, seq_len=16, vocab=32, seed=0)
+    t_alone = alone.submit([1, 2, 3], 8)
+    alone.run()
+
+    churn = ContinuousDecoder(slots=4, seq_len=16, vocab=32, seed=0)
+    for i in range(6):  # staggered lengths force slot churn
+        churn.submit([5 + i], 4 + i)
+    t_mix = churn.submit([1, 2, 3], 8)
+    churn.run()
+
+    assert t_alone.wait(5.0) == t_mix.wait(5.0)
+    assert churn.decode_path_compiles == 0
+    stats = churn.stats()
+    assert stats["admitted"] == 7 > churn.slots  # slots were reused
+    assert stats["completed"] == 7
+
+
+@pytest.mark.smoke
+def test_continuous_decoder_validates_submits():
+    from sparknet_tpu.serve.continuous import ContinuousDecoder
+
+    with pytest.raises(ValueError, match="slots"):
+        ContinuousDecoder(slots=1)
+    d = ContinuousDecoder(slots=2, seq_len=8, vocab=16, seed=0)
+    with pytest.raises(ValueError, match="non-empty"):
+        d.submit([], 4)
+    with pytest.raises(ValueError, match="outside"):
+        d.submit([99], 4)
+    with pytest.raises(ValueError, match="positive"):
+        d.submit([1], 0)
+
+
+@pytest.mark.smoke
+def test_obs_report_renders_replica_section():
+    """The obs report grows a replica-pool section: membership, the
+    re-routed-ticket ledger on a kill, and the aggregate summary."""
+    from sparknet_tpu.obs import schema
+    from sparknet_tpu.obs.report import render
+
+    events = [
+        {"event": "run_start", "run_id": "pod",
+         "utc": "2026-08-05 00:00:00Z", "pid": 1},
+        {"event": "replica", "run_id": "pod",
+         "utc": "2026-08-05 00:00:01Z", "kind": "replica_up",
+         "replica": 3, "width": 4, "note": "elastic join"},
+        {"event": "replica", "run_id": "pod",
+         "utc": "2026-08-05 00:00:02Z", "kind": "replica_down",
+         "replica": 1, "width": 3, "rerouted": 10, "outstanding": 10,
+         "dropped": 0},
+        {"event": "replica", "run_id": "pod",
+         "utc": "2026-08-05 00:00:03Z", "kind": "rollout",
+         "replica": 0, "version": 2, "drained": 4},
+        {"event": "replica", "run_id": "pod",
+         "utc": "2026-08-05 00:00:04Z", "kind": "summary", "width": 4,
+         "requests": 504, "rps": 10860.0, "shed": 12, "dropped": 0,
+         "rerouted": 10},
+        {"event": "run_end", "run_id": "pod",
+         "utc": "2026-08-05 00:00:05Z", "rounds": 0, "spans": 0,
+         "compiles": 0},
+    ]
+    for ev in events:
+        assert schema.validate_line(ev) == [], ev
+    text = render(events, source="t")
+    assert "replica pool (pod-scale serving)" in text
+    assert "**UP** replica 3" in text
+    assert "10 in-flight ticket(s) re-routed" in text
+    assert "dropped 0" in text
+    assert "rollout replica 0 -> version 2" in text
+    assert "10860 req/s aggregate" in text

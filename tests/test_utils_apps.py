@@ -660,6 +660,9 @@ def test_cli_parse_log_roundtrip(tmp_path, monkeypatch, capsys):
     from sparknet_tpu.cli import main
 
     monkeypatch.chdir(tmp_path)
+    # Default log dir is the system tempdir; pin it to the sandbox to
+    # exercise the SPARKNET_TRAIN_LOG_DIR route and keep the glob local.
+    monkeypatch.setenv("SPARKNET_TRAIN_LOG_DIR", str(tmp_path))
     assert main([
         "train", "--solver", "zoo:lenet", "--batch", "8",
         "--data", "synthetic", "--iterations", "3",
