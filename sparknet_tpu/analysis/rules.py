@@ -1196,3 +1196,103 @@ def check_no_pkill(ctx: ModuleContext) -> Iterator[tuple[int, str]]:
                 "(exit 144, command never runs) — use "
                 "pgrep -f '/path/narrow[p]attern' and kill by pid",
             )
+
+
+# ---------------------------------------------------------------------------
+# obs-vocab-coverage
+# ---------------------------------------------------------------------------
+
+# The obs journal schema (sparknet_tpu/obs/schema.py EVENTS) is the
+# vocabulary three consumers must agree on: the emitters (schema-checked
+# at write time), the report renderer (obs/report.py), and the human
+# contract (docs/OBSERVABILITY.md).  A name added to EVENTS but not to
+# the renderer silently vanishes from every report; one missing from the
+# docs is an undocumented wire format.  Anchored on schema.py alone so
+# the finding lands once, at the offending EVENTS key's own line.
+_OBS_SCHEMA_SOURCE = "sparknet_tpu/obs/schema.py"
+_OBS_REPORT_REL = "sparknet_tpu/obs/report.py"
+_OBS_DOC_REL = "docs/OBSERVABILITY.md"
+
+
+def _obs_schema_rel(path: str) -> tuple[str, str] | None:
+    norm = os.path.abspath(path).replace(os.sep, "/")
+    idx = norm.rfind("/sparknet_tpu/")
+    if idx < 0:
+        return None
+    root, rel = norm[:idx], norm[idx + 1:]
+    if rel == _OBS_SCHEMA_SOURCE:
+        return root, rel
+    return None
+
+
+def _events_keys(tree: ast.AST) -> list[tuple[str, int]]:
+    """``(name, lineno)`` per string key of the module-level EVENTS
+    dict literal (plain or annotated assignment)."""
+    for node in ast.walk(tree):
+        target = None
+        if isinstance(node, ast.AnnAssign):
+            target, value = node.target, node.value
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target, value = node.targets[0], node.value
+        else:
+            continue
+        if (isinstance(target, ast.Name) and target.id == "EVENTS"
+                and isinstance(value, ast.Dict)):
+            return [(k.value, k.lineno) for k in value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)]
+    return []
+
+
+@rule(
+    "obs-vocab-coverage",
+    "every obs schema event name must be rendered by obs/report.py (as "
+    "a quoted literal) and documented in docs/OBSERVABILITY.md (as a "
+    "backticked term)",
+)
+def check_obs_vocab_coverage(ctx: ModuleContext) -> Iterator[tuple[int, str]]:
+    """Vocabulary drift guard for the obs journal.  For each key of
+    schema.py's EVENTS dict: ``obs/report.py`` must contain the name as
+    a quoted string literal (``"name"`` or ``'name'`` — how the
+    renderer dispatches on ``ev.get("event")``), and
+    ``docs/OBSERVABILITY.md`` must contain it backticked (the event
+    vocabulary table).  Resolved from this file's own repo root, so
+    fixture trees exercise both directions without touching the real
+    repo.  Blind spot (deliberate): a literal inside a dead branch of
+    report.py satisfies the check — renderer CORRECTNESS is pinned by
+    the golden-report test, not a lint heuristic.
+    """
+    hit = _obs_schema_rel(ctx.path)
+    if hit is None:
+        return
+    root, rel = hit
+    names = _events_keys(ctx.tree)
+    if not names:
+        yield (1, f"{rel} declares no parseable module-level EVENTS "
+                  "dict literal — the vocabulary-coverage contract "
+                  "has nothing to check")
+        return
+    consumers = []
+    for crel in (_OBS_REPORT_REL, _OBS_DOC_REL):
+        try:
+            with open(os.path.join(root, crel), encoding="utf-8") as f:
+                consumers.append((crel, f.read()))
+        except OSError:
+            yield (1, f"{crel} missing or unreadable next to {rel} — "
+                      "every EVENTS name must be rendered and "
+                      "documented there")
+            consumers.append((crel, None))
+    for name, lineno in names:
+        for crel, text in consumers:
+            if text is None:
+                continue
+            hits = (f'"{name}"' in text or f"'{name}'" in text
+                    if crel == _OBS_REPORT_REL else f"`{name}`" in text)
+            if not hits:
+                what = ("rendered as a quoted literal"
+                        if crel == _OBS_REPORT_REL
+                        else "documented as a backticked term")
+                yield (lineno, f"obs event {name!r} is in the schema "
+                               f"vocabulary but not {what} in {crel} — "
+                               "events must never silently vanish from "
+                               "reports or docs")

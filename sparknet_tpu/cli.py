@@ -1945,6 +1945,13 @@ def main(argv=None) -> int:
         help="force a jax platform (cpu/tpu); the config route wins over "
         "JAX_PLATFORMS when a site hook pins it",
     )
+    p.add_argument(
+        "--obs",
+        default="",
+        metavar="PATH.jsonl",
+        help="arm the obs journal for this run (same as SPARKNET_OBS=PATH; "
+        "off by default — the disabled path is bit-identical)",
+    )
     sub = p.add_subparsers(dest="cmd", required=True)
 
     def common(sp):
@@ -2243,6 +2250,10 @@ def main(argv=None) -> int:
         from sparknet_tpu.common import force_platform
 
         force_platform(args.platform)
+    if args.obs:
+        # env is the single arming point the Recorder (and any child
+        # process the brew spawns, e.g. a process feed) already reads
+        os.environ["SPARKNET_OBS"] = args.obs
     overrides = {}
     if getattr(args, "dtype", ""):
         # one application point for every brew that takes --dtype

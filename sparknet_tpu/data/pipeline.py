@@ -211,6 +211,14 @@ class BatchSource:
     def get(self, epoch: int, index: int) -> dict[str, np.ndarray]:
         raise NotImplementedError
 
+    def lineage_source(self) -> str | None:
+        """A deterministic identity string for lineage (obs/lineage.py):
+        together with a journal's ``(epoch, index)`` cursor range it
+        must pin exactly which records a feed window delivered.  None
+        (the default) means the source has no durable identity worth
+        journaling (synthetic feeds)."""
+        return None
+
 
 class DataFnSource(BatchSource):
     """Wraps an INDEX-ADDRESSABLE ``data_fn(it) -> feeds`` (the solver
@@ -462,7 +470,8 @@ class _StageClock:
     with obs off — feed_bench reads its attribution there."""
 
     def __init__(self, name: str, workers: int, images_per_batch: int,
-                 every: int, totals: dict | None = None):
+                 every: int, totals: dict | None = None,
+                 source_id: str | None = None):
         from sparknet_tpu.obs import get_recorder
 
         self.rec = get_recorder()
@@ -470,13 +479,17 @@ class _StageClock:
         self.workers = workers
         self.images = images_per_batch
         self.every = max(int(every), 1)
+        self.source_id = source_id
         self.stages = {s: 0.0 for s in FEED_STAGES[:5]}
         self.totals = totals if totals is not None else {}
         self.batches = 0
         self._t0 = time.perf_counter()
+        self._first_g: int | None = None
+        self._last_g: int | None = None
 
     def add(self, slot_wait: float, source: float, decode: float,
-            transform: float, write: float) -> None:
+            transform: float, write: float,
+            g: int | None = None) -> None:
         for key, val in (("slot_wait", slot_wait), ("source", source),
                          ("decode", decode),
                          ("transform", transform), ("write", write)):
@@ -484,6 +497,10 @@ class _StageClock:
             self.totals[key] = self.totals.get(key, 0.0) + val
         self.totals["batches"] = self.totals.get("batches", 0) + 1
         self.batches += 1
+        if g is not None:
+            if self._first_g is None:
+                self._first_g = g
+            self._last_g = g
         if self.rec and self.batches % self.every == 0:
             self.flush()
 
@@ -491,6 +508,18 @@ class _StageClock:
         if not (self.rec and self.batches):
             return
         wall = time.perf_counter() - self._t0
+        fields: dict = {}
+        if self._first_g is not None and self._last_g is not None:
+            # lineage mint point: the window's global batch-index range
+            # — the same deterministic cursor (epoch, index) = divmod(g,
+            # batches_per_epoch) the ring workers decode, so any batch
+            # in the window is re-derivable from the journal alone
+            from sparknet_tpu.obs import lineage as obs_lineage
+
+            fields["lineage"] = obs_lineage.feed_lineage(
+                self.name, self._first_g, self._last_g)
+            if self.source_id:
+                fields["lineage"]["source"] = self.source_id
         self.rec.emit(
             "feed", name=self.name, batches=self.batches,
             images=self.batches * self.images,
@@ -498,11 +527,12 @@ class _StageClock:
             stages={k: round(v, 6) for k, v in self.stages.items()},
             images_per_sec=round(self.batches * self.images / wall, 1)
             if wall > 0 else 0.0,
-            workers=self.workers,
+            workers=self.workers, **fields,
         )
         self.stages = {s: 0.0 for s in FEED_STAGES[:5]}
         self.batches = 0
         self._t0 = time.perf_counter()
+        self._first_g = self._last_g = None
 
 
 class ProcessPipeline:
@@ -639,7 +669,8 @@ class ProcessPipeline:
         producer death; always safe to ``close()`` after."""
         clock = _StageClock(self.name, self.workers,
                             self._images_per_batch(), self._obs_every,
-                            totals=self.stats)
+                            totals=self.stats,
+                            source_id=self.source.lineage_source())
         pending, held = self._pending, self._held
         try:
             for g in range(self.start_index,
@@ -668,7 +699,7 @@ class ProcessPipeline:
                     # knows how many batches are owed
                 slot, (src_s, dec_s, tr_s, wr_s) = pending.pop(g)
                 clock.add(time.perf_counter() - t0, src_s, dec_s, tr_s,
-                          wr_s)
+                          wr_s, g=g)
                 held.append(slot)
                 while len(held) > self.hold:
                     self._release(held.pop(0))

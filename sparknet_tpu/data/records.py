@@ -283,6 +283,20 @@ class RecordShardSource(BatchSource):
             self._f.close()
             self._f = None
 
+    def lineage_source(self) -> str | None:
+        """The ring source's durable identity for lineage: names the
+        shard file and every :meth:`_record_ids` input except the
+        cursor itself, so a journal's ``(epoch, index)`` window plus
+        this string re-derives the exact record ids each batch
+        assembled — provenance down to the record, with zero runtime id
+        plumbing."""
+        import os
+
+        shuffle = f"seed{self.seed}" if self.shuffle else "off"
+        return (f"{self.backend}:{os.path.basename(self.path)}"
+                f"#batch={self.batch},stride={self.stride},"
+                f"offset={self.offset},shuffle={shuffle}")
+
     # -- the index walk -------------------------------------------------
     def _record_ids(self, epoch: int, index: int) -> np.ndarray:
         """The record ids batch (epoch, index) assembles — the

@@ -85,15 +85,23 @@ class ProductionLoop:
     def checkpoint(self) -> str:
         """Fold the elastic pool into the solver and snapshot it
         atomically; returns the npz path (the rollout's input)."""
+        from sparknet_tpu.obs import lineage as obs_lineage
+
         t0 = time.perf_counter()
         self.trainer.sync_to_solver()
         prefix = os.path.join(self.workdir,
                               f"round{self.trainer.round:05d}")
         path = self.trainer.solver.save(prefix)
         self.checkpoints += 1
+        # lineage: the artifact descends from the LAST round folded in
+        # (its span id recomputes deterministically — no plumbing);
+        # a zero-round checkpoint is seed-born, a root
+        parent = (obs_lineage.round_span("elastic", self.trainer.round - 1)
+                  if self.trainer.round > 0 else None)
         self._emit("checkpoint", round=self.trainer.round,
                    iteration=int(self.trainer.solver.iter), path=path,
                    wall_s=round(time.perf_counter() - t0, 6),
+                   lineage=obs_lineage.checkpoint_lineage(path, parent),
                    note="atomic npz (temp + os.replace) — pollers "
                         "never see a torn archive")
         return path
@@ -103,35 +111,53 @@ class ProductionLoop:
         telemetry, or None when admission pricing refuses the candidate
         (journaled; the incumbent keeps serving — refused, not fatal)."""
         from sparknet_tpu.loop.deploy import variables_from_checkpoint
+        from sparknet_tpu.obs import lineage as obs_lineage
         from sparknet_tpu.serve.engine import AdmissionRefused
 
         t0 = time.perf_counter()
+        ckpt_span = obs_lineage.checkpoint_span(path)
         variables = variables_from_checkpoint(path)
         self._emit("candidate", arm=self.arm, path=path,
-                   round=self.trainer.round)
+                   round=self.trainer.round,
+                   lineage={"span": obs_lineage.candidate_span(path),
+                            "parent": ckpt_span})
         try:
-            candidate = self.engine.build_candidate(
-                self.serve_name, family=self.family, arm=self.arm,
-                buckets=self.buckets, variables=variables)
+            # ambient lineage: the engine's own serve events
+            # (candidate_built / rollout) adopt the checkpoint as
+            # parent without the engine API growing checkpoint params
+            with obs_lineage.ambient(ckpt_span):
+                candidate = self.engine.build_candidate(
+                    self.serve_name, family=self.family, arm=self.arm,
+                    buckets=self.buckets, variables=variables)
         except AdmissionRefused as refusal:
             self._emit("refused", arm=self.arm, path=path,
                        round=self.trainer.round,
+                       lineage={"span": obs_lineage.candidate_span(path),
+                                "parent": ckpt_span},
                        note=str(refusal))
             return None
-        info = self.engine.swap_model(self.serve_name, candidate)
+        with obs_lineage.ambient(ckpt_span):
+            info = self.engine.swap_model(self.serve_name, candidate)
         self.rollouts += 1
         self._emit("rollout", arm=self.arm, path=path,
                    round=self.trainer.round, version=info["version"],
                    drained=info["drained"],
+                   lineage={"span": obs_lineage.generation_span(
+                                self.serve_name, info["version"]),
+                            "parent": ckpt_span},
                    wall_s=round(time.perf_counter() - t0, 6))
         return info
 
     def rollback(self):
         """Restore the previous serving generation (bitwise — the same
         retained ``ServedModel``); returns it."""
+        from sparknet_tpu.obs import lineage as obs_lineage
+
         prev = self.engine.rollback(self.serve_name)
         self.rollbacks += 1
         self._emit("rollback", version=prev.version,
+                   lineage={"span": obs_lineage.generation_span(
+                       self.serve_name, prev.version)},
                    note="previous generation restored bitwise")
         return prev
 

@@ -1,12 +1,24 @@
-"""obs CLI: ``python -m sparknet_tpu.obs {report|validate|dryrun} ...``.
+"""obs CLI: ``python -m sparknet_tpu.obs
+{report|validate|slo|top|dryrun} ...``.
 
-* ``report <journal> [--out f.md]`` — render a journal to markdown
-  (refuses unstamped walls; never prints a throughput above its stated
-  roofline bound).
+* ``report <journal> [--out f.md] [--lineage]`` — render a journal to
+  markdown (refuses unstamped walls; never prints a throughput above
+  its stated roofline bound).  ``--lineage`` appends the causal-span
+  audit and the parent/child waterfalls for the last round and the
+  last request (obs/lineage.py).
 * ``validate [journals...]`` — schema-check journal files; with no
-  arguments, every ``docs/evidence_r*/journal.jsonl`` in the repo.
-  Legacy deviations pass only via the explicit allowlist in
+  arguments, every ``docs/evidence_r*/*.jsonl`` in the repo — the
+  runner's ``journal.jsonl`` AND the banked per-job journals next to
+  it.  Legacy deviations pass only via the explicit allowlist in
   ``obs/schema.py``.  Exit 1 on any non-allowlisted violation.
+* ``slo [journals...] [--manifest f.json]`` — evaluate the declarative
+  SLO manifest (``docs/slo_manifest.json``) against journal(s); same
+  default discovery as ``validate``.  Gates with no subject events
+  pass vacuously (and say so); exit 1 on any burn.
+* ``top <journal> [--interval s] [--once]`` — live-tail a GROWING
+  journal: each poll folds only the newly appended complete lines into
+  streaming metrics (obs/metrics.py) and repaints one summary frame —
+  bounded memory however long the run.
 * ``dryrun [--out p] [--rounds N] [--elastic]`` — the zero-chip-time
   proof: run dp (tau=1 sync SGD) and tau (SparkNet averaging) rounds on
   the virtual 8-device CPU mesh with the Recorder armed, producing a
@@ -48,13 +60,15 @@ def report_main(argv: list[str]) -> int:
         description="render an obs journal to markdown")
     ap.add_argument("journal")
     ap.add_argument("--out", help="write here instead of stdout")
+    ap.add_argument("--lineage", action="store_true",
+                    help="append the causal-span audit + waterfalls")
     args = ap.parse_args(argv)
     if not os.path.exists(args.journal):
         print(f"no such journal: {args.journal}", file=sys.stderr)
         return 2
     from sparknet_tpu.obs.report import render_path
 
-    text = render_path(args.journal)
+    text = render_path(args.journal, lineage=args.lineage)
     if args.out:
         with open(args.out, "w", encoding="utf-8") as f:
             f.write(text)
@@ -68,13 +82,13 @@ def validate_main(argv: list[str]) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m sparknet_tpu.obs validate",
         description="schema-check journal files (default: every "
-        "docs/evidence_r*/journal.jsonl)")
+        "docs/evidence_r*/*.jsonl — runner journals AND banked "
+        "per-job journals)")
     ap.add_argument("journals", nargs="*")
     args = ap.parse_args(argv)
     from sparknet_tpu.obs import schema
 
-    paths = args.journals or sorted(glob.glob(
-        os.path.join(_REPO, "docs", "evidence_r*", "journal.jsonl")))
+    paths = args.journals or _discover_journals()
     if not paths:
         print("no journals found", file=sys.stderr)
         return 2
@@ -93,6 +107,152 @@ def validate_main(argv: list[str]) -> int:
             print(f"  {err}")
         if errors:
             rc = 1
+    return rc
+
+
+def _discover_journals() -> list[str]:
+    """Every evidence journal in the repo: each round's runner
+    ``journal.jsonl`` plus the banked per-job journals next to it
+    (``docs/evidence_r*/[!j]*.jsonl`` — e.g. the dryrun journals the
+    r7 setup jobs bank)."""
+    return sorted(glob.glob(
+        os.path.join(_REPO, "docs", "evidence_r*", "*.jsonl")))
+
+
+def slo_main(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m sparknet_tpu.obs slo",
+        description="evaluate the declarative SLO manifest "
+        "(docs/slo_manifest.json) against journal(s); default: every "
+        "docs/evidence_r*/*.jsonl.  Exit 1 on any burn.")
+    ap.add_argument("journals", nargs="*")
+    ap.add_argument("--manifest", help="alternate manifest path")
+    ap.add_argument("--quiet", action="store_true",
+                    help="verdict lines only, no per-gate detail")
+    args = ap.parse_args(argv)
+    from sparknet_tpu.obs import slo
+
+    manifest_path = args.manifest or slo.default_manifest_path()
+    manifest = slo.load_manifest(manifest_path)
+    paths = args.journals or _discover_journals()
+    if not paths:
+        print("no journals found", file=sys.stderr)
+        return 2
+    rc = 0
+    for path in paths:
+        try:
+            results = slo.evaluate_journal(path, manifest)
+        except OSError as e:
+            print(f"{path}: unreadable ({e})", file=sys.stderr)
+            rc = 1
+            continue
+        burned = [r["id"] for r in results if not r["ok"]]
+        applicable = sum(1 for r in results if r["applicable"])
+        status = "OK" if not burned else "BURN"
+        print(f"{status} {path}: {applicable}/{len(results)} gate(s) "
+              "applicable")
+        if not args.quiet:
+            for r in results:
+                mark = "pass" if r["ok"] else "BURN"
+                scope = "" if r["applicable"] else " (vacuous)"
+                print(f"  [{mark}] {r['id']}{scope}: {r['detail']}")
+        if burned:
+            rc = 1
+    return rc
+
+
+def top_main(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m sparknet_tpu.obs top",
+        description="live-tail a growing journal into streaming "
+        "metrics: each poll folds only newly appended complete lines "
+        "(obs/metrics.py JournalTail) — bounded memory at any run "
+        "length")
+    ap.add_argument("journal")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="seconds between polls (default 2)")
+    ap.add_argument("--once", action="store_true",
+                    help="one poll, one frame, exit (tests/CI)")
+    ap.add_argument("--frames", type=int, default=0,
+                    help="exit after N frames (0 = until Ctrl-C)")
+    args = ap.parse_args(argv)
+    import time
+
+    from sparknet_tpu.obs import metrics as obs_metrics
+
+    tail = obs_metrics.JournalTail(args.journal)
+    # fold-only hub: the flush clock never fires (top reads state
+    # directly; it must not mint metrics events for someone's journal)
+    hub = obs_metrics.MetricsHub(flush_every=1 << 62)
+    folded = 0
+    frames = 0
+    try:
+        while True:
+            for ev in tail.poll():
+                kind = ev.get("event")
+                if isinstance(kind, str):
+                    hub.observe_event(kind, ev)
+                    folded += 1
+            frames += 1
+            print(_top_frame(args.journal, folded, hub), flush=True)
+            if args.once or (args.frames and frames >= args.frames):
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+def _top_frame(path: str, folded: int, hub) -> str:
+    from sparknet_tpu.obs import metrics as obs_metrics
+
+    lines = [f"== obs top {path} — {folded} event(s) folded =="]
+    for name in sorted(hub.counters):
+        value = hub.counters[name]
+        lines.append(f"  {name} = {value:g}")
+    for name in sorted(hub.gauges):
+        lines.append(f"  {name} ~ {hub.gauges[name]:g} (gauge)")
+    for name in sorted(hub.hists):
+        snap = hub.hists[name].snapshot()
+        p50 = obs_metrics.percentile(snap, 50)
+        p99 = obs_metrics.percentile(snap, 99)
+        lines.append(
+            f"  {name}: n={snap['count']} p50={p50:.3f} "
+            f"p99={p99:.3f} max={snap['max']:.3f}")
+    if len(lines) == 1:
+        lines.append("  (no metric-bearing events yet)")
+    return "\n".join(lines)
+
+
+def _dryrun_gates(path: str) -> int:
+    """The post-dryrun machine gates (dryrun modes 17-20 acceptance):
+    zero schema findings AND a clean lineage audit — every parent ref
+    in the journal resolves to a defined span or a declared root."""
+    from sparknet_tpu.obs import lineage, schema
+
+    rc = 0
+    n, _allowed, errors = schema.validate_journal(path)
+    if errors:
+        print(f"obs dryrun: SCHEMA FAIL — {len(errors)} finding(s) "
+              f"over {n} line(s):", file=sys.stderr)
+        for err in errors[:20]:
+            print(f"  {err}", file=sys.stderr)
+        rc = 1
+    else:
+        print(f"obs dryrun: schema clean over {n} line(s)",
+              file=sys.stderr)
+    verdict = lineage.audit(schema.stream_journal(path))
+    if verdict["dangling"]:
+        print(f"obs dryrun: LINEAGE FAIL — "
+              f"{len(verdict['dangling'])} dangling ref(s):",
+              file=sys.stderr)
+        for ref in verdict["dangling"][:20]:
+            print(f"  {ref}", file=sys.stderr)
+        rc = 1
+    else:
+        print(f"obs dryrun: lineage complete — {verdict['spans']} "
+              f"span(s), {verdict['edges']} edge(s), "
+              f"{verdict['requests_linked']} request(s) linked",
+              file=sys.stderr)
     return rc
 
 
@@ -182,7 +342,7 @@ def dryrun_main(argv: list[str]) -> int:
             f"{summary['continuous_exact']}")
         print(f"obs dryrun: journal at {args.out} — render with "
               f"`python -m sparknet_tpu.obs report {args.out}`")
-        return 0 if summary["ok"] else 1
+        return 0 if summary["ok"] and _dryrun_gates(args.out) == 0 else 1
 
     if args.loop:
         from sparknet_tpu.loop.dryrun import loop_run
@@ -206,7 +366,7 @@ def dryrun_main(argv: list[str]) -> int:
             f"journaled: {summary['refused']}")
         print(f"obs dryrun: journal at {args.out} — render with "
               f"`python -m sparknet_tpu.obs report {args.out}`")
-        return 0 if summary["ok"] else 1
+        return 0 if summary["ok"] and _dryrun_gates(args.out) == 0 else 1
 
     if args.serve:
         from sparknet_tpu.serve.loadgen import load_run
@@ -226,7 +386,8 @@ def dryrun_main(argv: list[str]) -> int:
             f"{summary['refused']}")
         print(f"obs dryrun: journal at {args.out} — render with "
               f"`python -m sparknet_tpu.obs report {args.out}`")
-        return 0 if summary["compiles_post_warmup"] == 0 else 1
+        return 0 if summary["compiles_post_warmup"] == 0 \
+            and _dryrun_gates(args.out) == 0 else 1
 
     import jax
     import numpy as np
@@ -286,12 +447,13 @@ def dryrun_main(argv: list[str]) -> int:
     set_recorder(None)
     print(f"obs dryrun: journal at {args.out} — render with "
           f"`python -m sparknet_tpu.obs report {args.out}`")
-    return 0
+    return _dryrun_gates(args.out)
 
 
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     commands = {"report": report_main, "validate": validate_main,
+                "slo": slo_main, "top": top_main,
                 "dryrun": dryrun_main}
     if not argv or argv[0] not in commands:
         print(__doc__)

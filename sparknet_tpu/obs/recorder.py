@@ -28,6 +28,7 @@ import threading
 import time
 
 from sparknet_tpu.obs import schema
+from sparknet_tpu.obs.metrics import MetricsHub
 from sparknet_tpu.obs.sentinel import get_sentinel
 
 __all__ = ["Recorder", "Span", "get_recorder", "set_recorder"]
@@ -105,11 +106,18 @@ class Span:
 class Recorder:
     """Append-only JSONL journal of schema-validated obs events."""
 
-    def __init__(self, path: str | None, run_id: str | None = None):
+    def __init__(self, path: str | None, run_id: str | None = None,
+                 metrics_flush_every: int = 256):
         self.path = path
         self.enabled = bool(path)
         self._lock = threading.Lock()
         self._started = False
+        # the streaming-metrics hub: every journaled event is folded
+        # into bounded counters/histograms in-process, and the
+        # cumulative state flushes as a periodic ``metrics`` snapshot
+        # event (obs/metrics.py) — so reports and `obs top` never need
+        # the raw request lines
+        self._hub = MetricsHub(metrics_flush_every) if path else None
         self._n_rounds = 0
         self._n_spans = 0
         self._ema: dict[str, float] = {}
@@ -160,6 +168,23 @@ class Recorder:
                     argv=list(sys.argv))
                 self._write(json.dumps(start))
             self._write(payload)
+            self._fold_locked(event, fields)
+
+    def _fold_locked(self, event: str, fields: dict) -> None:
+        """Fold one just-journaled event into the metrics hub and write
+        the periodic ``metrics`` snapshot when one is due (caller holds
+        the lock; the snapshot line is written directly, not re-folded).
+        """
+        if self._hub is None or event == "metrics":
+            return
+        try:
+            snap = self._hub.observe_event(event, fields)
+            if snap:
+                mline = schema.make_event(
+                    "metrics", run_id=self.run_id, **snap)
+                self._write(json.dumps(mline))
+        except Exception as e:  # telemetry must not take the run down
+            print(f"obs: metrics fold failed: {e}", file=sys.stderr)
 
     def _write(self, payload: str) -> None:
         try:
@@ -185,11 +210,16 @@ class Recorder:
     def round(self, *, mode: str, tau: int, devices: int, iters: int,
               batch: int, wall_s: float, loss: float, fenced: bool,
               comm: dict | None = None, iteration: int | None = None,
-              workers: int | None = None) -> None:
+              workers: int | None = None, lineage: dict | None = None,
+              expected_compiles: bool = False) -> None:
         """One per-round training record.  ``batch`` is images per local
         step; throughput is ``iters * batch / wall_s``.  Also drives the
         recompile sentinel: any backend compilation between rounds of an
-        already-warm mode is flagged live as a ``recompile`` event."""
+        already-warm mode is flagged live as a ``recompile`` event —
+        ``expected_compiles=True`` lets a caller that KNOWS this round
+        built a new program (the elastic trainer compiling its first
+        round at an unseen mesh width) stamp the event ``expected`` so
+        the compiles-zero SLO gate does not count it as a burn."""
         if not self.enabled:
             return
         loss = float(loss)
@@ -204,7 +234,7 @@ class Recorder:
         if compiles > 0 and mode in self._warm_modes:
             self.emit("recompile", count=compiles,
                       total=total - self._compiles0, where=mode,
-                      expected=False)
+                      expected=bool(expected_compiles))
         self._warm_modes.add(mode)
 
         images_per_sec = (iters * batch / wall_s) if wall_s > 0 else 0.0
@@ -222,6 +252,8 @@ class Recorder:
             fields["iteration"] = int(iteration)
         if workers is not None:
             fields["workers"] = int(workers)
+        if lineage is not None:
+            fields["lineage"] = lineage
         self._n_rounds += 1
         self.emit("round", **fields)
 
@@ -259,9 +291,14 @@ class Recorder:
         self.emit("bank", **fields)
 
     def close(self) -> None:
-        """Emit the run summary (idempotent enough for atexit use)."""
+        """Emit the final metrics snapshot and the run summary
+        (idempotent enough for atexit use)."""
         if not self.enabled or not self._started:
             return
+        if self._hub is not None:
+            snap = self._hub.flush_fields()
+            if snap:
+                self.emit("metrics", **snap)
         self.emit("run_end", rounds=self._n_rounds, spans=self._n_spans,
                   compiles=self.sentinel.count - self._compiles0)
 

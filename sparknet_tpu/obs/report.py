@@ -14,13 +14,33 @@ load-bearing:
   whose value exceeds its own ``roofline_img_s_upper_bound`` (or that
   carries a ``bound_inconsistency``) renders as a named conflict, never
   as a headline number (CLAUDE.md: no value above its stated roofline).
+
+Memory is bounded: ``request`` events are folded into fixed-boundary
+log-bucket histograms (obs/metrics.py) as they stream past — the
+latency table is O(models x buckets), never O(requests), so a pod-scale
+journal with 10k+ request lines renders in constant space.  Every event
+name in the schema vocabulary renders somewhere in this module (the
+``obs-vocab-coverage`` lint rule machine-checks that), including the
+window-runner ledger events that used to be tunnel_log.py-only.
+``--lineage`` adds the causal waterfall (obs/lineage.py): the last
+round and the last request walked up their parent edges to a root.
 """
 
 from __future__ import annotations
 
+from typing import Iterable
+
+from sparknet_tpu.obs import metrics as obs_metrics
 from sparknet_tpu.obs import schema
 
 __all__ = ["render", "render_path"]
+
+# window-runner events carry no run_id: they are the host-side evidence
+# ledger (tools/tpu_window_runner.py) and render as one flat timeline
+_RUNNER_EVENTS = ("runner_start", "dial_start", "dial_end",
+                  "dial_abandoned", "job_start", "job_end",
+                  "queue_reload_failed", "preflight_oom", "setup_failed",
+                  "slo", "runner_done")
 
 
 def _fmt_comm(comm: dict) -> str:
@@ -137,14 +157,6 @@ def _member_rows(members: list[dict]) -> list[str]:
             f"| {ev.get('round', '?')} | {kind} | {worker} "
             f"| {width} | {detail} |")
     return lines
-
-
-def _pct(values: list[float], q: float) -> float:
-    """Nearest-rank percentile (same convention as serve/engine.py:
-    no value is interpolated into existence between real samples)."""
-    ordered = sorted(values)
-    rank = max(1, -(-int(q * len(ordered)) // 100))
-    return ordered[min(rank, len(ordered)) - 1]
 
 
 def _serve_lines(serves: list[dict]) -> list[str]:
@@ -286,32 +298,232 @@ def _loop_lines(loops: list[dict]) -> list[str]:
     return lines
 
 
-def _request_rows(requests: list[dict]) -> list[str]:
-    """The per-request latency histogram, rolled up per model x bucket:
-    p50/p99 totals plus the stage decomposition's tails.  Host+device
+class _RequestAgg:
+    """Bounded-memory ``request`` roll-up per model x bucket: three
+    fixed-boundary log-bucket histograms (obs/metrics.py) plus two
+    counters — O(groups x buckets) however many requests stream past.
+    Estimates carry the Histogram contract: within one bucket width
+    (~5.93% relative) of exact nearest-rank, never under a tail."""
+
+    __slots__ = ("groups",)
+
+    def __init__(self) -> None:
+        self.groups: dict[tuple, dict] = {}
+
+    def fold(self, ev: dict) -> None:
+        key = (str(ev.get("model", "?")), int(ev.get("bucket", 0)))
+        grp = self.groups.get(key)
+        if grp is None:
+            grp = self.groups[key] = {
+                "n": 0, "total": obs_metrics.Histogram(),
+                "queue": obs_metrics.Histogram(),
+                "device": obs_metrics.Histogram(),
+                "deadline": 0, "padded": 0}
+        grp["n"] += 1
+        grp["total"].observe(float(ev.get("total_ms", 0)))
+        grp["queue"].observe(float(ev.get("queue_wait_ms", 0)))
+        grp["device"].observe(float(ev.get("device_ms", 0)))
+        if ev.get("deadline_flush"):
+            grp["deadline"] += 1
+        if ev.get("padded"):
+            grp["padded"] += 1
+
+
+def _request_rows(agg: _RequestAgg) -> list[str]:
+    """The per-request latency roll-up per model x bucket: p50/p99
+    totals plus the stage decomposition's tails, read off log-bucket
+    histograms — never a buffered list of raw requests.  Host+device
     walls measured engine-side; the device stage is fence-stamped by its
     serve_device span."""
-    groups: dict[tuple, list[dict]] = {}
-    for ev in requests:
-        groups.setdefault((str(ev.get("model", "?")),
-                           int(ev.get("bucket", 0))), []).append(ev)
     lines = [
+        "Log-bucket estimates (obs/metrics.py: within ~5.93% of exact "
+        "nearest-rank, exact at the extremes, never under a tail).",
+        "",
         "| model | bucket | requests | p50 total ms | p99 total ms "
         "| p99 queue ms | p50 device ms | deadline flushes | padded |",
         "|---|---|---|---|---|---|---|---|---|",
     ]
-    for (model, bucket) in sorted(groups):
-        evs = groups[(model, bucket)]
-        totals = [float(e.get("total_ms", 0)) for e in evs]
-        queues = [float(e.get("queue_wait_ms", 0)) for e in evs]
-        devices = [float(e.get("device_ms", 0)) for e in evs]
-        deadline = sum(1 for e in evs if e.get("deadline_flush"))
-        padded = sum(1 for e in evs if e.get("padded"))
+    for (model, bucket) in sorted(agg.groups):
+        grp = agg.groups[(model, bucket)]
+        p50t = obs_metrics.percentile(grp["total"].snapshot(), 50)
+        p99t = obs_metrics.percentile(grp["total"].snapshot(), 99)
+        p99q = obs_metrics.percentile(grp["queue"].snapshot(), 99)
+        p50d = obs_metrics.percentile(grp["device"].snapshot(), 50)
         lines.append(
-            f"| {model} | {bucket} | {len(evs)} "
-            f"| {_pct(totals, 50):.3f} | {_pct(totals, 99):.3f} "
-            f"| {_pct(queues, 99):.3f} | {_pct(devices, 50):.3f} "
-            f"| {deadline} | {padded} |")
+            f"| {model} | {bucket} | {grp['n']} "
+            f"| {p50t:.3f} | {p99t:.3f} "
+            f"| {p99q:.3f} | {p50d:.3f} "
+            f"| {grp['deadline']} | {grp['padded']} |")
+    return lines
+
+
+def _metrics_lines(ev: dict) -> list[str]:
+    """One cumulative streaming-metrics snapshot — the run's LAST (hub
+    state is cumulative, so the last flush supersedes; merging is for
+    ACROSS runs): counters, gauges, per-histogram percentile estimates."""
+    lines = [f"Cumulative snapshot seq {ev.get('seq', '?')} "
+             "(the last flush of the run supersedes earlier ones)."]
+    counters = ev.get("counters") or {}
+    gauges = ev.get("gauges") or {}
+    hists = ev.get("hists") or {}
+    if counters or gauges:
+        lines += ["", "| metric | kind | value |", "|---|---|---|"]
+        for name in sorted(counters):
+            value = counters[name]
+            cell = f"{value:g}" if isinstance(value, float) else str(value)
+            lines.append(f"| {name} | counter | {cell} |")
+        for name in sorted(gauges):
+            lines.append(f"| {name} | gauge | {gauges[name]:g} |")
+    if hists:
+        lines += ["", "| histogram | count | p50 | p99 | min | max |",
+                  "|---|---|---|---|---|---|"]
+        for name in sorted(hists):
+            snap = hists[name]
+            cells = [obs_metrics.percentile(snap, 50),
+                     obs_metrics.percentile(snap, 99),
+                     snap.get("min"), snap.get("max")]
+            shown = " | ".join(
+                "—" if c is None else f"{c:.3f}" for c in cells)
+            lines.append(f"| {name} | {snap.get('count', 0)} "
+                         f"| {shown} |")
+    return lines
+
+
+def _slo_lines(ev: dict) -> list[str]:
+    """One SLO verdict (obs/slo.py, journaled by the window runner):
+    which gates were applicable, and the burn list when any failed."""
+    burned = ev.get("burned") or []
+    verdict = "PASS" if ev.get("ok") else "**BURNED**"
+    detail = ("" if not burned
+              else " — burned: " + ", ".join(f"`{b}`" for b in burned))
+    src = f" over `{ev.get('journal')}`" if ev.get("journal") else ""
+    return [f"- SLO {verdict} `{ev.get('job', '?')}`: "
+            f"{ev.get('applicable', 0)}/{ev.get('gates', 0)} gate(s) "
+            f"applicable{src}{detail}"]
+
+
+def _runner_lines(events: list[dict]) -> list[str]:
+    """The window-runner evidence ledger (tools/tpu_window_runner.py):
+    dials, jobs, refusals, and per-job SLO verdicts — rendered here so
+    one report covers a whole evidence journal, not only Recorder runs
+    (tools/tunnel_log.py stays the round-narrative renderer)."""
+    lines = []
+    for ev in events:
+        kind = ev.get("event", "?")
+        if kind == "runner_start":
+            jobs = ev.get("jobs") or []
+            lines.append(
+                f"- runner start: queue `{ev.get('queue', '?')}`, "
+                f"{len(jobs)} job(s)")
+        elif kind == "dial_start":
+            lines.append(f"- dial (probe {ev.get('probe', '?')}) started")
+        elif kind == "dial_end":
+            if ev.get("ok"):
+                lines.append(
+                    f"- dial (probe {ev.get('probe', '?')}): backend "
+                    f"`{ev.get('platform') or '?'}` up in "
+                    f"{ev.get('dt_s', 0):.1f} s")
+            else:
+                lines.append(
+                    f"- dial (probe {ev.get('probe', '?')}): DEAD after "
+                    f"{ev.get('dt_s', 0):.1f} s — "
+                    f"{ev.get('error') or 'no backend'}")
+        elif kind == "dial_abandoned":
+            lines.append(
+                f"- dial (probe {ev.get('probe', '?')}) abandoned — "
+                f"{ev.get('note', '?')}")
+        elif kind == "job_start":
+            setup = " [setup]" if ev.get("setup") else ""
+            lines.append(
+                f"- job `{ev.get('job', '?')}`{setup} started "
+                f"(deadline {ev.get('deadline_s', 0):g} s)")
+        elif kind == "job_end":
+            status = ("TIMED OUT" if ev.get("timed_out")
+                      else f"rc {ev.get('rc')}")
+            death = " — window death" if ev.get("window_death") else ""
+            lines.append(
+                f"- job `{ev.get('job', '?')}`: {status} in "
+                f"{ev.get('dt_s', 0):.1f} s{death}")
+        elif kind == "queue_reload_failed":
+            lines.append(
+                f"- **queue reload FAILED**: {ev.get('error', '?')} "
+                "(runner kept the previous queue)")
+        elif kind == "preflight_oom":
+            lines.append(
+                f"- **preflight OOM refusal** `{ev.get('job', '?')}`: "
+                f"{ev.get('model', '?')} batch {ev.get('batch', '?')} "
+                f"{ev.get('dtype', '?')} predicts "
+                f"{ev.get('predicted_bytes', 0):,} B against the "
+                f"{ev.get('budget_bytes', 0):,} B budget — refused "
+                "without burning a dial")
+        elif kind == "setup_failed":
+            lines.append(
+                f"- **setup FAILED** `{ev.get('job', '?')}`: "
+                f"{ev.get('note', '?')}")
+        elif kind == "slo":
+            lines += _slo_lines(ev)
+        elif kind == "runner_done":
+            lines.append(f"- runner done: {ev.get('reason', '?')}")
+    return lines
+
+
+def _waterfall_lines(defining: list[dict], lin: dict,
+                     label: str) -> list[str]:
+    """One causal chain (obs/lineage.py chain) as an indented list:
+    child first, each hop naming the event that defined its span."""
+    from sparknet_tpu.obs import lineage as obs_lineage
+
+    lines = ["", f"### waterfall — {label}", ""]
+    for depth, hop in enumerate(obs_lineage.chain(defining, lin)):
+        attrs = hop.get("attrs")
+        bits = []
+        if isinstance(attrs, dict):
+            bits = [f"{key}={attrs[key]}" for key in sorted(attrs)
+                    if key not in ("span", "parent")]
+        extra = f" ({', '.join(bits)})" if bits else ""
+        origin = f" [{hop['event']}]" if hop.get("event") else ""
+        span = hop.get("span") or label
+        dangling = (" — DANGLING (parent never defined)"
+                    if attrs is None else "")
+        lines.append(f"- {'  ' * depth}`{span}`{origin}{extra}{dangling}")
+    return lines
+
+
+def _lineage_section(defining: list[dict], last_round: dict | None,
+                     last_request_lin: dict | None,
+                     requests_linked: int,
+                     request_parents: set[str]) -> list[str]:
+    """The ``--lineage`` view: audit roll-up plus two waterfalls — the
+    last round back to its shard range, the last request back through
+    its serve generation / checkpoint / round to a root."""
+    from sparknet_tpu.obs import lineage as obs_lineage
+
+    verdict = obs_lineage.audit(defining)
+    defined = obs_lineage.spans(defining)
+    dangling = list(verdict["dangling"])
+    for parent in sorted(request_parents):
+        if parent not in defined and not parent.startswith(
+                obs_lineage.ROOT_PREFIXES):
+            dangling.append(f"request -> {parent}")
+    lines = [
+        "", "## lineage (causal spans)", "",
+        f"- {verdict['spans']} defined span(s), {verdict['edges']} "
+        "parent edge(s) between producer events",
+        f"- {requests_linked} request(s) linked across "
+        f"{len(request_parents)} generation parent(s)",
+    ]
+    if dangling:
+        lines.append(f"- **{len(dangling)} dangling ref(s)**: "
+                     + ", ".join(f"`{d}`" for d in dangling))
+    else:
+        lines.append("- dangling refs: none — lineage-complete")
+    if last_round is not None and isinstance(
+            last_round.get("lineage"), dict):
+        lines += _waterfall_lines(defining, last_round["lineage"],
+                                  "last round")
+    if last_request_lin is not None:
+        lines += _waterfall_lines(defining, last_request_lin,
+                                  "last request")
     return lines
 
 
@@ -367,9 +579,12 @@ def _bank_lines(banks: list[dict]) -> list[str]:
     return lines
 
 
-def render(events: list[dict], source: str = "journal") -> str:
+def render(events: Iterable[dict], source: str = "journal",
+           lineage: bool = False) -> str:
     """Deterministic markdown for one journal's events (pure function of
-    its input — the golden test depends on that)."""
+    its input — the golden test depends on that).  ``events`` may be a
+    generator: the pass is single, and ``request`` lines fold into
+    histograms instead of buffering."""
     lines = [
         f"# obsnet run report — {source}",
         "",
@@ -381,27 +596,58 @@ def render(events: list[dict], source: str = "journal") -> str:
     ]
     runs: list[str] = []
     by_run: dict[str, dict[str, list]] = {}
+    runner_events: list[dict] = []
+    request_aggs: dict[str, _RequestAgg] = {}
+    last_round: dict | None = None
+    last_request_lin: dict | None = None
+    requests_linked = 0
+    request_parents: set[str] = set()
     for ev in events:
+        kind = ev.get("event")
         run_id = ev.get("run_id")
         if run_id is None:
-            continue  # window-runner events render via tools/tunnel_log.py
+            if kind in _RUNNER_EVENTS:
+                runner_events.append(ev)
+            continue
         if run_id not in by_run:
             runs.append(run_id)
             by_run[run_id] = {"start": [], "round": [], "span": [],
                               "member": [], "feed": [], "recompile": [],
                               "bench": [], "bank": [], "end": [],
-                              "serve": [], "loop": [], "request": [],
+                              "serve": [], "loop": [], "metrics": [],
                               "replica": []}
-        kind = ev.get("event")
+        if kind == "request":
+            agg = request_aggs.get(run_id)
+            if agg is None:
+                agg = request_aggs[run_id] = _RequestAgg()
+            agg.fold(ev)
+            lin = ev.get("lineage")
+            if isinstance(lin, dict):
+                last_request_lin = lin
+                requests_linked += 1
+                parent = lin.get("parent")
+                if isinstance(parent, str):
+                    request_parents.add(parent)
+            continue
         key = {"run_start": "start", "run_end": "end",
                "worker_lost": "member", "worker_joined": "member",
                "mesh_resize": "member"}.get(kind, kind)
+        if key == "metrics":
+            # cumulative snapshots: the last supersedes — keep ONE
+            by_run[run_id]["metrics"] = [ev]
+            continue
         if key in by_run[run_id]:
             by_run[run_id][key].append(ev)
+            if key == "round":
+                last_round = ev
 
-    if not runs:
+    if not runs and not runner_events:
         lines += ["", "_No obs events in this journal._", ""]
         return "\n".join(lines)
+
+    if runner_events:
+        lines += ["", "## window-runner ledger", ""]
+        lines += _runner_lines(runner_events)
 
     for run_id in runs:
         group = by_run[run_id]
@@ -429,10 +675,13 @@ def render(events: list[dict], source: str = "journal") -> str:
         if group["replica"]:
             lines += ["", "### replica pool (pod-scale serving)", ""]
             lines += _replica_lines(group["replica"])
-        if group["request"]:
+        if run_id in request_aggs:
             lines += ["", "### request latency (p50/p99 per model × "
                           "bucket)", ""]
-            lines += _request_rows(group["request"])
+            lines += _request_rows(request_aggs[run_id])
+        if group["metrics"]:
+            lines += ["", "### streaming metrics", ""]
+            lines += _metrics_lines(group["metrics"][0])
         if group["recompile"]:
             lines += ["", "### recompiles", ""]
             for ev in group["recompile"]:
@@ -454,12 +703,24 @@ def render(events: list[dict], source: str = "journal") -> str:
                       f"Run end: {ev.get('rounds', 0)} round(s), "
                       f"{ev.get('spans', 0)} span(s), "
                       f"{ev.get('compiles', 0)} backend compilation(s)."]
+
+    if lineage:
+        defining: list[dict] = []
+        for run_id in runs:
+            group = by_run[run_id]
+            for key in ("feed", "round", "serve", "loop", "replica"):
+                defining.extend(group[key])
+        lines += _lineage_section(defining, last_round,
+                                  last_request_lin, requests_linked,
+                                  request_parents)
     lines.append("")
     return "\n".join(lines)
 
 
-def render_path(path: str, source: str | None = None) -> str:
+def render_path(path: str, source: str | None = None,
+                lineage: bool = False) -> str:
     import os
 
-    return render(schema.load_journal(path),
-                  source=source or os.path.basename(path))
+    return render(schema.stream_journal(path),
+                  source=source or os.path.basename(path),
+                  lineage=lineage)

@@ -42,6 +42,7 @@ __all__ = [
     "validate_line",
     "validate_journal",
     "load_journal",
+    "stream_journal",
 ]
 
 SCHEMA_VERSION = 1
@@ -89,6 +90,14 @@ EVENTS: dict[str, tuple[dict, dict]] = {
         {"note": str},
     ),
     "setup_failed": ({"job": str, "note": str}, {}),
+    # the runner's per-job SLO verdict (obs/slo.py evaluated against the
+    # obs journal(s) a drained job produced): ``gates`` is the manifest
+    # size, ``applicable`` how many gates had subject events in the
+    # journal (the rest pass vacuously), ``burned`` the failing gate ids
+    "slo": (
+        {"job": str, "ok": bool, "gates": int, "applicable": int},
+        {"burned": list, "journal": str, "manifest": str, "note": str},
+    ),
     "runner_done": ({"reason": str}, {"blocked_jobs": list}),
     # -- sparknet_tpu/obs Recorder (runtime telemetry) ------------------
     "run_start": ({"run_id": str}, {"pid": int, "argv": list, "note": str}),
@@ -107,7 +116,8 @@ EVENTS: dict[str, tuple[dict, dict]] = {
          "iters": int, "batch": int, "wall_s": _NUM,
          "images_per_sec": _NUM, "loss": _NUM, "loss_ema": _NUM,
          "fenced": bool},
-        {"comm": dict, "compiles": int, "iteration": int, "workers": int},
+        {"comm": dict, "compiles": int, "iteration": int, "workers": int,
+         "lineage": dict},
     ),
     # the recompile sentinel fired: ``count`` backend compilations since
     # the previous round of an already-warm mode
@@ -149,7 +159,8 @@ EVENTS: dict[str, tuple[dict, dict]] = {
     "feed": (
         {"run_id": str, "name": str, "batches": int, "images": int,
          "wall_s": _NUM, "stages": dict},
-        {"images_per_sec": _NUM, "workers": int, "note": str},
+        {"images_per_sec": _NUM, "workers": int, "note": str,
+         "lineage": dict},
     ),
     # a bench.py measurement, embedded whole under ``record`` (the
     # record's own keys are bench.py's contract, not re-specified here)
@@ -163,6 +174,18 @@ EVENTS: dict[str, tuple[dict, dict]] = {
         {"run_id": str, "path": str, "measured": bool},
         {"metric": str, "value": (int, float, type(None)),
          "rehearsal": bool},
+    ),
+    # one streaming-metrics snapshot (obs/metrics.py MetricsHub): the
+    # hub folds every Recorder event into bounded-memory counters /
+    # gauges / fixed-boundary log-bucket histograms and flushes the
+    # CUMULATIVE state every ``flush_every`` observations — so the
+    # report's p50/p99 and stage shares come from the LAST snapshot per
+    # run, never from buffering raw ``request`` lines.  ``hists`` maps
+    # metric name -> Histogram.snapshot() (count/sum/min/max/buckets);
+    # snapshots of the same metric are exactly mergeable bucket-wise.
+    "metrics": (
+        {"run_id": str, "seq": int, "counters": dict, "hists": dict},
+        {"gauges": dict, "note": str},
     ),
     "run_end": (
         {"run_id": str, "rounds": int, "spans": int, "compiles": int}, {},
@@ -189,7 +212,7 @@ EVENTS: dict[str, tuple[dict, dict]] = {
          "padded": int, "compiles": int, "p50_ms": _NUM, "p99_ms": _NUM,
          "rps": _NUM, "wall_s": _NUM, "version": int, "drained": int,
          "shed": int, "projected_wait_ms": _NUM, "tick_ms": _NUM,
-         "replicas": int, "dropped": int, "note": str},
+         "replicas": int, "dropped": int, "note": str, "lineage": dict},
     ),
     # -- replica router (sparknet_tpu/serve/router.py) ------------------
     # one pod-scale membership/lifecycle event, discriminated by
@@ -210,7 +233,8 @@ EVENTS: dict[str, tuple[dict, dict]] = {
          "rerouted": int, "outstanding": int, "version": int,
          "drained": int, "requests": int, "shed": int, "dropped": int,
          "predicted_bytes": int, "resident_bytes": int, "rps": _NUM,
-         "p50_ms": _NUM, "p99_ms": _NUM, "wall_s": _NUM, "note": str},
+         "p50_ms": _NUM, "p99_ms": _NUM, "wall_s": _NUM, "note": str,
+         "lineage": dict},
     ),
     # -- production loop (sparknet_tpu/loop) ----------------------------
     # one train-to-serve loop lifecycle event, discriminated by
@@ -228,7 +252,7 @@ EVENTS: dict[str, tuple[dict, dict]] = {
          "iteration": int, "version": int, "path": str,
          "loss": _NUM, "wall_s": _NUM, "drained": int, "requests": int,
          "compiles": int, "rollouts": int, "rollbacks": int,
-         "checkpoints": int, "note": str},
+         "checkpoints": int, "note": str, "lineage": dict},
     ),
     # one served request's latency decomposition (the p50/p99 material):
     # queue_wait (submit -> flush) + batch_assembly (pad/fill) + device
@@ -241,7 +265,7 @@ EVENTS: dict[str, tuple[dict, dict]] = {
          "queue_wait_ms": _NUM, "batch_assembly_ms": _NUM,
          "device_ms": _NUM, "total_ms": _NUM},
         {"batch_n": int, "padded": bool, "deadline_flush": bool,
-         "note": str},
+         "note": str, "lineage": dict},
     ),
 }
 
@@ -394,8 +418,27 @@ def load_journal(path: str) -> list[dict]:
     return events
 
 
+def stream_journal(path: str) -> Iterator[dict]:
+    """Event dicts in file order WITHOUT buffering the file (the
+    bounded-memory twin of :func:`load_journal` — ``obs top`` and the
+    report's request aggregation ride this).  Best-effort like
+    :func:`load_journal`: torn lines are skipped here, counted by
+    :func:`validate_journal`."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(obj, dict):
+                    yield obj
+    except OSError:
+        return
+
+
 def iter_events(path: str, event: str) -> Iterator[dict]:
     """Events of one kind from a journal, in file order."""
-    for obj in load_journal(path):
+    for obj in stream_journal(path):
         if obj.get("event") == event:
             yield obj

@@ -502,6 +502,10 @@ class ElasticTrainer:
         rec = get_recorder()
         t0 = time.perf_counter() if rec else 0.0
         rnd = self.round
+        # widths already compiled BEFORE the boundary: a round at a
+        # fresh width builds its program by design, and its sentinel
+        # record must say so (expected_compiles below)
+        seen_widths = set(self._programs)
         self._apply_boundary(rnd)
         W = self.width
         feeds_np = self._round_feeds(data_fn, W)
@@ -514,11 +518,13 @@ class ElasticTrainer:
         self.variables, self.slots, loss = self._program(W)(
             self.variables, self.slots, weights, self.iter, feeds,
             self.solver._key)
+        cursor0 = self.cursor
         self.iter += self.tau
         self.cursor += self.tau * W
         self.round += 1
         if rec:
             from sparknet_tpu.common import value_fence
+            from sparknet_tpu.obs import lineage as obs_lineage
 
             loss_val = value_fence(loss)
             batch = next(
@@ -528,7 +534,14 @@ class ElasticTrainer:
                 mode="elastic", tau=self.tau, devices=W, workers=W,
                 iters=self.tau, batch=batch,
                 wall_s=time.perf_counter() - t0, loss=loss_val,
-                fenced=True, comm=self._obs_comm(), iteration=self.iter)
+                fenced=True, comm=self._obs_comm(), iteration=self.iter,
+                # the round's causal input: the global shard-id range
+                # _round_feeds consumed (round_shards' grid) — minted
+                # host-side from the deterministic cursor, never enters
+                # the round program
+                lineage=obs_lineage.round_lineage(
+                    "elastic", rnd, cursor0, cursor0 + self.tau * W - 1),
+                expected_compiles=W not in seen_widths)
             return loss_val
         return float(loss)
 

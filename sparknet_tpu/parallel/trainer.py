@@ -474,13 +474,19 @@ class ParallelTrainer:
                 batch = int(shp[1]) if stacked and len(shp) > 1 \
                     else int(shp[0])
                 break
+        from sparknet_tpu.obs import lineage as obs_lineage
+
+        it_consumed = self.tau if stacked else 1
         rec.round(
             mode=self._obs_mode(), tau=self.tau,
             devices=int(self.mesh.devices.size),
             workers=self.num_workers,
-            iters=self.tau if stacked else 1, batch=batch,
+            iters=it_consumed, batch=batch,
             wall_s=wall, loss=loss_val, fenced=True,
             comm=self._obs_comm(), iteration=self.iter,
+            lineage=obs_lineage.round_lineage(
+                self._obs_mode(), self.iter - it_consumed,
+                self.iter - it_consumed, self.iter - 1),
         )
         return loss_val
 
@@ -538,11 +544,15 @@ class ParallelTrainer:
             batch = next(
                 (int(np.shape(v)[0]) for v in host[0].values()
                  if np.shape(v)), 0)
+            from sparknet_tpu.obs import lineage as obs_lineage
+
             rec.round(
                 mode="dp", tau=1, devices=int(self.mesh.devices.size),
                 workers=self.num_workers, iters=n, batch=batch,
                 wall_s=time.perf_counter() - t0, loss=loss_val,
                 fenced=True, comm=self._obs_comm(), iteration=self.iter,
+                lineage=obs_lineage.round_lineage(
+                    "dp", self.iter - n, self.iter - n, self.iter - 1),
             )
             return loss_val
         return float(losses[-1])

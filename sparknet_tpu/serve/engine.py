@@ -453,6 +453,18 @@ class ServeEngine:
         with self._lock:
             self._models[name] = model
             self._resident_bytes += model.predicted_bytes
+        from sparknet_tpu.obs import lineage as obs_lineage
+
+        # lineage: this load defines generation v0.  Seed-initialized
+        # weights are a ROOT (seed:<n>); injected weights adopt the
+        # caller's ambient parent when one is pushed (a joining replica
+        # copying the live weights, a test harness), else stay parentless
+        lin: dict = {"span": obs_lineage.generation_span(
+            name, model.version)}
+        parent = obs_lineage.current_parent() or (
+            obs_lineage.seed_root(seed) if variables is None else None)
+        if parent:
+            lin["parent"] = parent
         rec.emit(
             "serve", kind="model_loaded", model=name, family=family,
             arm=arm, buckets=list(model.buckets),
@@ -460,6 +472,7 @@ class ServeEngine:
             resident_bytes=self._resident_bytes,
             budget_bytes=verdict["budget_bytes"],
             wall_s=round(model.compile_wall_s, 6),
+            lineage=lin,
             note="all buckets AOT-compiled at load "
                  "(jit().lower().compile())")
         return model
@@ -520,13 +533,22 @@ class ServeEngine:
             verdict["predicted_bytes"], seed=seed,
             calibration_batches=self.calibration_batches,
             variables=variables, device=self.device)
+        from sparknet_tpu.obs import lineage as obs_lineage
+
+        fields: dict = {}
+        parent = obs_lineage.current_parent()
+        if parent:
+            # the loop pushed its checkpoint span; the candidate has no
+            # generation number until the swap, so it carries the edge
+            # only (the rollout event names the generation)
+            fields["lineage"] = {"parent": parent}
         rec.emit(
             "serve", kind="candidate_built", model=name, family=family,
             arm=arm, buckets=list(candidate.buckets),
             predicted_bytes=candidate.predicted_bytes,
             wall_s=round(candidate.compile_wall_s, 6),
             note="all buckets AOT-compiled on the builder thread — "
-                 "zero request-path compiles")
+                 "zero request-path compiles", **fields)
         return candidate
 
     def swap_model(self, name: str, candidate: ServedModel) -> dict:
@@ -559,6 +581,14 @@ class ServeEngine:
             self._execute(old, batch)
             drained += len(batch)
         wall = time.perf_counter() - t0
+        from sparknet_tpu.obs import lineage as obs_lineage
+
+        # lineage: the new generation descends from the loop's ambient
+        # checkpoint when one is pushed; a bare swap (router rollout, a
+        # test) falls back to the generation it displaced — both parents
+        # resolve in-journal
+        parent = obs_lineage.current_parent() or \
+            obs_lineage.generation_span(name, old.version)
         get_recorder().emit(
             "serve", kind="rollout", model=name,
             family=candidate.family_name, arm=candidate.arm,
@@ -566,6 +596,9 @@ class ServeEngine:
             drained=drained, predicted_bytes=candidate.predicted_bytes,
             resident_bytes=self._resident_bytes,
             wall_s=round(wall, 6),
+            lineage={"span": obs_lineage.generation_span(
+                         name, candidate.version),
+                     "parent": parent},
             note="hot swap under the pump lock — incumbent drained "
                  "with its own executables, zero dropped tickets")
         return {"version": candidate.version, "drained": drained,
@@ -596,11 +629,15 @@ class ServeEngine:
         for batch in stale:
             self._execute(cur, batch)
             drained += len(batch)
+        from sparknet_tpu.obs import lineage as obs_lineage
+
         get_recorder().emit(
             "serve", kind="rollback", model=name,
             family=prev.family_name, arm=prev.arm,
             buckets=list(prev.buckets), version=prev.version,
             drained=drained, resident_bytes=self._resident_bytes,
+            lineage={"span": obs_lineage.generation_span(
+                name, prev.version)},
             note="previous ServedModel restored bitwise (same object, "
                  "same executables); rolled-back candidate drained "
                  "with its own executables")
@@ -800,6 +837,11 @@ class ServeEngine:
         # offered rates the kwargs construction alone is measurable
         # against the ~85 us/row budget when the journal is disarmed
         emit = rec.emit if rec.enabled else None
+        # one shared lineage dict per BATCH, not per ticket: the parent
+        # generation id is the same for every row, and at pod rates a
+        # per-request dict build is measurable
+        lineage = ({"parent": f"gen:{model.name}:v{model.version}"}
+                   if emit is not None else None)
         for i, t in enumerate(tickets):
             t.t_done = now
             queue_ms = max(0.0, (t.t_batch - t.t_submit) * 1e3)
@@ -817,7 +859,8 @@ class ServeEngine:
                     device_ms=round(device_ms, 4),
                     total_ms=round(total_ms, 4),
                     batch_n=len(tickets), padded=bucket > len(tickets),
-                    deadline_flush=bool(t.deadline_flush))
+                    deadline_flush=bool(t.deadline_flush),
+                    lineage=lineage)
 
     # -- telemetry ---------------------------------------------------------
 

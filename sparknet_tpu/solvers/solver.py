@@ -842,11 +842,15 @@ class Solver:
             loss_val = value_fence(loss)
         else:
             loss_val = float(loss)
+        from sparknet_tpu.obs import lineage as obs_lineage
+
         rec.round(
             mode="solo", tau=1, devices=1, iters=self.iter - it0,
             batch=int(self._obs_images_per_iter),
             wall_s=time.perf_counter() - t0, loss=loss_val, fenced=True,
             iteration=self.iter,
+            lineage=obs_lineage.round_lineage(
+                "solo", it0, it0, max(it0, self.iter - 1)),
         )
 
     def solve(

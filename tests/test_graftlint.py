@@ -40,6 +40,7 @@ EXPECTED_RULES = {
     "queue-job-hygiene",
     "obs-fenced-span",
     "feed-shm-cleanup",
+    "obs-vocab-coverage",
 }
 
 
@@ -1248,3 +1249,95 @@ def test_repo_self_lint_is_clean():
     bad = [f for f in findings if not f.suppressed]
     assert not bad, "unsuppressed graftlint findings:\n" + "\n".join(
         f"{f.path}:{f.line}: [{f.rule}] {f.message}" for f in bad)
+
+
+# -- obs-vocab-coverage -----------------------------------------------------
+
+
+_VOCAB_SCHEMA = (
+    'EVENTS: dict[str, tuple[dict, dict]] = {\n'
+    '    "round": ({"run_id": str}, {}),\n'
+    '    "serve": ({"run_id": str, "kind": str}, {}),\n'
+    '}\n'
+)
+
+
+def _vocab_tree(tmp_path, report_has=("round", "serve"),
+                doc_has=("round", "serve"), write_report=True,
+                write_doc=True):
+    """A fake repo around obs/schema.py: a report.py rendering some
+    event names as quoted literals, an OBSERVABILITY.md documenting
+    some as backticked terms."""
+    rel = tmp_path / "sparknet_tpu" / "obs" / "schema.py"
+    rel.parent.mkdir(parents=True, exist_ok=True)
+    rel.write_text(_VOCAB_SCHEMA)
+    if write_report:
+        body = "\n".join(
+            f'    if ev.get("event") == "{n}":\n        pass'
+            for n in report_has)
+        (rel.parent / "report.py").write_text(
+            f"def render(ev):\n{body or '    pass'}\n")
+    if write_doc:
+        docs = tmp_path / "docs"
+        docs.mkdir(exist_ok=True)
+        (docs / "OBSERVABILITY.md").write_text(
+            "# obs\n" + "".join(f"the `{n}` event\n" for n in doc_has))
+    return str(rel)
+
+
+def test_obs_vocab_clean_when_fully_covered(tmp_path):
+    path = _vocab_tree(tmp_path)
+    assert not hits(_VOCAB_SCHEMA, "obs-vocab-coverage", path=path)
+
+
+def test_obs_vocab_positive_when_report_misses_an_event(tmp_path):
+    path = _vocab_tree(tmp_path, report_has=("round",))
+    found = hits(_VOCAB_SCHEMA, "obs-vocab-coverage", path=path)
+    assert len(found) == 1
+    assert "'serve'" in found[0].message
+    assert "report.py" in found[0].message
+    # the finding lands at the offending EVENTS key's own line
+    assert found[0].line == 3
+
+
+def test_obs_vocab_positive_when_docs_miss_an_event(tmp_path):
+    path = _vocab_tree(tmp_path, doc_has=("serve",))
+    found = hits(_VOCAB_SCHEMA, "obs-vocab-coverage", path=path)
+    assert len(found) == 1
+    assert "'round'" in found[0].message
+    assert "OBSERVABILITY.md" in found[0].message
+
+
+def test_obs_vocab_positive_when_consumer_files_missing(tmp_path):
+    path = _vocab_tree(tmp_path, write_report=False, write_doc=False)
+    found = hits(_VOCAB_SCHEMA, "obs-vocab-coverage", path=path)
+    # two missing-consumer findings; per-name findings only against
+    # the consumers that could be read
+    assert len(found) == 2
+    assert all("missing or unreadable" in f.message for f in found)
+
+
+def test_obs_vocab_ignores_other_obs_files(tmp_path):
+    # the rule anchors on schema.py alone — report.py itself (which
+    # contains the same names) must not trigger it
+    tree = _vocab_tree(tmp_path)
+    report = os.path.join(os.path.dirname(tree), "report.py")
+    assert not hits(_VOCAB_SCHEMA, "obs-vocab-coverage", path=report)
+    assert not hits(_VOCAB_SCHEMA, "obs-vocab-coverage")
+
+
+def test_obs_vocab_suppressible(tmp_path):
+    path = _vocab_tree(tmp_path, report_has=("round",))
+    src = ("# graftlint: disable-file=obs-vocab-coverage -- "
+           "renderer lands later in this PR\n" + _VOCAB_SCHEMA)
+    assert not hits(src, "obs-vocab-coverage", path=path)
+    assert suppressed_hits(src, "obs-vocab-coverage", path=path)
+
+
+def test_obs_vocab_real_repo_is_covered():
+    """The live schema/report/docs triple passes its own rule."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    real = os.path.join(root, "sparknet_tpu", "obs", "schema.py")
+    with open(real, encoding="utf-8") as f:
+        src = f.read()
+    assert not hits(src, "obs-vocab-coverage", path=real)

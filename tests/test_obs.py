@@ -201,8 +201,32 @@ GOLDEN_EVENTS = [
     {"event": "bank", "run_id": "golden", "utc": "2026-08-04 00:00:10Z",
      "path": "/tmp/int8_bench_rehearsal.json", "measured": False,
      "rehearsal": True},
+    {"event": "request", "run_id": "golden",
+     "utc": "2026-08-04 00:00:10Z", "model": "live", "bucket": 8,
+     "queue_wait_ms": 1.5, "batch_assembly_ms": 0.2, "device_ms": 4.0,
+     "total_ms": 5.7, "batch_n": 5, "padded": True,
+     "lineage": {"span": "req:live:1", "parent": "gen:live:v1"}},
+    {"event": "request", "run_id": "golden",
+     "utc": "2026-08-04 00:00:10Z", "model": "live", "bucket": 8,
+     "queue_wait_ms": 1.9, "batch_assembly_ms": 0.2, "device_ms": 4.0,
+     "total_ms": 6.1, "deadline_flush": True,
+     "lineage": {"span": "req:live:2", "parent": "gen:live:v1"}},
+    {"event": "metrics", "run_id": "golden",
+     "utc": "2026-08-04 00:00:11Z", "seq": 1,
+     "counters": {"serve/requests": 2},
+     "gauges": {"train/loss_ema/dp": 2.3026},
+     "hists": {"serve/total_ms/live/b8": {
+         "count": 2, "sum": 11.8, "min": 5.7, "max": 6.1,
+         "buckets": {"30": 1, "31": 1}}}},
     {"event": "run_end", "run_id": "golden", "utc": "2026-08-04 00:00:11Z",
      "rounds": 2, "spans": 3, "compiles": 14},
+    # a window-runner ledger line (no run_id): the report renders these
+    # in their own section, and the slo verdict is the runner's per-job
+    # gate (tools/tpu_window_runner.py module doc step 4)
+    {"event": "slo", "utc": "2026-08-04 00:00:12Z", "job": "loop_dryrun",
+     "ok": True, "gates": 5, "applicable": 2,
+     "journal": "docs/evidence_r7/loop_dryrun.jsonl",
+     "manifest": "docs/slo_manifest.json"},
 ]
 
 

@@ -358,3 +358,95 @@ def test_rc4_backend_unreachable_is_window_death_not_failure(
     # ...but it DOES count on the hang ledger so a chronically rc-4 job
     # still blocks eventually instead of spinning forever
     assert runner.load_done(count_timeouts=True).get("bench_rc4") == 2
+
+
+def test_job_journals_discovery(runner):
+    """Journal discovery scans argv *.jsonl tokens plus SPARKNET_OBS,
+    resolves against the job's cwd, and never surfaces the runner's own
+    ledger (a job must not be judged on the runner's bookkeeping)."""
+    job = {"name": "j", "cwd": "/work",
+           "argv": ["python", "-u", "tool.py",
+                    "--out", "out/run.jsonl", "--n", "5"],
+           "env": {"SPARKNET_OBS": "/abs/obs.jsonl"}}
+    got = runner.job_journals(job)
+    assert got == ["/work/out/run.jsonl", "/abs/obs.jsonl"]
+    # the runner's own journal is excluded even when a job names it
+    self_ref = {"name": "s", "argv": ["python", runner.JOURNAL]}
+    assert runner.job_journals(self_ref) == []
+
+
+def test_drained_job_gets_a_schema_valid_slo_verdict(runner, tmp_path,
+                                                     monkeypatch):
+    """Module doc step 4: after a job ends, its obs journal is gated
+    against docs/slo_manifest.json and the verdict is journaled as a
+    schema-valid `slo` event naming the job and the journal."""
+    from sparknet_tpu.obs import schema
+
+    obs_journal = tmp_path / "job_obs.jsonl"
+    ev = {"event": "request", "run_id": "t", "model": "live",
+          "bucket": 8, "queue_wait_ms": 1.0, "batch_assembly_ms": 0.1,
+          "device_ms": 2.0, "total_ms": 3.1}
+    obs_journal.write_text("".join(json.dumps(ev) + "\n"
+                                   for _ in range(20)))
+    monkeypatch.setattr(runner, "dial", lambda probe_id=0: True)
+    job = {"name": "telemetry_job",
+           "argv": [sys.executable, "-c", "print('ok')",
+                    str(obs_journal)],
+           "deadline_s": 30}
+    q = _queue(tmp_path, [job])
+    monkeypatch.setattr(sys, "argv", ["runner", q])
+    assert runner.main() == 0
+    events = [json.loads(ln) for ln in open(runner.JOURNAL)]
+    verdicts = [e for e in events if e.get("event") == "slo"]
+    assert len(verdicts) == 1
+    v = verdicts[0]
+    assert v["job"] == "telemetry_job" and v["ok"] is True
+    assert v["journal"].endswith("job_obs.jsonl")
+    assert schema.validate_line(v) == []
+    # the verdict landed after the job_end it gates
+    kinds = [e.get("event") for e in events]
+    assert kinds.index("slo") > kinds.index("job_end")
+
+
+def test_slo_burn_is_journaled_but_never_fails_the_job(runner, tmp_path,
+                                                       monkeypatch):
+    """A burned SLO is evidence, not a retry trigger: the job stays
+    green on the queue ledger while the verdict names the burn."""
+    obs_journal = tmp_path / "burn_obs.jsonl"
+    ev = {"event": "request", "run_id": "t", "model": "live",
+          "bucket": 8, "queue_wait_ms": 900.0, "batch_assembly_ms": 0.1,
+          "device_ms": 2.0, "total_ms": 902.1}
+    obs_journal.write_text("".join(json.dumps(ev) + "\n"
+                                   for _ in range(60)))
+    monkeypatch.setattr(runner, "dial", lambda probe_id=0: True)
+    job = {"name": "hot_job",
+           "argv": [sys.executable, "-c", "print('ok')",
+                    str(obs_journal)],
+           "deadline_s": 30}
+    q = _queue(tmp_path, [job])
+    monkeypatch.setattr(sys, "argv", ["runner", q])
+    assert runner.main() == 0  # queue drains green despite the burn
+    assert runner.load_done() == {"hot_job": -1}
+    verdicts = [json.loads(ln) for ln in open(runner.JOURNAL)
+                if json.loads(ln).get("event") == "slo"]
+    assert verdicts and verdicts[0]["ok"] is False
+    assert "warm-queue-p99" in verdicts[0]["burned"]
+
+
+def test_window_death_skips_slo_evaluation(runner, tmp_path, monkeypatch):
+    """A deadline-killed job's half-written journal is not a specimen:
+    no slo verdict is journaled for it."""
+    obs_journal = tmp_path / "partial.jsonl"
+    obs_journal.write_text("")
+    monkeypatch.setattr(runner, "dial", lambda probe_id=0: True)
+    hang = {"name": "hang",
+            "argv": [sys.executable, "-c",
+                     "import time; time.sleep(60)", str(obs_journal)],
+            "deadline_s": 1}
+    q = _queue(tmp_path, [hang], max_hours=0.001, max_timeouts=1)
+    monkeypatch.setattr(sys, "argv", ["runner", q])
+    runner.main()
+    events = [json.loads(ln) for ln in open(runner.JOURNAL)]
+    assert any(e.get("event") == "job_end" and e.get("rc") is None
+               for e in events)
+    assert not any(e.get("event") == "slo" for e in events)

@@ -20,6 +20,15 @@ latency, so this runner automates the round's protocol:
 3. **Journal everything** to ``docs/evidence_r3/journal.jsonl`` —
    dials, outcomes, job rcs, durations — so the tunnel log can be
    reconstructed after the fact.
+4. **Gate every drained job's telemetry.**  After a job ends, any obs
+   journal it produced (a ``*.jsonl`` token in its argv, or its
+   ``SPARKNET_OBS`` env value) is evaluated against the checked-in SLO
+   manifest (``sparknet_tpu/obs/slo.py``; docs/slo_manifest.json) and
+   the verdict is journaled as a schema-valid ``slo`` event — a banked
+   journal that burns an SLO is flagged the moment the window drains
+   it, not when a human reads the markdown.  Best-effort by contract:
+   an evaluation error prints to stderr and never takes the runner
+   down.
 
 Usage:
     python tools/tpu_window_runner.py tools/tpu_queue_r4.json &
@@ -203,6 +212,54 @@ def window_death(rc: int | None, job: dict) -> bool:
         "SPARKNET_BENCH_REQUIRE_MEASURED") == "1"
 
 
+def job_journals(job: dict) -> list[str]:
+    """Obs journal paths one queue job produces: every ``*.jsonl``
+    token in its argv plus its ``SPARKNET_OBS`` env value.  Relative
+    paths resolve against the job's cwd (run_job's contract); the
+    runner's own ledger is excluded (a job must not be judged on the
+    runner's bookkeeping lines)."""
+    cwd = job.get("cwd", REPO)
+    cands = [str(a) for a in job.get("argv", [])
+             if str(a).endswith(".jsonl")]
+    obs = str(job.get("env", {}).get("SPARKNET_OBS", ""))
+    if obs.endswith(".jsonl"):
+        cands.append(obs)
+    paths: list[str] = []
+    for c in cands:
+        p = os.path.abspath(c if os.path.isabs(c)
+                            else os.path.join(cwd, c))
+        if p != os.path.abspath(JOURNAL) and p not in paths:
+            paths.append(p)
+    return paths
+
+
+def evaluate_job_slos(job: dict) -> None:
+    """Run the manifest's SLO gates over each journal the job produced
+    and journal one schema-valid ``slo`` verdict event per journal
+    (module doc step 4).  Missing journals are skipped silently (most
+    queue jobs don't arm obs); any evaluation error is contained —
+    the gate surfaces burns, it never takes the runner down."""
+    try:
+        from sparknet_tpu.obs import slo as _slo
+
+        manifest_path = _slo.default_manifest_path()
+        manifest = _slo.load_manifest(manifest_path)
+        for jpath in job_journals(job):
+            if not os.path.exists(jpath):
+                continue
+            results = _slo.evaluate_journal(jpath, manifest)
+            rel = os.path.relpath(jpath, REPO)
+            log({"event": "slo",
+                 **_slo.verdict_fields(
+                     job["name"], results,
+                     journal=jpath if rel.startswith("..") else rel,
+                     manifest_path=os.path.relpath(manifest_path,
+                                                   REPO))})
+    except Exception as e:  # best-effort by contract
+        print(f"runner: slo evaluation failed for {job.get('name')}: "
+              f"{e}", file=sys.stderr)
+
+
 def run_job(job: dict, probe_id: int = 0, setup: bool = False) -> int | None:
     """Run one job with a deadline.  Returns rc, or None on timeout.
 
@@ -259,6 +316,11 @@ def run_job(job: dict, probe_id: int = 0, setup: bool = False) -> int | None:
          "timed_out": rc is None,
          **({"window_death": True} if dead and rc is not None else {}),
          **({"setup": True} if setup else {})})
+    if not dead:
+        # the job ran to completion (pass or fail): gate whatever obs
+        # journals it produced.  Window deaths skip — a half-written
+        # journal from a deadline kill is not a specimen.
+        evaluate_job_slos(job)
     return rc
 
 

@@ -45,8 +45,17 @@ def render(events: list[dict], round_no: int) -> str:
     dials: dict[int, dict] = {}
     jobs: list[str] = []
     n_ok = 0
+    # events the table/bullets above don't render get TALLIED, never
+    # dropped on the floor — a journal line the log can't show is still
+    # part of the round's record (the round-7 slo verdicts were the
+    # first casualties of the old silent fallthrough)
+    handled = {"dial_start", "dial_end", "dial_abandoned", "job_start",
+               "job_end", "slo", "runner_start", "runner_done"}
+    other: dict[str, int] = {}
     for ev in events:
         kind = ev.get("event")
+        if kind not in handled:
+            other[str(kind)] = other.get(str(kind), 0) + 1
         if kind == "dial_start":
             p = ev.get("probe", 0)
             dials[p] = {"start": ev.get("utc", "?")}
@@ -73,6 +82,17 @@ def render(events: list[dict], round_no: int) -> str:
                 f"{', TIMED OUT' if ev.get('timed_out') else ''}"
                 f"{', WINDOW DIED (uncounted)' if ev.get('window_death') and not ev.get('timed_out') else ''})"
             )
+        elif kind == "slo":
+            # the runner's per-job SLO verdict (module doc step 4 in
+            # tools/tpu_window_runner.py); setup jobs' verdicts render
+            # too — their banked dryrun journals are evidence as well
+            burned = ev.get("burned") or []
+            verdict = ("PASS" if ev.get("ok")
+                       else "**BURNED** " + ", ".join(map(str, burned)))
+            jobs.append(
+                f"SLO {verdict} for `{ev.get('job')}`: "
+                f"{ev.get('applicable')}/{ev.get('gates')} gate(s) "
+                f"applicable over `{ev.get('journal', '?')}`")
     for p in sorted(k for k in dials if k):
         d = dials[p]
         if "ok" not in d:
@@ -92,6 +112,10 @@ def render(events: list[dict], round_no: int) -> str:
     if jobs:
         lines += ["", "## Jobs run in healthy windows", ""]
         lines += [f"- {j}" for j in jobs]
+    if other:
+        lines += ["", "Other journal events (rendered by `python -m "
+                      "sparknet_tpu.obs report`): " +
+                      ", ".join(f"{k}×{other[k]}" for k in sorted(other))]
     lines.append("")
     return "\n".join(lines)
 
