@@ -1101,6 +1101,74 @@ def check_queue_job_hygiene(ctx: ModuleContext) -> Iterator[tuple[int, str]]:
 
 
 # ---------------------------------------------------------------------------
+# queue-policy-fields
+# ---------------------------------------------------------------------------
+
+# Queues written before the survival scheduler existed (rounds 3-7):
+# immutable history of what actually ran, excused explicitly like
+# _LEGACY_QUEUES above.  From r8 on, every job must price itself for
+# the policy (tools/window_policy.py) — an unpriced job defaults to
+# value 1 / half its deadline and silently distorts every pick.
+_POLICY_LEGACY_QUEUES = frozenset({
+    "tpu_queue_r3.json", "tpu_queue_r4.json", "tpu_queue_r5.json",
+    "tpu_queue_r6.json", "tpu_queue_r7.json"})
+
+
+def _queue_policy_problems(fname: str, spec: dict) -> Iterator[str]:
+    """The per-queue policy-field checks, factored for fixture tests:
+    yields one message per violation in one parsed queue spec."""
+    for job in spec.get("jobs", []):
+        name = str(job.get("name", "?"))
+        for field in ("value", "est_runtime_s"):
+            v = job.get(field)
+            if (isinstance(v, bool) or not isinstance(v, (int, float))
+                    or v <= 0):
+                yield (f"{fname}: job {name!r} lacks a positive numeric "
+                       f"{field!r} — the survival policy "
+                       "(tools/window_policy.py, --policy survival) "
+                       "prices every pick as value x P(survive "
+                       "est_runtime); an unpriced job silently "
+                       "defaults and distorts the whole window plan")
+
+
+@rule(
+    "queue-policy-fields",
+    "tools/tpu_queue_*.json jobs from r8 on must carry positive numeric "
+    "value/est_runtime_s policy fields (r3-r7 excused as immutable "
+    "history)",
+)
+def check_queue_policy_fields(ctx: ModuleContext) -> Iterator[tuple[int, str]]:
+    """Extends queue-job-hygiene for the survival scheduler: same
+    anchoring (queue files are JSON, so the rule fires while linting
+    ``tools/tpu_window_runner.py`` and audits every sibling
+    ``tpu_queue_*.json``), same explicit-legacy move — rounds 3-7 ran
+    before the policy existed and are historical evidence; editing them
+    to satisfy the rule would falsify the record.  Unreadable queue
+    files are queue-job-hygiene's finding, not duplicated here.
+    """
+    base = os.path.basename(ctx.path)
+    if base != "tpu_window_runner.py":
+        return
+    tools_dir = os.path.dirname(os.path.abspath(ctx.path))
+    try:
+        queues = sorted(f for f in os.listdir(tools_dir)
+                        if re.fullmatch(r"tpu_queue_.*\.json", f))
+    except OSError:
+        return
+    for fname in queues:
+        if fname in _POLICY_LEGACY_QUEUES:
+            continue
+        try:
+            with open(os.path.join(tools_dir, fname),
+                      encoding="utf-8") as f:
+                spec = json.load(f)
+        except (OSError, ValueError):
+            continue  # queue-job-hygiene already reports unreadable files
+        for msg in _queue_policy_problems(fname, spec):
+            yield (1, msg)
+
+
+# ---------------------------------------------------------------------------
 # feed-shm-cleanup
 # ---------------------------------------------------------------------------
 

@@ -40,7 +40,7 @@ __all__ = ["render", "render_path"]
 _RUNNER_EVENTS = ("runner_start", "dial_start", "dial_end",
                   "dial_abandoned", "job_start", "job_end",
                   "queue_reload_failed", "preflight_oom", "setup_failed",
-                  "slo", "runner_done")
+                  "slo", "sched", "runner_done")
 
 
 def _fmt_comm(comm: dict) -> str:
@@ -462,9 +462,44 @@ def _runner_lines(events: list[dict]) -> list[str]:
                 f"{ev.get('note', '?')}")
         elif kind == "slo":
             lines += _slo_lines(ev)
+        elif kind == "sched":
+            lines += _sched_lines(ev)
         elif kind == "runner_done":
             lines.append(f"- runner done: {ev.get('reason', '?')}")
     return lines
+
+
+def _sched_lines(ev: dict) -> list[str]:
+    """One survival-policy scheduler decision (tools/window_policy.py;
+    journaled only under ``--policy survival``), keyed on ``kind``."""
+    k = ev.get("kind", "?")
+    if k == "fit":
+        return [f"- sched fit [{ev.get('policy', '?')}]: "
+                f"{ev.get('windows', 0)} window(s) "
+                f"({ev.get('window_deaths', 0)} death(s), median "
+                f"{ev.get('median_window_s', 0):g} s), "
+                f"{ev.get('heals', 0)} heal obs (median "
+                f"{ev.get('heal_median_s', 0):g} s) from "
+                f"{len(ev.get('sources') or [])} journal(s)"]
+    if k == "pick":
+        return [f"- sched pick `{ev.get('job', '?')}` at window age "
+                f"{ev.get('window_age_s', 0):g} s: value "
+                f"{ev.get('value', 0):g} x p_survive "
+                f"{ev.get('p_survive', 0):g} = score "
+                f"{ev.get('score', 0):g} over "
+                f"{ev.get('candidates', 0)} candidate(s)"]
+    if k == "window_summary":
+        return [f"- sched window summary (probe {ev.get('probe', '?')}): "
+                f"expected {ev.get('expected_value', 0):g}, banked "
+                f"{ev.get('banked_value', 0):g} across "
+                f"{ev.get('jobs_banked', 0)} job(s) in "
+                f"{ev.get('window_age_s', 0):g} s"]
+    if k == "redial_backoff":
+        return [f"- sched redial backoff: deferring dial "
+                f"{ev.get('delay_s', 0):g} s after "
+                f"{ev.get('consecutive_dead', 0)} consecutive death(s) "
+                f"(fitted heal median {ev.get('heal_median_s', 0):g} s)"]
+    return [f"- sched {k}: {ev.get('note', '')}"]
 
 
 def _waterfall_lines(defining: list[dict], lin: dict,

@@ -99,6 +99,24 @@ EVENTS: dict[str, tuple[dict, dict]] = {
         {"burned": list, "journal": str, "manifest": str, "note": str},
     ),
     "runner_done": ({"reason": str}, {"blocked_jobs": list}),
+    # one survival-policy scheduling decision (tools/window_policy.py;
+    # only written under ``--policy survival`` — the default runner path
+    # stays byte-compatible).  ``kind`` discriminates: "fit" (model
+    # summary at runner start), "pick" (value x P(survive) argmax inside
+    # a window), "window_summary" (per-window expected-vs-banked
+    # evidence reconciliation), "redial_backoff" (survival-seeded
+    # deferred dial while the relay is wedged)
+    "sched": (
+        {"kind": str},
+        {"policy": str, "job": str, "probe": int, "window_age_s": _NUM,
+         "est_runtime_s": _NUM, "p_survive": _NUM, "value": _NUM,
+         "score": _NUM, "candidates": int, "expected_value": _NUM,
+         "banked_value": _NUM, "jobs_banked": int, "delay_s": _NUM,
+         "consecutive_dead": int, "heal_median_s": _NUM,
+         "windows": int, "window_deaths": int, "median_window_s": _NUM,
+         "heals": int, "heals_observed": int, "sources": list,
+         "note": str},
+    ),
     # -- sparknet_tpu/obs Recorder (runtime telemetry) ------------------
     "run_start": ({"run_id": str}, {"pid": int, "argv": list, "note": str}),
     # a fenced wall around arbitrary work; ``fenced`` False means the

@@ -38,6 +38,7 @@ EXPECTED_RULES = {
     "loop-manifest-fresh",
     "replica-manifest-fresh",
     "queue-job-hygiene",
+    "queue-policy-fields",
     "obs-fenced-span",
     "feed-shm-cleanup",
     "obs-vocab-coverage",
@@ -985,6 +986,74 @@ def test_queue_hygiene_suppressible(tmp_path):
            "fixture queue under construction\n" + RUNNER_SRC)
     assert not hits(src, "queue-job-hygiene", path=path)
     assert suppressed_hits(src, "queue-job-hygiene", path=path)
+
+
+# -- queue-policy-fields ----------------------------------------------------
+
+
+def _priced(job, value=5, est=300):
+    j = dict(job)
+    j["value"] = value
+    j["est_runtime_s"] = est
+    return j
+
+
+def test_queue_policy_flags_missing_and_invalid_fields(tmp_path):
+    path = _runner_tree(tmp_path, {"tpu_queue_r9.json": {"jobs": [
+        _bench_job("unpriced"),                              # both missing
+        _priced(_bench_job("zero_value"), value=0),          # non-positive
+        dict(_priced(_bench_job("bool_value")), value=True),  # bool sneaks
+        _priced(_bench_job("clean")),
+    ]}})
+    found = hits(RUNNER_SRC, "queue-policy-fields", path=path)
+    msgs = "\n".join(f.message for f in found)
+    assert len(found) == 4  # unpriced x2 fields + zero_value + bool_value
+    assert "unpriced" in msgs and "'value'" in msgs
+    assert "'est_runtime_s'" in msgs
+    assert "zero_value" in msgs and "bool_value" in msgs
+    assert "clean" not in msgs
+
+
+def test_queue_policy_legacy_rounds_excused_r8_not(tmp_path):
+    bare = {"jobs": [_bench_job("unpriced")]}
+    queues = {f"tpu_queue_r{n}.json": dict(bare) for n in range(3, 8)}
+    queues["tpu_queue_r8.json"] = bare
+    path = _runner_tree(tmp_path, queues)
+    found = hits(RUNNER_SRC, "queue-policy-fields", path=path)
+    assert found
+    assert all("tpu_queue_r8.json" in f.message for f in found)
+
+
+def test_queue_policy_clean_priced_queue_passes(tmp_path):
+    path = _runner_tree(tmp_path, {"tpu_queue_r8.json": {"jobs": [
+        _priced(_bench_job("headline"), value=10, est=900),
+        _priced(_trace_job("trace_last"), value=3, est=900),
+    ]}})
+    assert not hits(RUNNER_SRC, "queue-policy-fields", path=path)
+
+
+def test_queue_policy_unreadable_left_to_hygiene(tmp_path):
+    # one finding per rule, not two for the same broken file
+    path = _runner_tree(tmp_path, {"tpu_queue_r8.json": "{not json"})
+    assert not hits(RUNNER_SRC, "queue-policy-fields", path=path)
+    assert hits(RUNNER_SRC, "queue-job-hygiene", path=path)
+
+
+def test_queue_policy_only_fires_from_the_runner(tmp_path):
+    path = _runner_tree(tmp_path, {"tpu_queue_r8.json": {"jobs": [
+        _bench_job("unpriced")]}})
+    other = os.path.join(os.path.dirname(path), "tunnel_log.py")
+    assert hits(RUNNER_SRC, "queue-policy-fields", path=path)
+    assert not hits(RUNNER_SRC, "queue-policy-fields", path=other)
+
+
+def test_queue_policy_suppressible(tmp_path):
+    path = _runner_tree(tmp_path, {"tpu_queue_r8.json": {"jobs": [
+        _bench_job("unpriced")]}})
+    src = ("# graftlint: disable-file=queue-policy-fields -- "
+           "draft queue not yet priced\n" + RUNNER_SRC)
+    assert not hits(src, "queue-policy-fields", path=path)
+    assert suppressed_hits(src, "queue-policy-fields", path=path)
 
 
 # -- feed-shm-cleanup -------------------------------------------------------

@@ -1,0 +1,353 @@
+"""tools/window_policy.py + tools/sched_sim.py — the survival scheduler.
+
+Pure host-side logic (stdlib by contract: the runner imports the policy
+while babysitting a wedged relay), so the whole surface pins chip-free
+and rides the smoke tier: the Kaplan-Meier estimator's censoring
+arithmetic, the journal parser's window/heal extraction (including the
+restart-bridge rule every observed heal depends on), the pick's
+value x P(survive) ordering with its hard traces-last constraint, the
+seeded replay gate's determinism, and the `sched` journal vocabulary.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+import time
+
+import pytest
+
+pytestmark = pytest.mark.smoke
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def wp():
+    return _load("window_policy")
+
+
+@pytest.fixture(scope="module")
+def sim():
+    return _load("sched_sim")
+
+
+# journal fixtures: hand-built events with real wall stamps, the same
+# format every banked journal uses
+BASE = 1700000000
+
+
+def _ev(kind, t, **kw):
+    utc = time.strftime("%Y-%m-%d %H:%M:%SZ", time.gmtime(BASE + t))
+    return {"event": kind, "utc": utc, **kw}
+
+
+# -- KaplanMeier ------------------------------------------------------------
+
+
+def test_km_all_observed_steps(wp):
+    km = wp.KaplanMeier([10.0, 20.0, 30.0], [True, True, True])
+    assert km.n == 3 and km.events == 3
+    assert km.survival(5) == 1.0
+    assert km.survival(15) == pytest.approx(2 / 3)
+    assert km.survival(25) == pytest.approx(1 / 3)
+    assert km.survival(30) == 0.0
+    assert km.quantile(0.5) == 20.0
+
+
+def test_km_censoring_shrinks_risk_set_not_the_curve(wp):
+    """A censored duration leaves the curve flat but removes the subject
+    from the risk set — the whole point of the estimator (dropping
+    censored windows would bias lifetimes short; module doc)."""
+    km = wp.KaplanMeier([10.0, 20.0, 30.0], [True, False, True])
+    assert km.events == 2
+    assert km.survival(15) == pytest.approx(2 / 3)
+    assert km.survival(25) == pytest.approx(2 / 3)  # no step at 20
+    # the death at 30 faces a risk set of ONE (censoring ate the other)
+    assert km.survival(30) == 0.0
+
+
+def test_km_conditional_decays_and_caps_at_one(wp):
+    km = wp.KaplanMeier([10.0, 20.0, 30.0], [True, True, True])
+    assert km.conditional(0, 15) == pytest.approx(km.survival(15))
+    assert 0.0 <= km.conditional(12, 10) <= 1.0
+    # a window that outlived every observation keeps decaying via the
+    # exponential tail instead of becoming immortal
+    assert km.conditional(30, 10) < 1.0 or km.survival(40) == 0.0
+
+
+def test_km_tail_extrapolates_past_support(wp):
+    km = wp.KaplanMeier([100.0, 200.0], [True, False])
+    s_end = km.survival(200.0)
+    assert km.survival(400.0) < s_end  # hazard keeps running
+    assert km.survival(400.0) > 0.0
+
+
+def test_km_sample_is_monotone_and_capped(wp):
+    km = wp.KaplanMeier([100.0, 200.0, 300.0], [True, True, False])
+    lo, hi = km.sample(0.9), km.sample(0.1)
+    assert lo <= hi            # higher survival draw -> shorter duration
+    assert hi <= km.t_max * 4  # censored-heavy curves cannot blow up
+    assert km.sample(0.5) > 0.0
+
+
+def test_km_censored_only_curve_never_dies(wp):
+    km = wp.KaplanMeier([100.0], [False])
+    assert km.events == 0 and km.steps == []
+    assert km.survival(1e6) == 1.0  # no basis for a death rate
+
+
+# -- parse_history ----------------------------------------------------------
+
+
+def test_parse_observed_window_and_wedge_start(wp):
+    events = [
+        _ev("dial_start", 0, probe=1),
+        _ev("dial_end", 10, probe=1, ok=True),
+        _ev("job_start", 12, job="a", argv=["python", "bench.py"]),
+        _ev("job_end", 100, job="a", rc=None, timed_out=True, dt_s=88),
+    ]
+    h = wp.parse_history(events)
+    assert h.windows == [(90.0, True)]  # healthy dial_end -> the kill
+    # the wedge starts at the death; EOF closes the streak censored
+    assert h.heals and h.heals[-1][1] is False
+    assert [seg["kind"] for seg in h.trace] == ["window", "dead"]
+
+
+def test_parse_censored_window_at_next_dial(wp):
+    events = [
+        _ev("dial_start", 0, probe=1),
+        _ev("dial_end", 10, probe=1, ok=True),
+        _ev("job_start", 12, job="a", argv=["python", "bench.py"]),
+        _ev("job_end", 50, job="a", rc=0, dt_s=38),
+        _ev("dial_start", 200, probe=2),  # window still healthy here
+    ]
+    h = wp.parse_history(events)
+    # censored at its LAST ACTIVITY (the green job_end), not the next
+    # dial's stamp — the 150 s idle gap was not observed window life
+    assert h.windows == [(40.0, False)]
+
+
+def test_parse_restart_bridges_short_gap_censors_long(wp):
+    """Every observed heal in r4/r5 straddles a runner restart; a
+    restart under RESTART_BRIDGE_S continues the wedge, a longer gap
+    closes the streak censored (module doc)."""
+    short = [
+        _ev("dial_start", 0, probe=1),
+        _ev("dial_end", 1505, probe=1, ok=False),
+        _ev("runner_start", 3000, queue="q", jobs=[]),  # gap 1495 s
+        _ev("dial_start", 3010, probe=2),
+        _ev("dial_end", 3020, probe=2, ok=True),
+    ]
+    h = wp.parse_history(short)
+    assert h.heals == [(3020.0, True)]  # first dead DIAL_START -> heal
+    long = [
+        _ev("dial_start", 0, probe=1),
+        _ev("dial_end", 1505, probe=1, ok=False),
+        _ev("runner_start", 20000, queue="q", jobs=[]),  # gap > bridge
+        _ev("dial_start", 20010, probe=2),
+        _ev("dial_end", 20020, probe=2, ok=True),
+    ]
+    h = wp.parse_history(long)
+    # the streak closed censored at the last pre-restart stamp; no
+    # observed heal survives the offline stretch
+    assert (1505.0, False) in h.heals
+    assert not any(obs for _, obs in h.heals)
+
+
+def test_parse_trailing_streak_closes_censored_at_eof(wp):
+    events = [
+        _ev("dial_start", 0, probe=1),
+        _ev("dial_end", 1505, probe=1, ok=False),
+        _ev("dial_start", 1600, probe=2),
+        _ev("dial_end", 3100, probe=2, ok=False),
+    ]
+    h = wp.parse_history(events)
+    assert h.heals == [(3100.0, False)]  # still wedged when journal ends
+
+
+def test_parse_setup_jobs_never_touch_windows(wp):
+    events = [
+        _ev("job_start", 0, job="fix", argv=["python", "x.py"], setup=True),
+        _ev("job_end", 5, job="fix", rc=0, dt_s=5, setup=True),
+    ]
+    h = wp.parse_history(events)
+    assert h.windows == [] and h.runs == []
+
+
+# -- RuntimeModel -----------------------------------------------------------
+
+
+def test_runtime_fallback_chain(wp):
+    m = wp.RuntimeModel()
+    job = {"name": "bench_x", "argv": ["python", "-u", "bench.py"],
+           "deadline_s": 600}
+    assert m.estimate(job) == 300.0  # nothing known: half the deadline
+    job["est_runtime_s"] = 120
+    assert m.estimate(job) == 120.0  # declared beats the prior
+    m.observe("other_bench", "bench.py", 80.0, 0)
+    del job["est_runtime_s"]
+    assert m.estimate(job) == 80.0   # tool pool beats the prior
+    job["est_runtime_s"] = 120
+    assert m.estimate(job) == 120.0  # declared beats the tool pool
+    m.observe("bench_x", "bench.py", 45.0, 0)
+    assert m.estimate(job) == 45.0   # own history beats everything
+
+
+def test_runtime_ignores_failed_runs(wp):
+    m = wp.RuntimeModel()
+    m.observe("j", "t.py", 500.0, 1)     # failure
+    m.observe("j", "t.py", 500.0, None)  # deadline kill
+    assert not m.by_name  # neither is evidence of a working runtime
+
+
+# -- SurvivalScheduler ------------------------------------------------------
+
+
+def _sched(wp, window=None, heal=None):
+    return wp.SurvivalScheduler(
+        window or wp.KaplanMeier([600.0, 1200.0], [True, True]),
+        heal or wp.KaplanMeier([3200.0], [True]),
+        wp.RuntimeModel(), [])
+
+
+def _job(name, value, est, trace=False):
+    argv = ["python", "-u", "bench.py"] + (["--trace"] if trace else [])
+    return {"name": name, "argv": argv, "deadline_s": 900,
+            "value": value, "est_runtime_s": est}
+
+
+def test_pick_maximizes_value_times_survival(wp):
+    s = _sched(wp)
+    jobs = [_job("cheap_low", 2, 100), _job("cheap_high", 8, 100)]
+    job, d = s.pick(jobs, age_s=0.0)
+    assert job["name"] == "cheap_high"
+    assert d["policy"] == "survival" and d["candidates"] == 2
+    assert d["score"] == pytest.approx(8 * s.p_survive(0, 100), abs=1e-3)
+
+
+def test_pick_reorders_as_the_window_ages(wp):
+    """Late in the window a long job's survival collapses while a short
+    one still fits — the whole reason the policy re-plans per pick."""
+    s = _sched(wp)
+    jobs = [_job("long_big", 10, 900), _job("short_small", 4, 60)]
+    early, _ = s.pick(jobs, age_s=0.0)
+    late, _ = s.pick(jobs, age_s=550.0)
+    assert early["name"] == "long_big"
+    assert late["name"] == "short_small"
+
+
+def test_pick_holds_traces_for_last(wp):
+    s = _sched(wp)
+    jobs = [_job("trace_hot", 100, 10, trace=True), _job("bench", 1, 800)]
+    job, _ = s.pick(jobs, age_s=0.0)
+    assert job["name"] == "bench"  # value cannot buy a trace an early slot
+    job, d = s.pick([jobs[0]], age_s=0.0)
+    assert job["name"] == "trace_hot"  # only traces left: eligible now
+
+
+def test_pick_tie_goes_to_cheaper_estimate(wp):
+    # censored-only curve: survival == 1 everywhere, so equal values tie
+    s = _sched(wp, window=wp.KaplanMeier([1000.0], [False]))
+    jobs = [_job("pricey", 5, 700), _job("thrifty", 5, 200)]
+    job, _ = s.pick(jobs, age_s=0.0)
+    assert job["name"] == "thrifty"  # equal expected value: gamble less
+
+
+def test_observe_reprices_mid_window(wp):
+    s = _sched(wp)
+    job = _job("bench", 5, 60)
+    s.observe(job, 590.0, 0)  # ran 10x the declared estimate
+    assert s.runtime.estimate(job) == 590.0
+
+
+def test_redial_delay_exponential_with_caps(wp):
+    s = _sched(wp)  # heal median 3200 -> base clamps to the 120 s floor
+    assert s.heal_median_s == 3200.0
+    assert s.redial_delay(1) == 120.0
+    assert s.redial_delay(2) == 240.0
+    assert s.redial_delay(3) == 480.0
+    assert s.redial_delay(10) == wp.BACKOFF_CAP_S  # capped at 30 min
+    # zero observed heals: the default hours-scale wedge shape seeds it
+    s2 = _sched(wp, heal=wp.KaplanMeier([100.0], [False]))
+    assert s2.heal_median_s == wp.DEFAULT_HEAL_MEDIAN_S
+
+
+def test_fit_from_real_banked_journals(wp):
+    """The committed evidence_r* journals must keep fitting: they are
+    the curve every --policy survival run prices against."""
+    s = wp.SurvivalScheduler.fit()
+    d = s.describe()
+    assert d["windows"] >= 1 and d["window_deaths"] >= 1
+    assert d["heals"] >= 1
+    assert d["median_window_s"] > 0
+    assert d["sources"]  # relpaths, journaled for provenance
+
+
+# -- sched vocabulary -------------------------------------------------------
+
+
+def test_sched_event_kinds_are_schema_valid():
+    from sparknet_tpu.obs import schema
+
+    samples = [
+        {"kind": "fit", "policy": "survival", "windows": 4,
+         "window_deaths": 3, "median_window_s": 1968.0, "heals": 6,
+         "heals_observed": 2, "heal_median_s": 41857.0, "sources": []},
+        {"kind": "pick", "policy": "survival", "job": "headline_bench",
+         "probe": 3, "window_age_s": 12.0, "est_runtime_s": 300.0,
+         "p_survive": 0.61, "value": 10.0, "score": 6.1,
+         "candidates": 5},
+        {"kind": "window_summary", "policy": "survival", "probe": 3,
+         "window_age_s": 900.0, "expected_value": 12.2,
+         "banked_value": 10.0, "jobs_banked": 2},
+        {"kind": "redial_backoff", "policy": "survival", "delay_s": 240.0,
+         "consecutive_dead": 2, "heal_median_s": 41857.0},
+    ]
+    for fields in samples:
+        ev = schema.make_event("sched", **fields)
+        assert schema.validate_line(ev) == [], fields["kind"]
+
+
+# -- sched_sim (the replay gate) --------------------------------------------
+
+
+def test_sched_sim_gate_holds_and_is_deterministic(sim):
+    """The banked claim itself: never worse than cheap-first on any
+    replayed history, strictly better on a wedge-heavy one — and the
+    record is a pure function of (queue, seed), so the banked JSON is
+    reproducible byte-for-byte."""
+    a = sim.run(sim.DEFAULT_QUEUE, seed=801)
+    b = sim.run(sim.DEFAULT_QUEUE, seed=801)
+    assert a == b
+    assert a["ok"] and a["policy_never_worse"]
+    assert a["strictly_better_on_wedge_heavy"]
+    assert a["chip_free"] and a["host_side"]
+    assert any(r["wedge_heavy"] for r in a["histories"])
+
+
+def test_sched_sim_banked_record_matches_live_run(sim):
+    """docs/sched_sim_last.json must be regeneratable: a stale bank
+    (code moved, record didn't) would misstate the gate's margin."""
+    with open(sim.LAST_PATH) as f:
+        banked = json.load(f)
+    live = sim.run(sim.DEFAULT_QUEUE, seed=banked["seed"])
+    assert banked["histories"] == live["histories"]
+    assert banked["ok"] is True
+
+
+def test_sched_sim_jitter_is_coordinate_keyed(sim):
+    """Both arms must face identical physics: the jitter is keyed by
+    (seed, history, job, window), never drawn from a shared sequence
+    whose consumption order differs between arms."""
+    assert sim._jitter(1, "h", "j", 2) == sim._jitter(1, "h", "j", 2)
+    assert sim._jitter(1, "h", "j", 2) != sim._jitter(1, "h", "j", 3)
+    assert 0.85 <= sim._jitter(9, "x", "y", 0) < 1.25

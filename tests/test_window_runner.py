@@ -450,3 +450,89 @@ def test_window_death_skips_slo_evaluation(runner, tmp_path, monkeypatch):
     assert any(e.get("event") == "job_end" and e.get("rc") is None
                for e in events)
     assert not any(e.get("event") == "slo" for e in events)
+
+
+# -- --policy survival (tools/window_policy.py) -----------------------------
+
+
+def test_wedge_end_to_end_policy_replans_on_survivors(runner, tmp_path,
+                                                      monkeypatch):
+    """The full wedge path under ``--policy survival``: a mid-window job
+    that ignores SIGTERM is SIGKILLed at its deadline, the death is
+    journaled as a window death (NOT a counted attempt), the survival
+    backoff defers the redial, and the next window's pick re-plans on
+    the surviving candidates."""
+    from sparknet_tpu.obs import schema
+
+    monkeypatch.setattr(runner, "TERM_GRACE_S", 0.5)
+    wp = runner.load_policy_module()  # cached: main() reuses this object
+    # shrink the backoff rails so the deferred redial is a real sleep
+    # the test can afford (the journal event is what's under test)
+    monkeypatch.setattr(wp, "BACKOFF_FLOOR_S", 0.05)
+    monkeypatch.setattr(wp, "BACKOFF_BASE_CAP_S", 0.05)
+    monkeypatch.setattr(wp, "BACKOFF_CAP_S", 0.1)
+    monkeypatch.setattr(runner, "dial", lambda probe_id=0: True)
+    # the stubborn hang: ignores SIGTERM, so only the grace-period
+    # SIGKILL ends it — the worst-case wedge casualty
+    stubborn = {"name": "stubborn_hang",
+                "argv": [sys.executable, "-c",
+                         "import signal, time;"
+                         " signal.signal(signal.SIGTERM, signal.SIG_IGN);"
+                         " time.sleep(60)"],
+                "deadline_s": 1, "value": 5, "est_runtime_s": 1}
+    survivor = dict(ok_job("survivor"), value=2, est_runtime_s=1)
+    q = _queue(tmp_path, [stubborn, survivor], max_timeouts=1)
+    monkeypatch.setattr(sys, "argv", ["runner", q, "--policy", "survival"])
+    # rc 3: the hang exhausted max_timeouts, so the queue ends blocked
+    assert runner.main() == 3
+    state = runner.load_done()
+    # the kill burned ZERO of the hang's max_attempts...
+    assert "stubborn_hang" not in state
+    # ...but did land on the timeout ledger, and the survivor banked
+    assert runner.load_done(count_timeouts=True)["stubborn_hang"] == 1
+    assert state["survivor"] == -1
+
+    events = [json.loads(ln) for ln in open(runner.JOURNAL)]
+    end = [e for e in events if e.get("event") == "job_end"
+           and e["job"] == "stubborn_hang"][0]
+    assert end["rc"] is None and end["timed_out"] is True
+    sched = [e for e in events if e.get("event") == "sched"]
+    # fit journaled once, before any pick
+    assert [e["kind"] for e in sched if e["kind"] == "fit"] == ["fit"]
+    # window 1 picked the higher-value hang (5 x p beats 2 x p); after
+    # the death, window 2 re-planned on the survivors and picked the
+    # only live candidate
+    picks = [e for e in sched if e["kind"] == "pick"]
+    assert [e["job"] for e in picks] == ["stubborn_hang", "survivor"]
+    assert picks[0]["probe"] == 1 and picks[1]["probe"] == 2
+    # the redial after the death was deferred and journaled
+    backoffs = [e for e in sched if e["kind"] == "redial_backoff"]
+    assert backoffs and backoffs[0]["consecutive_dead"] == 1
+    # per-window reconciliation: the dead window banked nothing, the
+    # second banked exactly the survivor's declared value
+    summaries = [e for e in sched if e["kind"] == "window_summary"]
+    assert [s["jobs_banked"] for s in summaries] == [0, 1]
+    assert summaries[0]["banked_value"] == 0.0
+    assert summaries[1]["banked_value"] == 2.0
+    # every line the policy path writes is schema-valid, zero allowlist
+    n, allowlisted, errors = schema.validate_journal(runner.JOURNAL)
+    assert n > 0 and allowlisted == 0
+    assert not errors, "\n".join(errors)
+
+
+def test_default_path_writes_no_sched_events(runner, tmp_path, monkeypatch):
+    """Without ``--policy`` the journal stays byte-compatible with every
+    prior round: no sched events, no backoff sleeps."""
+    monkeypatch.setattr(runner, "dial", lambda probe_id=0: True)
+    q = _queue(tmp_path, [ok_job("plain")])
+    monkeypatch.setattr(sys, "argv", ["runner", q])
+    assert runner.main() == 0
+    events = [json.loads(ln) for ln in open(runner.JOURNAL)]
+    assert not any(e.get("event") == "sched" for e in events)
+
+
+def test_unknown_policy_is_usage_error(runner, tmp_path, monkeypatch):
+    q = _queue(tmp_path, [ok_job("a")])
+    monkeypatch.setattr(sys, "argv", ["runner", q, "--policy", "greedy"])
+    assert runner.main() == 2
+    assert not os.path.exists(runner.JOURNAL)  # refused before any write

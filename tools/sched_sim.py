@@ -1,0 +1,255 @@
+"""Fault-injected replay gate for the survival scheduling policy.
+
+The policy's correctness claim ("never bank less evidence than the
+static cheap-first order; strictly more when the relay is wedge-heavy")
+is verifiable with ZERO chip time: replay the r8 queue through every
+banked journal history (``docs/evidence_r*/journal.jsonl``) and through
+seeded synthetic histories whose wedges and dead-dials are drawn from
+the fitted Kaplan-Meier curves themselves, and compare total banked
+evidence value under both orders.
+
+Replay model (docs/SCHEDULING.md "The replay gate"):
+
+* A history is the sequence of dead stretches and healthy windows that
+  ``window_policy.parse_history`` extracts from a journal (real
+  histories), or that inverse-transform sampling from the fitted
+  window/heal curves generates (synthetic; ``--seed`` pins the draw).
+  Wedge-heavy synthetic histories sample windows from the short-lived
+  half of the survival curve and get few of them — the regime the
+  policy exists for.
+* Inside a window, both arms face the same physics: a job's true
+  runtime is the runtime model's estimate times a deterministic
+  per-(history, window, job) jitter in [0.85, 1.25) — estimate error
+  is simulated, and identical for both arms so selection order is the
+  ONLY degree of freedom.  A job that overruns the window dies with
+  the window (a timeout, not a failed attempt — the runner's own
+  ledger rule), the rest of the window is lost, and the next window
+  starts fresh.  Completed jobs bank their declared ``value``.
+* The static arm drains in queue order (cheap-first, the r3-r7
+  protocol); the survival arm calls ``SurvivalScheduler.pick`` with
+  the live window age, exactly the code path the runner runs under
+  ``--policy survival``.  Job-level rc failures are not modeled (both
+  arms would retry identically; window survival is the contested
+  resource).
+
+The gate: policy total >= static total on EVERY history, and strictly
+greater on at least one wedge-heavy one.  ``--bank`` writes the full
+per-history table to ``docs/sched_sim_last.json`` through bank_guard —
+host-side, chip-free, deterministic under its banked seed.  Exit 1 on
+any gate miss (the r8 queue runs this as a setup job, so a regressed
+policy refuses to schedule a round with itself).
+
+Usage:
+    python tools/sched_sim.py [--seed 801] [--queue tools/tpu_queue_r8.json]
+                              [--bank]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+
+TOOLS = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(TOOLS)
+for p in (REPO, TOOLS):
+    if p not in sys.path:  # tools/ is not a package
+        sys.path.insert(0, p)
+
+import window_policy as wp  # noqa: E402
+
+DEFAULT_QUEUE = os.path.join(TOOLS, "tpu_queue_r8.json")
+LAST_PATH = os.path.join(REPO, "docs", "sched_sim_last.json")
+
+# synthetic-history shape: a normal history gets a full night of
+# windows; a wedge-heavy one gets few and short — the r3/r5 regime
+# (22 dials, 2 windows) that motivated the policy
+NORMAL_WINDOWS = 6
+WEDGE_WINDOWS = 3
+
+
+def _jitter(seed: int, hist: str, job: str, widx: int) -> float:
+    """Deterministic runtime jitter, identical across policy arms (a
+    shared rng SEQUENCE would diverge the moment the arms pick in
+    different orders — key the draw by coordinates instead)."""
+    return random.Random(f"{seed}:{hist}:{job}:{widx}").uniform(0.85, 1.25)
+
+
+def real_histories() -> list[tuple[str, list[dict]]]:
+    """(name, trace) per banked journal, via the same parser the
+    policy fits from."""
+    from sparknet_tpu.obs import schema
+
+    out = []
+    for path in wp.default_history_paths(REPO):
+        events = schema.load_journal(path)
+        if not events:
+            continue
+        name = os.path.basename(os.path.dirname(path))
+        out.append((name, wp.parse_history(events).trace))
+    return out
+
+
+def synth_history(model: wp.SurvivalScheduler, rng: random.Random,
+                  wedge_heavy: bool) -> list[dict]:
+    """Alternating dead/window segments drawn from the fitted curves by
+    inverse transform.  Wedge-heavy: window draws confined to the
+    short-lived u-range (u near 1 = low survival), heal draws to the
+    long half."""
+    trace: list[dict] = []
+    n = WEDGE_WINDOWS if wedge_heavy else NORMAL_WINDOWS
+    for _ in range(n):
+        u_heal = rng.random()
+        u_win = rng.random()
+        if wedge_heavy:
+            u_heal = 0.5 * u_heal            # long heals
+            u_win = 0.7 + 0.3 * u_win        # short windows
+        heal = (model.heal_km.sample(u_heal) if model.heal_km.events
+                else wp.DEFAULT_HEAL_MEDIAN_S)
+        trace.append({"kind": "dead", "dur": heal})
+        trace.append({"kind": "window",
+                      "dur": model.window_km.sample(u_win),
+                      "observed": True})
+    return trace
+
+
+def replay(jobs: list[dict], trace: list[dict],
+           model: wp.SurvivalScheduler, policy: str, seed: int,
+           hist: str, max_attempts: int = 10,
+           max_timeouts: int = 8) -> dict:
+    """One arm's pass over one history.  Mirrors the runner's drain
+    semantics: green jobs never re-run, a window death is a timeout
+    (capped separately, never counted vs max_attempts), one shot per
+    job per window, ``needs`` gates on a green dependency."""
+    green: set[str] = set()
+    timeouts: dict[str, int] = {}
+    banked = 0.0
+    windows = 0
+    deaths = 0
+    widx = 0
+    for seg in trace:
+        if seg["kind"] != "window":
+            continue
+        widx += 1
+        windows += 1
+        horizon = float(seg["dur"])
+        age = 0.0
+        attempted: set[str] = set()
+        while True:
+            cands = []
+            for j in jobs:
+                n = j["name"]
+                if (n in green or n in attempted
+                        or timeouts.get(n, 0) >= max_timeouts):
+                    continue
+                need = j.get("needs")
+                if need and need not in green:
+                    continue
+                cands.append(j)
+            if not cands:
+                break
+            if policy == "static":
+                job = cands[0]
+            else:
+                job, _decision = model.pick(cands, age)
+            name = job["name"]
+            attempted.add(name)
+            runtime = model.runtime.estimate(job) * _jitter(
+                seed, hist, name, widx)
+            if age + runtime <= horizon:
+                age += runtime
+                green.add(name)
+                banked += float(job.get("value", 1.0))
+            else:
+                timeouts[name] = timeouts.get(name, 0) + 1
+                deaths += 1
+                break
+    return {"banked_value": round(banked, 3), "jobs_banked": len(green),
+            "windows": windows, "window_deaths": deaths}
+
+
+def run(queue_path: str, seed: int) -> dict:
+    with open(queue_path) as f:
+        spec = json.load(f)
+    jobs = spec["jobs"]
+    model = wp.SurvivalScheduler.fit()
+    histories: list[tuple[str, bool, list[dict]]] = [
+        (name, False, trace) for name, trace in real_histories()]
+    rng = random.Random(seed)
+    for k in range(3):
+        histories.append((f"synth_{k}", False,
+                          synth_history(model, rng, wedge_heavy=False)))
+    for k in range(3):
+        histories.append((f"synth_wedge_{k}", True,
+                          synth_history(model, rng, wedge_heavy=True)))
+
+    rows = []
+    for name, wedge_heavy, trace in histories:
+        static = replay(jobs, trace, model, "static", seed, name)
+        surv = replay(jobs, trace, model, "survival", seed, name)
+        rows.append({
+            "history": name,
+            "wedge_heavy": wedge_heavy,
+            "windows": static["windows"],
+            "static_value": static["banked_value"],
+            "policy_value": surv["banked_value"],
+            "static_jobs": static["jobs_banked"],
+            "policy_jobs": surv["jobs_banked"],
+            "delta": round(surv["banked_value"]
+                           - static["banked_value"], 3),
+        })
+    never_worse = all(r["policy_value"] >= r["static_value"]
+                      for r in rows)
+    strictly = any(r["wedge_heavy"]
+                   and r["policy_value"] > r["static_value"]
+                   for r in rows)
+    return {
+        "tool": "sched_sim",
+        "queue": os.path.relpath(queue_path, REPO),
+        "seed": seed,
+        "model": model.describe(),
+        "histories": rows,
+        "policy_never_worse": never_worse,
+        "strictly_better_on_wedge_heavy": strictly,
+        "ok": never_worse and strictly,
+        # chip-free by construction: a deterministic replay of banked
+        # journal histories — "measured" in the feed_bench host_side
+        # sense (real evidence, no accelerator in the loop)
+        "measured": True,
+        "host_side": True,
+        "chip_free": True,
+        "provenance": "offline replay of docs/evidence_r*/journal.jsonl"
+                      " + seeded KM-sampled fault injection",
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--queue", default=DEFAULT_QUEUE)
+    ap.add_argument("--seed", type=int, default=801)
+    ap.add_argument("--bank", action="store_true",
+                    help=f"bank the record to {LAST_PATH}")
+    args = ap.parse_args()
+    record = run(args.queue, args.seed)
+    print(json.dumps(record, indent=1))
+    # The measured-or-die queue contract (round-5 learning; rc 4 =
+    # window death to the runner).  This gate is host-side evidence by
+    # construction, so the record is always measured — but the knob is
+    # honored explicitly so a future unmeasured arm can never slip a
+    # rehearsal into the bank under an armed queue job.
+    if (os.environ.get("SPARKNET_BENCH_REQUIRE_MEASURED") == "1"
+            and not record["measured"]):
+        return 4
+    if args.bank:
+        # lazy: common imports jax; the gate itself must stay runnable
+        # on a box where only stdlib is healthy
+        from sparknet_tpu.common import bank_guard
+
+        bank_guard(LAST_PATH, record, measured=record["measured"])
+    return 0 if record["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

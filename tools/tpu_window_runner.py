@@ -32,6 +32,18 @@ latency, so this runner automates the round's protocol:
 
 Usage:
     python tools/tpu_window_runner.py tools/tpu_queue_r4.json &
+    python tools/tpu_window_runner.py tools/tpu_queue_r8.json \
+        --policy survival &   # survival-modeled picks (docs/SCHEDULING.md)
+
+``--policy survival`` replaces the static in-order drain with
+``tools/window_policy.py``: a Kaplan-Meier window-survival curve fitted
+from the banked ``docs/evidence_r*/journal.jsonl`` histories picks the
+runnable job maximizing value x P(survive runtime | window age),
+re-planning after every job, and redials after a death with capped
+exponential backoff seeded from the fitted heal-time distribution.
+Every decision is journaled as a schema-valid ``sched`` event.  WITHOUT
+the flag, nothing changes: the default path writes byte-identical
+journal lines.
 
 Queue file format (JSON):
     {"max_hours": 10,
@@ -109,6 +121,31 @@ def load_fit_table() -> dict:
 # missing, import error, jax falling straight back to cpu) would spin
 # the loop hot and flood the journal.  Enforce a floor between dials.
 MIN_DIAL_PERIOD_S = 120.0
+
+# SIGTERM-to-SIGKILL grace on a deadline-killed job (module doc step 2);
+# a module constant so the wedge end-to-end test can shrink it without
+# touching the default path
+TERM_GRACE_S = 30.0
+
+# the survival policy module is a sibling file (tools/ is not a
+# package); loaded once and cached so tests can doctor its constants
+# before main() runs
+_POLICY_MOD = None
+
+
+def load_policy_module():
+    global _POLICY_MOD
+    if _POLICY_MOD is None:
+        import importlib.util
+
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "window_policy.py")
+        spec = importlib.util.spec_from_file_location("window_policy",
+                                                      path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _POLICY_MOD = mod
+    return _POLICY_MOD
 
 
 def log(event: dict) -> None:
@@ -305,7 +342,7 @@ def run_job(job: dict, probe_id: int = 0, setup: bool = False) -> int | None:
         except subprocess.TimeoutExpired:
             proc.send_signal(signal.SIGTERM)
             try:
-                proc.wait(timeout=30)
+                proc.wait(timeout=TERM_GRACE_S)
             except subprocess.TimeoutExpired:
                 proc.kill()
                 proc.wait()
@@ -326,10 +363,16 @@ def run_job(job: dict, probe_id: int = 0, setup: bool = False) -> int | None:
 
 def main() -> int:
     global EVIDENCE_DIR, JOURNAL
-    if len(sys.argv) != 2:
+    argv = list(sys.argv[1:])
+    policy_name = None
+    if "--policy" in argv:
+        i = argv.index("--policy")
+        policy_name = argv[i + 1] if i + 1 < len(argv) else None
+        del argv[i:i + 2]
+    if len(argv) != 1 or policy_name not in (None, "survival"):
         print(__doc__)
         return 2
-    queue_path = sys.argv[1]
+    queue_path = argv[0]
     spec_cache: list = [None]
 
     def load_spec() -> dict:
@@ -352,6 +395,21 @@ def main() -> int:
     stop_at = time.time() + float(spec.get("max_hours", 10)) * 3600
     log({"event": "runner_start", "queue": queue_path,
          "jobs": [j["name"] for j in spec["jobs"]]})
+
+    # --policy survival: fit the censored survival model from every
+    # banked round's journal (plus this round's own, for mid-round
+    # restarts) and journal the fit so the round's record says exactly
+    # which curve priced its decisions.  policy stays None on the
+    # default path — every sched-event write is gated on it.
+    policy = None
+    if policy_name == "survival":
+        wp = load_policy_module()
+        history = wp.default_history_paths()
+        if os.path.exists(JOURNAL) and JOURNAL not in history:
+            history.append(JOURNAL)
+        policy = wp.SurvivalScheduler.fit(history)
+        log({"event": "sched", "kind": "fit", "policy": policy.POLICY,
+             **policy.describe()})
 
     # Host-side setup jobs (top-level "setup" list): run once per runner
     # start, BEFORE any dial — they need no TPU and exist so queued jobs'
@@ -396,12 +454,15 @@ def main() -> int:
                          "job's batch to requeue"})
         return False
 
-    def next_pending(spec: dict, skip: set[str] = frozenset()):
-        """(job, blocked): the next runnable job, plus the set of non-green
-        jobs that can never run again — exhausted attempts, a predicted
-        OOM (pre-flight refusal), a 'needs' naming a job not in the
-        queue, or (transitively) a dead dependency.  With that fixpoint,
-        runnable=None and blocked=[] together mean every job is green."""
+    def pending_jobs(spec: dict, skip: set[str] = frozenset()):
+        """(runnable, blocked): EVERY runnable job in queue order, plus
+        the set of non-green jobs that can never run again — exhausted
+        attempts, a predicted OOM (pre-flight refusal), a 'needs'
+        naming a job not in the queue, or (transitively) a dead
+        dependency.  With that fixpoint, runnable=[] and blocked=[]
+        together mean every job is green.  The static path takes
+        runnable[0] (next_pending); the survival policy scores the
+        whole list."""
         max_attempts = int(spec.get("max_attempts", 3))
         # re-read like the queue itself: a fit table re-banked mid-round
         # (after shrinking a refused job's batch) is picked up without a
@@ -430,7 +491,7 @@ def main() -> int:
                         or (need and (need not in names or need in dead))):
                     dead.add(n)
                     changed = True
-        runnable = None
+        runnable: list[dict] = []
         for j in spec["jobs"]:
             n = j["name"]
             if state.get(n, 0) < 0 or n in dead or n in skip:
@@ -438,9 +499,8 @@ def main() -> int:
             need = j.get("needs")
             if need and state.get(need, 0) >= 0:
                 continue  # dependency not yet green; may still become so
-            if runnable is None:
-                runnable = j
-        if runnable is None and not skip:
+            runnable.append(j)
+        if not runnable and not skip:
             # no runnable job, nothing intentionally skipped: any job still
             # non-green and non-dead can only be waiting on a 'needs' CYCLE
             # (a live dependency would itself be runnable).  Promote to
@@ -449,6 +509,11 @@ def main() -> int:
                 j["name"] for j in spec["jobs"]
                 if state.get(j["name"], 0) >= 0 and j["name"] not in dead)
         return runnable, sorted(dead)
+
+    def next_pending(spec: dict, skip: set[str] = frozenset()):
+        """The static order's view: first runnable job (or None)."""
+        runnable, dead = pending_jobs(spec, skip)
+        return (runnable[0] if runnable else None), dead
 
     # Probe ids must stay unique across runner restarts against the same
     # journal (resume semantics), or a bench record's "probe" field would
@@ -466,6 +531,10 @@ def main() -> int:
     except OSError:
         pass
 
+    # Death-signal streak for the survival policy's redial backoff:
+    # failed dials and window deaths both count; a healthy dial resets.
+    dead_streak = 0
+    last_death_t = 0.0
     while time.time() < stop_at:
         spec = load_spec()  # pick up jobs appended mid-round
         job, blocked = next_pending(spec)
@@ -479,6 +548,21 @@ def main() -> int:
                 return 3
             log({"event": "runner_done", "reason": "queue drained"})
             return 0
+        if policy is not None and dead_streak:
+            # Survival-informed redial backoff: defer the dial by the
+            # fitted-heal-curve delay, minus wedge time already served
+            # (a failed dial's own ~1505 s self-fail paces the early
+            # streak for free).  Each deferred dial is journaled — the
+            # tunnel log renders why the runner sat quiet.
+            delay = policy.redial_delay(dead_streak)
+            wait = min(delay - (time.time() - last_death_t),
+                       stop_at - time.time())
+            if wait > 0:
+                log({"event": "sched", "kind": "redial_backoff",
+                     "policy": policy.POLICY, "delay_s": round(wait, 1),
+                     "consecutive_dead": dead_streak,
+                     "heal_median_s": round(policy.heal_median_s, 1)})
+                time.sleep(wait)
         t0 = time.time()
         probe_id += 1
         ok = dial(probe_id)
@@ -486,28 +570,72 @@ def main() -> int:
             # a dead-backend dial takes ~25 min and is its own backoff; a
             # FAST failure (broken plugin → instant cpu fallback) must not
             # spin the loop hot
-            elapsed = time.time() - t0
-            backoff = min(MIN_DIAL_PERIOD_S - elapsed, stop_at - time.time())
-            if backoff > 0:
-                time.sleep(backoff)
+            dead_streak += 1
+            last_death_t = time.time()
+            if policy is None:
+                elapsed = time.time() - t0
+                backoff = min(MIN_DIAL_PERIOD_S - elapsed,
+                              stop_at - time.time())
+                if backoff > 0:
+                    time.sleep(backoff)
             continue
+        dead_streak = 0
         # Window open: drain everything runnable, re-deriving the next
         # job from the journal after each run so (a) a job's dependents
         # run in the SAME window once it goes green, and (b) a job a
         # human ran in parallel isn't repeated.  A job that fails gets
         # one shot per window (`attempted`); a job that HANGS means the
-        # window closed, so back to dialing.
+        # window closed, so back to dialing.  Under --policy survival
+        # the "next job" is the value x P(survive | window age) argmax
+        # over ALL runnable jobs, re-planned after every run (a job
+        # finishing early/late re-prices the rest of the window), and
+        # each pick is journaled.
+        window_t0 = time.time()
+        expected_value = 0.0
+        banked_value = 0.0
+        jobs_banked = 0
+        died = False
         attempted: set[str] = set()
         while True:
-            job, _ = next_pending(load_spec(), skip=attempted)
+            spec_now = load_spec()
+            if policy is None:
+                job, _ = next_pending(spec_now, skip=attempted)
+            else:
+                cands, _ = pending_jobs(spec_now, skip=attempted)
+                job, decision = policy.pick(cands,
+                                            time.time() - window_t0)
+                if job is not None:
+                    log({"event": "sched", "kind": "pick",
+                         "probe": probe_id, **decision})
+                    expected_value += decision["score"]
             if job is None:
                 break
             attempted.add(job["name"])
+            t_job = time.time()
             rc = run_job(job, probe_id)
+            if policy is not None:
+                policy.observe(job, time.time() - t_job, rc)
+                if rc == 0:
+                    banked_value += float(job.get("value", 1.0))
+                    jobs_banked += 1
             if window_death(rc, job):
                 # the window is gone — dial, don't drain the next job
                 # against a dead backend
+                died = True
                 break
+        if policy is not None:
+            # per-window reconciliation: what the model expected to bank
+            # (sum of pick scores) vs what actually banked — the tunnel
+            # log's calibration table reads exactly these events
+            log({"event": "sched", "kind": "window_summary",
+                 "policy": policy.POLICY, "probe": probe_id,
+                 "window_age_s": round(time.time() - window_t0, 1),
+                 "expected_value": round(expected_value, 3),
+                 "banked_value": round(banked_value, 3),
+                 "jobs_banked": jobs_banked})
+        if died:
+            dead_streak = 1
+            last_death_t = time.time()
     log({"event": "runner_done", "reason": "max_hours reached"})
     return 0
 

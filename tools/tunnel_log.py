@@ -50,8 +50,13 @@ def render(events: list[dict], round_no: int) -> str:
     # part of the round's record (the round-7 slo verdicts were the
     # first casualties of the old silent fallthrough)
     handled = {"dial_start", "dial_end", "dial_abandoned", "job_start",
-               "job_end", "slo", "runner_start", "runner_done"}
+               "job_end", "slo", "runner_start", "runner_done", "sched"}
     other: dict[str, int] = {}
+    sched: list[str] = []
+    # per-window expected-vs-actual reconciliation (sched
+    # window_summary events, --policy survival only): the round's
+    # calibration record of the survival model's pricing
+    recon: list[dict] = []
     for ev in events:
         kind = ev.get("event")
         if kind not in handled:
@@ -93,6 +98,32 @@ def render(events: list[dict], round_no: int) -> str:
                 f"SLO {verdict} for `{ev.get('job')}`: "
                 f"{ev.get('applicable')}/{ev.get('gates')} gate(s) "
                 f"applicable over `{ev.get('journal', '?')}`")
+        elif kind == "sched":
+            # survival-policy decisions (tools/window_policy.py via
+            # `--policy survival`): picks and backoffs render as
+            # bullets, window summaries feed the reconciliation table
+            k = ev.get("kind")
+            if k == "fit":
+                sched.append(
+                    f"fit: {ev.get('windows', 0)} window(s) / "
+                    f"{ev.get('window_deaths', 0)} death(s), median "
+                    f"window {ev.get('median_window_s', 0)} s, heal "
+                    f"median {ev.get('heal_median_s', 0)} s from "
+                    f"{len(ev.get('sources') or [])} journal(s)")
+            elif k == "pick":
+                sched.append(
+                    f"pick `{ev.get('job')}` (probe {ev.get('probe')}) "
+                    f"at age {ev.get('window_age_s')} s: value "
+                    f"{ev.get('value')} x p {ev.get('p_survive')} = "
+                    f"{ev.get('score')} over {ev.get('candidates')} "
+                    f"candidate(s)")
+            elif k == "redial_backoff":
+                sched.append(
+                    f"redial backoff {ev.get('delay_s')} s after "
+                    f"{ev.get('consecutive_dead')} consecutive "
+                    f"death(s)")
+            elif k == "window_summary":
+                recon.append(ev)
     for p in sorted(k for k in dials if k):
         d = dials[p]
         if "ok" not in d:
@@ -112,6 +143,26 @@ def render(events: list[dict], round_no: int) -> str:
     if jobs:
         lines += ["", "## Jobs run in healthy windows", ""]
         lines += [f"- {j}" for j in jobs]
+    if sched:
+        lines += ["", "## Scheduler decisions (`--policy survival`)", ""]
+        lines += [f"- {s}" for s in sched]
+    if recon:
+        lines += [
+            "", "## Expected vs banked evidence value, per window", "",
+            "Expected = sum of pick scores (value x P(survive)); "
+            "banked = sum of values of jobs that went green "
+            "(docs/SCHEDULING.md).",
+            "",
+            "| probe | window s | expected | banked | jobs banked |",
+            "|---|---|---|---|---|",
+        ]
+        for ev in recon:
+            lines.append(
+                f"| {ev.get('probe', '?')} | "
+                f"{ev.get('window_age_s', '?')} | "
+                f"{ev.get('expected_value', '?')} | "
+                f"{ev.get('banked_value', '?')} | "
+                f"{ev.get('jobs_banked', '?')} |")
     if other:
         lines += ["", "Other journal events (rendered by `python -m "
                       "sparknet_tpu.obs report`): " +

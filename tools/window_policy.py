@@ -1,0 +1,516 @@
+"""Survival-modeled evidence scheduling for the TPU window runner.
+
+SparkNet's pitch was extracting useful work from unreliable workers;
+this repo's equivalent scarce, flaky resource is the axon relay, whose
+healthy windows last 5-30 minutes and whose wedges last hours
+(CLAUDE.md "TPU tunnel protocol").  Seven rounds of journaled history
+(``docs/evidence_r*/journal.jsonl``) record every dial, window death,
+and job outcome — enough data to stop scheduling by folklore
+("cheap-first, traces-last") and start scheduling by model:
+
+* **Window survival** — a Kaplan-Meier product-limit fit over window
+  lifetimes (healthy ``dial_end`` -> the ``job_end`` that killed the
+  window).  Windows still healthy when the queue drained or the runner
+  stopped are right-CENSORED, not dropped — censoring is most of the
+  r4 data and ignoring it would bias lifetimes short.
+* **Heal times** — the same estimator over dead-dial streaks (first
+  dead dial -> the next healthy ``dial_end``); a trailing streak with
+  no heal is censored.  Seeds the capped-exponential redial backoff.
+* **Job runtimes** — per-name (then per-tool) medians of journaled
+  successful runs, refreshed mid-round as jobs finish early/late;
+  queue-declared ``est_runtime_s`` fills the gap for never-run jobs.
+
+The policy itself is one line: pick the runnable job maximizing
+``value x P(survive est_runtime | window age)`` — expected evidence
+value banked before the wedge.  Hard constraints stay hard: traces go
+last (2-for-2 correlated with wedges in r1/r3), and predicted-OOM jobs
+never reach the candidate set (the runner's memcheck pre-flight
+refuses them before any dial, collapsing the model's OOM-risk term to
+a hard gate).
+
+Deliberately stdlib-only, like ``analysis/mem_model`` and
+``obs/schema``: the window runner imports this while babysitting a
+wedged relay, so nothing here may initialize a backend.  Offline
+verification lives in ``tools/sched_sim.py`` (fault-injected replay of
+the journal histories — zero chip time); docs/SCHEDULING.md is the
+narrative.
+
+CLI (inspection only):
+    python tools/window_policy.py            # fit + summary JSON
+    python tools/window_policy.py j1.jsonl   # fit named journals
+"""
+
+from __future__ import annotations
+
+import calendar
+import glob
+import json
+import math
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # standalone invocation: tools/ is not a package
+    sys.path.insert(0, REPO)
+
+from sparknet_tpu.obs import schema  # noqa: E402  (stdlib-only by contract)
+
+# journal wall-stamp format (schema._UTC_FMT is private; the format is
+# frozen by seven rounds of banked history and restated here)
+_UTC_FMT = "%Y-%m-%d %H:%M:%SZ"
+
+# survival below this is "the window is already gone" — conditional
+# probabilities divide by it, so it doubles as the division floor
+_EPS = 1e-9
+
+# redial backoff rails: never below the runner's anti-hot-spin floor,
+# base capped so the FIRST deferred dial never waits longer than a dead
+# dial would, total capped at 30 min (a heal can land any time — the
+# backoff exists to stop burning 25-min dead dials seconds apart, not
+# to stop dialing)
+BACKOFF_FLOOR_S = 120.0
+BACKOFF_BASE_CAP_S = 900.0
+BACKOFF_CAP_S = 1800.0
+
+# with zero journaled heals (fresh repo), assume the observed r4/r5
+# shape: wedges are hours-scale, so the backoff base lands mid-rail
+DEFAULT_HEAL_MEDIAN_S = 6000.0
+
+# Every banked heal so far straddles a runner restart (the operator
+# restarted the runner when the relay healed: r4 probes 29/40, r5
+# probe 16 all land seconds after a runner_start).  A restart whose
+# offline gap is under this bound continues the same wedge — censoring
+# there would discard every observed heal; a longer gap means the box
+# was genuinely offline, so the streak closes censored.
+RESTART_BRIDGE_S = 7200.0
+
+
+def default_history_paths(repo: str = REPO) -> list[str]:
+    """Every banked runner journal, oldest round first."""
+    return sorted(glob.glob(
+        os.path.join(repo, "docs", "evidence_r*", "journal.jsonl")))
+
+
+def _ts(ev: dict) -> float | None:
+    """Journal wall stamp -> epoch seconds (None when absent/torn)."""
+    utc = ev.get("utc")
+    if not isinstance(utc, str):
+        return None
+    try:
+        return float(calendar.timegm(time.strptime(utc, _UTC_FMT)))
+    except ValueError:
+        return None
+
+
+def job_tool(argv: list) -> str:
+    """The tool a queue job runs: the first ``*.py`` basename, or the
+    module named by ``-m`` — the runtime model's fallback pool when a
+    job NAME has no history (e.g. a fresh A/B arm of a known bench)."""
+    toks = [str(a) for a in argv]
+    for i, tok in enumerate(toks):
+        if tok == "-m" and i + 1 < len(toks):
+            return toks[i + 1]
+        if tok.endswith(".py"):
+            return os.path.basename(tok)
+    return toks[0] if toks else "?"
+
+
+def is_trace_job(job: dict) -> bool:
+    """Traces go LAST — the one folklore rule the policy keeps as a
+    hard constraint (2-for-2 correlated with wedges in r1/r3; the
+    ``queue-job-hygiene`` lint rule enforces the same ordering on the
+    static queue)."""
+    argv = [str(a) for a in job.get("argv", [])]
+    return "--trace" in argv or str(job.get("name", "")).startswith("trace")
+
+
+class KaplanMeier:
+    """Product-limit survival estimator over right-censored durations.
+
+    ``durations[i]`` is a window lifetime (or heal time) in seconds;
+    ``observed[i]`` True when the death/heal was actually seen, False
+    when the observation was cut short (queue drained, runner stopped).
+    Beyond the last observation the curve is extrapolated with the
+    curve's own average hazard (exponential tail) so conditional
+    survival keeps decaying instead of flat-lining at the last step —
+    a policy that believes windows become immortal past the observed
+    support would happily start a 20-minute trace at minute 29.
+    """
+
+    def __init__(self, durations: list[float], observed: list[bool]):
+        pairs = sorted(zip(durations, observed))
+        self.n = len(pairs)
+        self.events = sum(1 for _, obs in pairs if obs)
+        self.steps: list[tuple[float, float]] = []  # (t, S(t)) at deaths
+        at_risk = self.n
+        s = 1.0
+        i = 0
+        while i < self.n:
+            t = pairs[i][0]
+            deaths = 0
+            j = i
+            while j < self.n and pairs[j][0] == t:
+                deaths += int(pairs[j][1])
+                j += 1
+            if deaths and at_risk > 0:
+                s *= 1.0 - deaths / at_risk
+                self.steps.append((t, max(s, 0.0)))
+            at_risk -= j - i
+            i = j
+        self.t_max = pairs[-1][0] if pairs else 0.0
+        s_end = self.steps[-1][1] if self.steps else 1.0
+        # average hazard over the observed support; 0 when the curve
+        # never dropped (censored-only data — no basis for a rate)
+        if self.t_max > 0 and s_end < 1.0:
+            self._tail_rate = -math.log(max(s_end, _EPS)) / self.t_max
+        else:
+            self._tail_rate = 0.0
+
+    def survival(self, t: float) -> float:
+        """S(t): probability of lasting at least ``t`` seconds."""
+        if t <= 0:
+            return 1.0
+        s = 1.0
+        for step_t, step_s in self.steps:
+            if step_t <= t:
+                s = step_s
+            else:
+                break
+        if t > self.t_max and self._tail_rate > 0:
+            s = min(s, max(self.steps[-1][1] if self.steps else 1.0,
+                           _EPS)) * math.exp(
+                -self._tail_rate * (t - self.t_max))
+        return max(s, 0.0)
+
+    def conditional(self, age: float, dt: float) -> float:
+        """P(survive ``age + dt`` | survived ``age``) — the policy's
+        "will this job outlive the wedge" term."""
+        base = self.survival(age)
+        if base <= _EPS:
+            return 0.0
+        return min(self.survival(age + dt) / base, 1.0)
+
+    def quantile(self, q: float) -> float:
+        """Smallest t with S(t) <= 1 - q (e.g. q=0.5 -> median)."""
+        target = 1.0 - q
+        for step_t, step_s in self.steps:
+            if step_s <= target:
+                return step_t
+        if self._tail_rate > 0:
+            s_end = max(self.steps[-1][1] if self.steps else 1.0, _EPS)
+            if target < s_end:
+                return self.t_max + math.log(s_end / max(target, _EPS)) \
+                    / self._tail_rate
+        return self.t_max  # censored-only curve: best available bound
+
+    def sample(self, u: float) -> float:
+        """Inverse-transform draw: the duration whose survival equals
+        ``u`` (pass ``rng.random()``); capped at 4x the observed
+        support so censored-heavy curves cannot return infinities."""
+        u = min(max(u, _EPS), 1.0)
+        return min(self.quantile(1.0 - u),
+                   max(self.t_max, 1.0) * 4.0)
+
+    def to_dict(self) -> dict:
+        return {"n": self.n, "events": self.events,
+                "median_s": round(self.quantile(0.5), 1),
+                "steps": [[round(t, 1), round(s, 4)]
+                          for t, s in self.steps]}
+
+
+class RuntimeModel:
+    """Expected job runtime from journaled outcomes, by name then tool.
+
+    Lookup order (docs/SCHEDULING.md "Runtime model"): the job NAME's
+    own successful history (median — robust to the one 1204 s rc-4
+    outlier in r4), else the queue-declared ``est_runtime_s`` policy
+    field, else the TOOL's history pooled across job names, else half
+    the deadline (the runner's only prior).  ``observe`` feeds the
+    current round back in, so mid-window re-planning sees a job that
+    just ran 3x its estimate."""
+
+    def __init__(self) -> None:
+        self.by_name: dict[str, list[float]] = {}
+        self.by_tool: dict[str, list[float]] = {}
+
+    def observe(self, name: str, tool: str, dt_s: float,
+                rc: object) -> None:
+        if rc == 0 and dt_s > 0:
+            self.by_name.setdefault(name, []).append(float(dt_s))
+            self.by_tool.setdefault(tool, []).append(float(dt_s))
+
+    @staticmethod
+    def _median(xs: list[float]) -> float:
+        ys = sorted(xs)
+        mid = len(ys) // 2
+        return ys[mid] if len(ys) % 2 else 0.5 * (ys[mid - 1] + ys[mid])
+
+    def estimate(self, job: dict) -> float:
+        name = str(job.get("name", "?"))
+        if self.by_name.get(name):
+            return self._median(self.by_name[name])
+        declared = job.get("est_runtime_s")
+        if isinstance(declared, (int, float)) and declared > 0:
+            return float(declared)
+        tool = job_tool(job.get("argv", []))
+        if self.by_tool.get(tool):
+            return self._median(self.by_tool[tool])
+        return 0.5 * float(job.get("deadline_s", 1200))
+
+
+class History:
+    """One journal's survival observations: censored window lifetimes,
+    censored heal times, and per-job run outcomes."""
+
+    def __init__(self) -> None:
+        self.windows: list[tuple[float, bool]] = []
+        self.heals: list[tuple[float, bool]] = []
+        self.runs: list[tuple[str, str, float, object, bool]] = []
+        # ordered replay trace for the simulator: dicts with kind
+        # "dead" (wedge time) or "window" (healthy lifetime + whether
+        # the death was observed)
+        self.trace: list[dict] = []
+
+
+def parse_history(events: list[dict]) -> History:
+    """Walk one runner journal into survival observations.
+
+    Window lifetime runs from the healthy ``dial_end`` to the
+    ``job_end`` that carries the death (``timed_out`` / rc-None /
+    ``window_death``); a window still open at the next ``dial_start``,
+    ``runner_start``, or end-of-journal closes CENSORED at its last
+    activity.  Heal time runs from the first dead dial of a streak to
+    the next healthy ``dial_end``; a ``runner_start`` BRIDGES the
+    streak when the offline gap is under :data:`RESTART_BRIDGE_S` (the
+    wedge did not heal just because the runner restarted — every
+    observed heal in r4/r5 straddles a restart) and censors it on a
+    longer gap (wall time across a genuinely offline stretch would
+    inflate heals).  Setup jobs never touch windows (they run before
+    any dial)."""
+    h = History()
+    window_open: float | None = None     # healthy dial_end ts
+    last_activity: float | None = None   # last ts inside the window
+    streak_start: float | None = None    # first dead dial's dial_start
+    last_dial_start: float | None = None
+    prev_ts: float | None = None         # last stamped event seen
+    argv_by_job: dict[str, list] = {}
+
+    def close_window(end: float | None, observed: bool) -> None:
+        nonlocal window_open, last_activity
+        if window_open is None:
+            return
+        end = end if end is not None else last_activity
+        if end is not None and end >= window_open:
+            h.windows.append((end - window_open, observed))
+            h.trace.append({"kind": "window", "dur": end - window_open,
+                            "observed": observed})
+        window_open, last_activity = None, None
+
+    def close_streak(end: float | None, observed: bool) -> None:
+        nonlocal streak_start
+        if streak_start is None:
+            return
+        if end is not None and end >= streak_start:
+            h.heals.append((end - streak_start, observed))
+            h.trace.append({"kind": "dead", "dur": end - streak_start})
+        streak_start = None
+
+    for ev in events:
+        kind = ev.get("event")
+        ts = _ts(ev)
+        if kind == "runner_start":
+            close_window(None, False)
+            if streak_start is not None:
+                gap = (ts - prev_ts if ts is not None
+                       and prev_ts is not None else None)
+                if gap is None or gap > RESTART_BRIDGE_S:
+                    close_streak(prev_ts, False)
+        elif kind == "dial_start":
+            close_window(None, False)
+            last_dial_start = ts
+        elif kind == "dial_end":
+            if ev.get("ok"):
+                close_streak(ts, True)
+                window_open = ts
+                last_activity = ts
+            elif streak_start is None:
+                streak_start = (last_dial_start if last_dial_start
+                                is not None else ts)
+        elif kind == "job_start":
+            if not ev.get("setup"):
+                argv_by_job[str(ev.get("job", "?"))] = \
+                    ev.get("argv") or []
+        elif kind == "job_end":
+            if ev.get("setup"):
+                continue
+            name = str(ev.get("job", "?"))
+            rc = ev.get("rc")
+            dead = (rc is None or bool(ev.get("timed_out"))
+                    or bool(ev.get("window_death")))
+            h.runs.append((name, job_tool(argv_by_job.get(name, [])),
+                           float(ev.get("dt_s", 0) or 0), rc, dead))
+            if window_open is not None and ts is not None:
+                last_activity = ts
+                if dead:
+                    close_window(ts, True)
+                    streak_start = ts  # the wedge starts at the death
+        if ts is not None:
+            prev_ts = ts
+    close_window(None, False)
+    close_streak(prev_ts, False)
+    return h
+
+
+class SurvivalScheduler:
+    """The ``--policy survival`` brain: fitted curves + the picker.
+
+    Everything the runner journals about a decision comes from
+    :meth:`pick`'s decision dict, already shaped for the ``sched``
+    obsnet event (``schema.EVENTS``)."""
+
+    POLICY = "survival"
+
+    def __init__(self, window_km: KaplanMeier, heal_km: KaplanMeier,
+                 runtime: RuntimeModel, sources: list[str]):
+        self.window_km = window_km
+        self.heal_km = heal_km
+        self.runtime = runtime
+        self.sources = sources
+
+    # -- fitting ---------------------------------------------------------
+
+    @classmethod
+    def fit(cls, journal_paths: list[str] | None = None
+            ) -> "SurvivalScheduler":
+        paths = (default_history_paths() if journal_paths is None
+                 else list(journal_paths))
+        wd: list[float] = []
+        wo: list[bool] = []
+        hd: list[float] = []
+        ho: list[bool] = []
+        runtime = RuntimeModel()
+        used: list[str] = []
+        for path in paths:
+            events = schema.load_journal(path)
+            if not events:
+                continue
+            h = parse_history(events)
+            used.append(path)
+            for dur, obs in h.windows:
+                wd.append(dur)
+                wo.append(obs)
+            for dur, obs in h.heals:
+                hd.append(dur)
+                ho.append(obs)
+            for name, tool, dt_s, rc, _dead in h.runs:
+                runtime.observe(name, tool, dt_s, rc)
+        return cls(KaplanMeier(wd, wo), KaplanMeier(hd, ho), runtime,
+                   used)
+
+    # -- the policy ------------------------------------------------------
+
+    def p_survive(self, age_s: float, runtime_s: float) -> float:
+        return self.window_km.conditional(age_s, runtime_s)
+
+    def score_job(self, job: dict, age_s: float,
+                  oom_risk: float = 0.0) -> dict:
+        """One candidate's decision record: value x P(survive runtime |
+        window age) x (1 - oom_risk).  The runner's memcheck pre-flight
+        refuses predicted-OOM jobs before the candidate set forms, so
+        its ``oom_risk`` is a hard {0, 1} collapsed upstream; the term
+        stays explicit for the simulator and any softer future gate."""
+        est = self.runtime.estimate(job)
+        p = self.p_survive(age_s, est)
+        value = float(job.get("value", 1.0))
+        return {
+            "job": str(job.get("name", "?")),
+            "window_age_s": round(age_s, 1),
+            "est_runtime_s": round(est, 1),
+            "p_survive": round(p, 4),
+            "value": value,
+            "score": round(value * p * (1.0 - oom_risk), 4),
+        }
+
+    def pick(self, jobs: list[dict], age_s: float
+             ) -> tuple[dict | None, dict | None]:
+        """The next job to spend window time on, plus its journalable
+        decision.  Among runnable candidates: traces are only eligible
+        once no non-trace candidate remains (hard constraint), then
+        argmax score, ties to the CHEAPER estimate (a tie in expected
+        value should not gamble more window), then queue order."""
+        if not jobs:
+            return None, None
+        pool = [j for j in jobs if not is_trace_job(j)] or list(jobs)
+        best = None
+        best_key = None
+        best_decision = None
+        for idx, job in enumerate(pool):
+            d = self.score_job(job, age_s)
+            key = (-d["score"], d["est_runtime_s"], idx)
+            if best_key is None or key < best_key:
+                best, best_key, best_decision = job, key, d
+        best_decision["policy"] = self.POLICY
+        best_decision["candidates"] = len(jobs)
+        return best, best_decision
+
+    def observe(self, job: dict, dt_s: float, rc: object) -> None:
+        """Fold a just-finished run back into the runtime model — the
+        mid-window re-planning input (a job that ran 3x its estimate
+        re-prices every subsequent pick this window)."""
+        self.runtime.observe(str(job.get("name", "?")),
+                             job_tool(job.get("argv", [])), dt_s, rc)
+
+    # -- redial backoff --------------------------------------------------
+
+    @property
+    def heal_median_s(self) -> float:
+        if self.heal_km.events:
+            return self.heal_km.quantile(0.5)
+        return DEFAULT_HEAL_MEDIAN_S
+
+    def redial_delay(self, consecutive_dead: int) -> float:
+        """Capped exponential backoff between dials while the relay is
+        wedged, seeded from the fitted heal-time distribution: base =
+        heal_median / 32 clamped to [120 s, 900 s], doubled per
+        consecutive death signal, capped at 30 min.  A dead dial's own
+        ~1505 s self-fail already paces the early streak (the runner
+        subtracts elapsed time), so the exponential only starts adding
+        real sleep once the streak says the wedge is hours-long."""
+        base = min(max(self.heal_median_s / 32.0, BACKOFF_FLOOR_S),
+                   BACKOFF_BASE_CAP_S)
+        return min(base * (2.0 ** max(consecutive_dead - 1, 0)),
+                   BACKOFF_CAP_S)
+
+    # -- provenance ------------------------------------------------------
+
+    def describe(self) -> dict:
+        """Fit summary for the ``sched`` fit event and the simulator's
+        banked record."""
+        return {
+            "windows": self.window_km.n,
+            "window_deaths": self.window_km.events,
+            "median_window_s": round(self.window_km.quantile(0.5), 1),
+            "heals": self.heal_km.n,
+            "heals_observed": self.heal_km.events,
+            "heal_median_s": round(self.heal_median_s, 1),
+            "sources": [os.path.relpath(p, REPO) if os.path.isabs(p)
+                        else p for p in self.sources],
+        }
+
+
+def main() -> int:
+    paths = sys.argv[1:] or default_history_paths()
+    sched = SurvivalScheduler.fit(paths)
+    out = sched.describe()
+    out["window_km"] = sched.window_km.to_dict()
+    out["heal_km"] = sched.heal_km.to_dict()
+    out["runtime_names"] = {
+        name: round(RuntimeModel._median(runs), 1)
+        for name, runs in sorted(sched.runtime.by_name.items())}
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
