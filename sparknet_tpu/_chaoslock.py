@@ -1,0 +1,207 @@
+"""Chaos-schedule lock instrumentation (``SPARKNET_CHAOS_SCHED``).
+
+The concurrency plane's locks are constructed through the named
+factories below (``named_lock``/``named_rlock``/``named_condition``)
+instead of bare ``threading`` constructors.  With the env var unset the
+factories return the *plain* ``threading`` primitive — zero wrappers,
+zero overhead, byte-identical runtime behavior (the ``SPARKNET_OBS``
+pattern).  With ``SPARKNET_CHAOS_SCHED=<seed>`` set they return
+instrumented proxies that
+
+- inject small *seeded* sleeps at every acquire (yield-point jitter:
+  the scheduler is shaken deterministically per (seed, lock name), so a
+  latent ordering bug has many chances to fire and a found interleaving
+  can be replayed by seed), and
+- record the actual lock-acquisition **edges** — (holder's innermost
+  lock, newly acquired lock) per thread — into a process-global
+  registry that ``python -m sparknet_tpu.obs dryrun`` diffs against the
+  static acquisition graph banked in ``docs/conc_contracts/
+  lock_graph.json`` (conccheck leg (c): any observed edge absent from
+  the static graph fails the dryrun).
+
+Lock *names* are the contract: the string passed to a factory must
+match the qualified id conccheck derives statically (``Class.attr`` for
+instance/class locks, ``module._name`` for module-level locks) or the
+observed-vs-static diff reports phantom edges.  conccheck reads the
+factory-call string argument as the lock id, so the two stay aligned
+by construction.
+
+Stdlib-only on purpose: ``serve/batcher.py`` keeps its direct import
+surface stdlib-only, and ``sparknet_tpu.analysis`` must be importable
+with no jax/numpy.  The public names are re-exported from
+``sparknet_tpu.common`` (docs/CONCURRENCY.md).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+import zlib
+
+__all__ = [
+    "chaos_armed",
+    "chaos_seed",
+    "named_condition",
+    "named_lock",
+    "named_rlock",
+    "observed_edges",
+    "reset_observed",
+]
+
+_CHAOS_ENV = "SPARKNET_CHAOS_SCHED"
+
+# process-global observed-edge registry; guarded by a PLAIN lock (the
+# instrumentation must never recurse into itself)
+_reg_lock = threading.Lock()
+_edges: set[tuple[str, str]] = set()
+_tls = threading.local()
+
+
+def chaos_seed() -> int | None:
+    """The armed chaos seed, or None when the mode is off (env unset,
+    empty, or not an integer — a malformed value never arms a mode
+    whose whole point is determinism)."""
+    raw = os.environ.get(_CHAOS_ENV, "").strip()
+    if not raw:
+        return None
+    try:
+        return int(raw, 0)
+    except ValueError:
+        return None
+
+
+def chaos_armed() -> bool:
+    return chaos_seed() is not None
+
+
+def observed_edges() -> set[tuple[str, str]]:
+    """Snapshot of every (outer, inner) acquisition edge recorded so
+    far in this process (empty when the mode is off)."""
+    with _reg_lock:
+        return set(_edges)
+
+
+def reset_observed() -> None:
+    """Drop the recorded edges (test isolation)."""
+    with _reg_lock:
+        _edges.clear()
+
+
+def _held_stack() -> list[str]:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = []
+        _tls.stack = stack
+    return stack
+
+
+def _record_edge(outer: str, inner: str) -> None:
+    with _reg_lock:
+        _edges.add((outer, inner))
+
+
+def _lock_rng(name: str, seed: int) -> random.Random:
+    # crc32 of the lock name XOR the seed: stable across runs and
+    # processes (never the salted builtin hash()), distinct per lock
+    return random.Random((zlib.crc32(name.encode("utf-8")) ^ seed)
+                         & 0xFFFFFFFF)
+
+
+class _ChaosProxy:
+    """Instrumented wrapper around one threading primitive.
+
+    Acquire-side protocol: record the edge from the calling thread's
+    innermost held lock (skipping reentrant re-acquires), jitter by a
+    seeded sleep (the yield point), then delegate.  Release pops the
+    per-thread held stack.  Everything else (``wait``/``notify_all``/
+    ``locked``/...) delegates verbatim, so a Condition proxy behaves
+    like a Condition.
+    """
+
+    def __init__(self, inner, name: str, seed: int):
+        self._inner = inner
+        self.name = name
+        self._rng = _lock_rng(name, seed)
+
+    # -- acquisition bookkeeping ---------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        stack = _held_stack()
+        if stack and self.name not in stack:
+            _record_edge(stack[-1], self.name)
+        # the yield point: a seeded, per-lock jitter BEFORE the acquire
+        # widens the interleaving space deterministically.  rng state
+        # races between threads only scramble jitter, never correctness.
+        r = self._rng.random()
+        time.sleep(0.002 if r < 0.05 else r * 5e-4)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            stack.append(self.name)
+        return got
+
+    def release(self) -> None:
+        stack = _held_stack()
+        if self.name in stack:
+            # pop the innermost occurrence (reentrant locks stack)
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i] == self.name:
+                    del stack[i]
+                    break
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    # -- Condition / Lock passthroughs ---------------------------------
+    def wait(self, timeout: float | None = None):
+        return self._inner.wait(timeout)
+
+    def wait_for(self, predicate, timeout: float | None = None):
+        return self._inner.wait_for(predicate, timeout)
+
+    def notify(self, n: int = 1) -> None:
+        self._inner.notify(n)
+
+    def notify_all(self) -> None:
+        self._inner.notify_all()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def __repr__(self) -> str:
+        return f"<chaos {self.name} wrapping {self._inner!r}>"
+
+
+def named_lock(name: str):
+    """A ``threading.Lock`` — instrumented iff chaos mode is armed."""
+    seed = chaos_seed()
+    if seed is None:
+        return threading.Lock()
+    return _ChaosProxy(threading.Lock(), name, seed)
+
+
+def named_rlock(name: str):
+    """A ``threading.RLock`` — instrumented iff chaos mode is armed."""
+    seed = chaos_seed()
+    if seed is None:
+        return threading.RLock()
+    return _ChaosProxy(threading.RLock(), name, seed)
+
+
+def named_condition(name: str):
+    """A ``threading.Condition`` — instrumented iff chaos mode is
+    armed.  The proxy's ``with``/``wait``/``notify_all`` surface
+    matches Condition's."""
+    seed = chaos_seed()
+    if seed is None:
+        return threading.Condition()
+    return _ChaosProxy(threading.Condition(), name, seed)

@@ -14,6 +14,12 @@ Three engines share one front door and one findings schema:
   against XLA's ``memory_analysis()``, banking docs/mem_contracts/;
   ``--fit`` runs the batch-fit solver the window runner's queue
   pre-flight consults).
+* ``conc``  — conccheck, the static concurrency-contract analysis
+  (lock-discipline inference, lock-order + blocking-call audit, and
+  the thread/process taxonomy over the serving/feed/loop plane,
+  banking docs/conc_contracts/; the chaos scheduler
+  ``SPARKNET_CHAOS_SCHED`` cross-validates the banked graph at
+  dryrun time).  Pure AST — no jax, no lowering, zero chip time.
 
 Exit codes (all subcommands): 0 clean (or suppressed-only), 1
 unsuppressed findings, 2 usage error.  ``--json`` (or the legacy
@@ -268,12 +274,55 @@ def mem_main(argv: list[str] | None = None) -> int:
     return 1 if any(not f.suppressed for f in findings) else 0
 
 
+def conc_main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m sparknet_tpu.analysis conc",
+        description="conccheck: infer lock discipline and the static "
+        "lock-acquisition graph over the serving/feed/loop plane "
+        "(serve/, loop/, obs/, the process feed, the window runner), "
+        "fail on lock-order cycles, blocking calls under a lock, and "
+        "jax reachable from ring workers, and diff against the banked "
+        "manifests (docs/conc_contracts/) — pure AST, zero chip time",
+    )
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--json", action="store_true",
+                    help="shorthand for --format json")
+    ap.add_argument("--update", action="store_true",
+                    help="rewrite the banked manifests (and the "
+                    "SOURCES.json freshness fingerprint) instead of "
+                    "diffing against them")
+    ap.add_argument("--show-suppressed", action="store_true")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the concurrency-rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    from sparknet_tpu.analysis import conccheck
+
+    if args.list_rules:
+        for rule_id, summary in conccheck.iter_rules():
+            print(f"{rule_id}: {summary}")
+        return 0
+
+    findings, _ = conccheck.run_conccheck(update=args.update)
+    if args.json or args.format == "json":
+        print(render_json(findings))
+    else:
+        print(render_text(findings, show_suppressed=args.show_suppressed,
+                          label="conccheck"))
+        if args.update:
+            print(f"conccheck: manifests updated in "
+                  f"{os.path.relpath(conccheck.MANIFEST_DIR)}")
+    return 1 if any(not f.suppressed for f in findings) else 0
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "graph":
         return graph_main(argv[1:])
     if argv and argv[0] == "mem":
         return mem_main(argv[1:])
+    if argv and argv[0] == "conc":
+        return conc_main(argv[1:])
     if argv and argv[0] == "lint":
         return lint_main(argv[1:])
     # legacy invocation: bare paths/flags mean lint

@@ -1,0 +1,495 @@
+"""Static concurrency model: locks, guarded writes, acquisition edges.
+
+The extraction half of conccheck (``python -m sparknet_tpu.analysis
+conc`` — conccheck.py is the checking half).  Pure stdlib ``ast`` over
+the audited modules (the analysis package's import contract: no jax,
+no numpy); one pass per file produces a :class:`ModuleModel` holding
+
+- **lock declarations** — ``self._x = threading.Lock()/RLock()/
+  Condition()`` (or the ``named_lock``/``named_rlock``/
+  ``named_condition`` chaos factories from ``sparknet_tpu.common``,
+  whose string argument IS the lock's qualified id), at class level
+  (``Ticket._lock``), instance level (``ServeEngine._lock``) or module
+  level (``common._lock``);
+- **per-function traces** — for every function/method (nested defs
+  included): the lock-acquisition sites (``with <lock>:``, with the
+  held-stack at each acquire), every call site with the held-stack and
+  enough shape (receiver attr, arg count, keyword names) for the
+  checker to resolve it and to spot blocking calls under a lock, every
+  ``self._*``/module-global write with the held-stack, and every
+  ``jax`` touch (module-level import or in-function use);
+- **type hints for call resolution** — ``self.x = ClassName(...)``
+  attribute types, dataclass/class-body annotations (``engine:
+  ServeEngine``), local ``v = ClassName(...)`` bindings, and
+  ``from m import name`` aliases;
+- **thread/process roots** — ``Thread(target=...)`` /
+  ``Process(target=...)`` call sites with the target resolved as far
+  as the hints allow.
+
+The model is deliberately an over-approximation in the direction that
+keeps leg (c) sound: the *static* acquisition graph may contain edges
+no schedule ever takes, but every edge a real schedule CAN take must
+be derivable from it (the chaos dryrun fails on observed-but-not-
+static edges, never the reverse).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+__all__ = [
+    "AttrWrite",
+    "CallSite",
+    "FuncModel",
+    "LockAcquire",
+    "ModuleModel",
+    "build_model",
+    "parse_module",
+]
+
+# threading constructors that declare a lock-like primitive
+_LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "condition"}
+# the chaos factories (sparknet_tpu._chaoslock, re-exported from
+# common) — the string argument is the canonical lock id
+_NAMED_CTORS = {"named_lock": "lock", "named_rlock": "rlock",
+                "named_condition": "condition"}
+
+
+@dataclass
+class LockAcquire:
+    lock: str                  # qualified id, e.g. "ServeEngine._lock"
+    lineno: int
+    held: tuple[str, ...]      # locks already held (outermost first)
+
+
+@dataclass
+class CallSite:
+    name: str                  # called attr/function name ("submit")
+    kind: str                  # "self" | "bare" | "attr"
+    base_attr: str | None      # for x.Y.name(): "Y"; for self.name(): None
+    base_name: str | None      # for v.name(): "v" (receiver variable)
+    nargs: int
+    kwnames: tuple[str, ...]
+    lineno: int
+    held: tuple[str, ...]
+
+
+@dataclass
+class AttrWrite:
+    attr: str                  # attribute or module-global name
+    target: str                # "self" | "<module>"
+    lineno: int
+    held: tuple[str, ...]
+    aug: bool = False          # augmented (+=) write
+
+
+@dataclass
+class FuncModel:
+    qualname: str              # "Class.meth", "func", "Class.meth.<inner>"
+    lineno: int
+    cls: str | None            # owning class (via self-closure for nested)
+    acquires: list[LockAcquire] = field(default_factory=list)
+    calls: list[CallSite] = field(default_factory=list)
+    writes: list[AttrWrite] = field(default_factory=list)
+    jax_lines: list[int] = field(default_factory=list)
+    local_types: dict[str, str] = field(default_factory=dict)
+
+    @property
+    def caller_held(self) -> bool:
+        """Repo convention: a ``*_locked`` method documents that its
+        caller holds the owning lock — its writes are guarded by
+        contract, not by a visible ``with``."""
+        leaf = self.qualname.rsplit(".", 1)[-1]
+        return leaf.endswith("_locked")
+
+
+@dataclass
+class ModuleModel:
+    rel: str                   # repo-relative path
+    stem: str                  # module stem for module-lock ids
+    classes: dict[str, dict[str, str]] = field(default_factory=dict)
+    # classes[C] = {attr: lock_id} for C's lock attributes
+    class_methods: dict[str, set[str]] = field(default_factory=dict)
+    class_bases: dict[str, list[str]] = field(default_factory=dict)
+    # class_bases[C] = base-class names (subclass closure lets a call
+    # through a base-typed receiver resolve to every override)
+    attr_types: dict[str, dict[str, str]] = field(default_factory=dict)
+    # attr_types[C] = {attr: ClassName} from assignments + annotations
+    module_locks: dict[str, str] = field(default_factory=dict)
+    functions: dict[str, FuncModel] = field(default_factory=dict)
+    import_aliases: dict[str, tuple[str, str]] = field(
+        default_factory=dict)  # name -> (module path tail, orig name)
+    thread_roots: list[tuple[str, str, int, str]] = field(
+        default_factory=list)
+    # (kind "thread"|"process", resolved-target descr, lineno, site fn)
+    module_imports_jax: bool = False
+
+    def key(self, qualname: str) -> str:
+        return f"{self.rel}::{qualname}"
+
+
+def _call_ctor(node: ast.expr) -> tuple[str, str] | None:
+    """If ``node`` constructs a lock, return (kind, explicit-name-or-"").
+
+    Recognizes ``threading.Lock()``-style ctors and the chaos factories
+    (any import spelling whose terminal name matches); the factory's
+    first string argument is the canonical lock id.
+    """
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    name = None
+    if isinstance(fn, ast.Attribute):
+        name = fn.attr
+    elif isinstance(fn, ast.Name):
+        name = fn.id
+    if name in _LOCK_CTORS:
+        return _LOCK_CTORS[name], ""
+    if name in _NAMED_CTORS:
+        explicit = ""
+        if node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            explicit = node.args[0].value
+        return _NAMED_CTORS[name], explicit
+    return None
+
+
+def _simple_annotation(node: ast.expr | None) -> str | None:
+    """A class-name annotation (``ServeEngine`` / ``"ServeEngine"``)."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        leaf = node.value.strip().rsplit(".", 1)[-1]
+        return leaf if leaf.isidentifier() else None
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+class _FuncWalker:
+    """Walks ONE function body tracking the held-lock stack."""
+
+    def __init__(self, model: "ModuleModel", func: FuncModel,
+                 cls: str | None):
+        self.m = model
+        self.f = func
+        self.cls = cls
+        self.held: list[str] = []
+        self.globals_declared: set[str] = set()
+
+    # -- lock expression -> qualified id -------------------------------
+    def lock_id(self, expr: ast.expr) -> str | None:
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if isinstance(base, ast.Name):
+                if base.id == "self" and self.cls:
+                    return self.m.classes.get(self.cls, {}).get(expr.attr)
+                # ClassName._lock (class-level lock referenced by name)
+                if base.id in self.m.classes:
+                    return self.m.classes[base.id].get(expr.attr)
+        elif isinstance(expr, ast.Name):
+            if expr.id in self.m.module_locks:
+                return self.m.module_locks[expr.id]
+        return None
+
+    # -- write targets -------------------------------------------------
+    def _note_write(self, tgt: ast.expr, lineno: int, aug: bool) -> None:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._note_write(el, lineno, aug)
+            return
+        if isinstance(tgt, (ast.Subscript, ast.Starred)):
+            self._note_write(tgt.value, lineno, aug)
+            return
+        if isinstance(tgt, ast.Attribute):
+            base = tgt.value
+            if isinstance(base, ast.Name) and base.id == "self":
+                self.f.writes.append(AttrWrite(
+                    tgt.attr, "self", lineno, tuple(self.held), aug))
+            return
+        if isinstance(tgt, ast.Name) and tgt.id in self.globals_declared:
+            self.f.writes.append(AttrWrite(
+                tgt.id, "<module>", lineno, tuple(self.held), aug))
+
+    # -- call sites ----------------------------------------------------
+    def _note_call(self, node: ast.Call) -> None:
+        kwnames = tuple(kw.arg for kw in node.keywords if kw.arg)
+        fn = node.func
+        site = None
+        if isinstance(fn, ast.Name):
+            site = CallSite(fn.id, "bare", None, None, len(node.args),
+                            kwnames, node.lineno, tuple(self.held))
+        elif isinstance(fn, ast.Attribute):
+            base = fn.value
+            if isinstance(base, ast.Name) and base.id == "self":
+                site = CallSite(fn.attr, "self", None, None,
+                                len(node.args), kwnames, node.lineno,
+                                tuple(self.held))
+            else:
+                base_attr = base.attr if isinstance(base, ast.Attribute) \
+                    else None
+                base_name = base.id if isinstance(base, ast.Name) \
+                    else None
+                site = CallSite(fn.attr, "attr", base_attr, base_name,
+                                len(node.args), kwnames, node.lineno,
+                                tuple(self.held))
+        if site is not None:
+            self.f.calls.append(site)
+        # thread/process roots
+        tail = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None)
+        if tail in ("Thread", "Process"):
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    kind = "thread" if tail == "Thread" else "process"
+                    self.m.thread_roots.append(
+                        (kind, self._target_descr(kw.value),
+                         node.lineno, self.f.qualname))
+
+    def _target_descr(self, expr: ast.expr) -> str:
+        """A resolvable description of a Thread/Process target."""
+        if isinstance(expr, ast.Name):
+            return f"bare:{expr.id}"
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if isinstance(base, ast.Name):
+                if base.id == "self" and self.cls:
+                    return f"method:{self.cls}.{expr.attr}"
+                loc = self.f.local_types.get(base.id)
+                if loc:
+                    return f"method:{loc}.{expr.attr}"
+            return f"name:{expr.attr}"
+        return "unknown:"
+
+    # -- the walk ------------------------------------------------------
+    def walk(self, body: list[ast.stmt]) -> None:
+        for node in body:
+            self.visit(node)
+
+    def visit(self, node: ast.stmt) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: its own FuncModel (closure over self keeps
+            # the class binding so `with self._lock:` still resolves)
+            inner = FuncModel(f"{self.f.qualname}.{node.name}",
+                              node.lineno, self.cls)
+            inner.local_types.update(self.f.local_types)
+            _seed_param_types(inner, node)
+            w = _FuncWalker(self.m, inner, self.cls)
+            w.globals_declared = set(self.globals_declared)
+            w.walk(node.body)
+            self.m.functions[inner.qualname] = inner
+            return
+        if isinstance(node, ast.Global):
+            self.globals_declared.update(node.names)
+            return
+        if isinstance(node, ast.With):
+            pushed = 0
+            for item in node.items:
+                for sub in ast.walk(item.context_expr):
+                    if isinstance(sub, ast.Call):
+                        self._note_call(sub)
+                lid = self.lock_id(item.context_expr)
+                if lid is not None:
+                    self.f.acquires.append(LockAcquire(
+                        lid, node.lineno, tuple(self.held)))
+                    self.held.append(lid)
+                    pushed += 1
+            self.walk(node.body)
+            for _ in range(pushed):
+                self.held.pop()
+            return
+        if isinstance(node, ast.Assign):
+            self._scan_expr(node.value)
+            for tgt in node.targets:
+                self._note_write(tgt, node.lineno, aug=False)
+            self._infer_types(node)
+            return
+        if isinstance(node, ast.AugAssign):
+            self._scan_expr(node.value)
+            self._note_write(node.target, node.lineno, aug=True)
+            return
+        if isinstance(node, ast.AnnAssign):
+            if node.value is not None:
+                self._scan_expr(node.value)
+                self._note_write(node.target, node.lineno, aug=False)
+                self._infer_types_ann(node)
+            return
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            self._note_import(node)
+            return
+        # compound statements: recurse into every stmt-list field so
+        # the held stack survives if/try/for bodies
+        for fname in node._fields:
+            val = getattr(node, fname, None)
+            if isinstance(val, list):
+                stmts = [s for s in val if isinstance(s, ast.stmt)]
+                if stmts:
+                    self.walk(stmts)
+                for v in val:
+                    if isinstance(v, ast.expr):
+                        self._scan_expr(v)
+                    elif isinstance(v, ast.excepthandler):
+                        self.walk(v.body)
+            elif isinstance(val, ast.expr):
+                self._scan_expr(val)
+
+    def _scan_expr(self, expr: ast.expr) -> None:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call):
+                self._note_call(sub)
+            elif isinstance(sub, ast.Name) and sub.id == "jax":
+                self.f.jax_lines.append(sub.lineno)
+            elif isinstance(sub, (ast.Lambda,)):
+                pass  # lambdas: calls within are still walked above
+
+    def _note_import(self, node: ast.stmt) -> None:
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "jax" or alias.name.startswith("jax."):
+                    self.f.jax_lines.append(node.lineno)
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod == "jax" or mod.startswith("jax."):
+                self.f.jax_lines.append(node.lineno)
+            for alias in node.names:
+                self.f.local_types.pop(alias.asname or alias.name, None)
+                self.m.import_aliases.setdefault(
+                    alias.asname or alias.name, (mod, alias.name))
+
+    def _infer_types(self, node: ast.Assign) -> None:
+        """``v = ClassName(...)`` and ``self.x = ClassName(...)``."""
+        if not isinstance(node.value, ast.Call):
+            return
+        fn = node.value.func
+        cname = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else None)
+        if not cname or not cname[:1].isupper():
+            return
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                self.f.local_types[tgt.id] = cname
+            elif isinstance(tgt, ast.Attribute) \
+                    and isinstance(tgt.value, ast.Name) \
+                    and tgt.value.id == "self" and self.cls:
+                self.m.attr_types.setdefault(
+                    self.cls, {})[tgt.attr] = cname
+
+    def _infer_types_ann(self, node: ast.AnnAssign) -> None:
+        cname = _simple_annotation(node.annotation)
+        if not cname or not cname[:1].isupper():
+            return
+        tgt = node.target
+        if isinstance(tgt, ast.Attribute) \
+                and isinstance(tgt.value, ast.Name) \
+                and tgt.value.id == "self" and self.cls:
+            self.m.attr_types.setdefault(self.cls, {})[tgt.attr] = cname
+
+
+def _seed_param_types(func: FuncModel, fnode) -> None:
+    """Feed parameter annotations (``source: BatchSource``) into the
+    function's local type table so attr calls through a typed parameter
+    resolve like any other typed receiver."""
+    args = fnode.args
+    for a in (*args.posonlyargs, *args.args, *args.kwonlyargs):
+        cname = _simple_annotation(a.annotation)
+        if cname and cname[:1].isupper():
+            func.local_types[a.arg] = cname
+
+
+def _scan_class_locks(model: ModuleModel, cnode: ast.ClassDef) -> None:
+    """Lock declarations: class-level assigns + ``self._x = ...`` in
+    every method (locks are usually born in ``__init__`` but swap/boot
+    paths may re-make them)."""
+    cname = cnode.name
+    locks = model.classes.setdefault(cname, {})
+    methods = model.class_methods.setdefault(cname, set())
+    types = model.attr_types.setdefault(cname, {})
+    model.class_bases[cname] = [
+        b for b in (_simple_annotation(base) for base in cnode.bases)
+        if b]
+    for node in cnode.body:
+        if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call):
+            ctor = _call_ctor(node.value)
+            if ctor:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        locks[tgt.id] = ctor[1] or f"{cname}.{tgt.id}"
+        elif isinstance(node, ast.AnnAssign):
+            tname = _simple_annotation(node.annotation)
+            if isinstance(node.target, ast.Name) and tname \
+                    and tname[:1].isupper():
+                types[node.target.id] = tname
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            methods.add(node.name)
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign) \
+                        and isinstance(sub.value, ast.Call):
+                    ctor = _call_ctor(sub.value)
+                    if not ctor:
+                        continue
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Attribute) \
+                                and isinstance(tgt.value, ast.Name) \
+                                and tgt.value.id == "self":
+                            locks[tgt.attr] = \
+                                ctor[1] or f"{cname}.{tgt.attr}"
+
+
+def parse_module(rel: str, source: str) -> ModuleModel:
+    """Build the :class:`ModuleModel` for one file."""
+    stem = os.path.splitext(os.path.basename(rel))[0]
+    model = ModuleModel(rel=rel, stem=stem)
+    tree = ast.parse(source)
+
+    # pass 0: module-level locks, imports, class lock/type tables
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call):
+            ctor = _call_ctor(node.value)
+            if ctor:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        model.module_locks[tgt.id] = \
+                            ctor[1] or f"{stem}.{tgt.id}"
+        elif isinstance(node, ast.Import):
+            if any(a.name == "jax" or a.name.startswith("jax.")
+                   for a in node.names):
+                model.module_imports_jax = True
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod == "jax" or mod.startswith("jax."):
+                model.module_imports_jax = True
+            for alias in node.names:
+                model.import_aliases[alias.asname or alias.name] = \
+                    (mod, alias.name)
+        elif isinstance(node, ast.ClassDef):
+            _scan_class_locks(model, node)
+
+    # pass 1: per-function traces
+    def walk_func(fnode, qual: str, cls: str | None) -> None:
+        func = FuncModel(qual, fnode.lineno, cls)
+        _seed_param_types(func, fnode)
+        w = _FuncWalker(model, func, cls)
+        w.walk(fnode.body)
+        model.functions[qual] = func
+
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            walk_func(node, node.name, None)
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    walk_func(sub, f"{node.name}.{sub.name}", node.name)
+    return model
+
+
+def build_model(files: dict[str, str]) -> dict[str, ModuleModel]:
+    """Parse every (rel-path -> source) pair; returns rel -> model."""
+    return {rel: parse_module(rel, src)
+            for rel, src in sorted(files.items())}

@@ -1007,6 +1007,97 @@ def check_replica_manifest_fresh(ctx: ModuleContext) -> Iterator[tuple[int, str]
 
 
 # ---------------------------------------------------------------------------
+# conc-manifest-fresh
+# ---------------------------------------------------------------------------
+
+# the concurrency-contract source surface: editing any of these can
+# change what conccheck derives (lock declarations, guarded-by maps,
+# acquisition edges, thread/process taxonomy), so the banked
+# docs/conc_contracts/ manifests must be regenerated in the same PR
+# (kept in sync with conccheck.CONC_SOURCE_PATTERNS — spelled out here
+# too so this module stays importable without conccheck)
+_CONC_SOURCE_DIRS = (
+    "sparknet_tpu/serve/",
+    "sparknet_tpu/loop/",
+    "sparknet_tpu/obs/",
+)
+_CONC_SOURCE_FILES = (
+    "sparknet_tpu/data/pipeline.py",
+    "sparknet_tpu/data/records.py",
+    "sparknet_tpu/worker_store.py",
+    "sparknet_tpu/common.py",
+    "sparknet_tpu/_chaoslock.py",
+    "sparknet_tpu/analysis/conc_model.py",
+    "sparknet_tpu/analysis/conccheck.py",
+    "tools/tpu_window_runner.py",
+)
+_CONC_REGEN = ("regenerate with `python -m sparknet_tpu.analysis conc "
+               "--update`")
+
+
+def _conc_source_rel(path: str) -> tuple[str, str] | None:
+    """(repo_root, repo_relative_path) when ``path`` is part of the
+    concurrency-contract source surface, else None.  Two anchors: the
+    audited surface spans the package AND tools/ (the window runner is
+    the one multi-thread entry point living outside sparknet_tpu/)."""
+    norm = os.path.abspath(path).replace(os.sep, "/")
+    for anchor in ("/sparknet_tpu/", "/tools/"):
+        idx = norm.rfind(anchor)
+        if idx < 0:
+            continue
+        root, rel = norm[:idx], norm[idx + 1:]
+        if rel.startswith(_CONC_SOURCE_DIRS) \
+                or rel in _CONC_SOURCE_FILES:
+            return root, rel
+    return None
+
+
+@rule(
+    "conc-manifest-fresh",
+    "a PR touching the audited concurrency surface (serve/, loop/, "
+    "obs/, the feed pipeline, common.py, the window runner, or "
+    "conccheck itself) must regenerate the docs/conc_contracts/ "
+    "manifests",
+)
+def check_conc_manifest_fresh(ctx: ModuleContext) -> Iterator[tuple[int, str]]:
+    """The concurrency manifests are what the chaos-schedule dryrun
+    gate diffs observed lock acquisitions against (obs/__main__.py
+    ``_chaos_gate``): a stale static graph either misses a real edge
+    (the gate cries wolf) or blesses one that no longer exists.
+    ``conc --update`` banks a sha256 per audited file in
+    ``docs/conc_contracts/SOURCES.json``; this rule re-hashes the
+    linted source and flags any mismatch — the mem-manifest-fresh
+    mechanism on the concurrency surface.  Blind spot: an edit that
+    reverts to the banked bytes passes (correctly — the derived
+    contracts are the banked ones again)."""
+    hit = _conc_source_rel(ctx.path)
+    if hit is None:
+        return
+    root, rel = hit
+    src = os.path.join(root, "docs", "conc_contracts", "SOURCES.json")
+    if not os.path.exists(src):
+        yield (1, f"{rel} is concurrency-contract source but no "
+                  f"manifests are banked (docs/conc_contracts/"
+                  f"SOURCES.json missing) — {_CONC_REGEN}")
+        return
+    try:
+        with open(src, encoding="utf-8") as f:
+            recorded = json.load(f)
+    except (OSError, ValueError):
+        yield (1, f"docs/conc_contracts/SOURCES.json unreadable — "
+                  f"{_CONC_REGEN}")
+        return
+    want = recorded.get(rel)
+    digest = hashlib.sha256(ctx.source.encode("utf-8")).hexdigest()
+    if want is None:
+        yield (1, f"{rel} is new concurrency-contract source not "
+                  f"covered by the banked manifests — {_CONC_REGEN}")
+    elif want != digest:
+        yield (1, f"{rel} changed since the concurrency manifests were "
+                  f"banked — {_CONC_REGEN}")
+
+
+# ---------------------------------------------------------------------------
 # queue-job-hygiene
 # ---------------------------------------------------------------------------
 

@@ -16,11 +16,24 @@ import json
 import os
 import sys
 import tempfile
-import threading
 from typing import Any
 
 import jax
 import jax.numpy as jnp
+
+# the chaos-schedule lock instrumentation (SPARKNET_CHAOS_SCHED,
+# conccheck leg (c)) — re-exported here as the public surface; the
+# implementation stays stdlib-only in _chaoslock.py so serve/batcher.py
+# and the analysis package can import it without jax
+from sparknet_tpu._chaoslock import (  # noqa: F401
+    chaos_armed,
+    chaos_seed,
+    named_condition,
+    named_lock,
+    named_rlock,
+    observed_edges,
+    reset_observed,
+)
 
 
 class Phase(enum.Enum):
@@ -110,7 +123,7 @@ TPU_PEAK_FLOPS = {
 # v5e HBM bandwidth (public spec), the bytes term of the same rooflines.
 V5E_HBM_BYTES_S = 819e9
 
-_lock = threading.Lock()
+_lock = named_lock("common._lock")
 _config = Config()
 
 

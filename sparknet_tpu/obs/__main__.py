@@ -253,7 +253,50 @@ def _dryrun_gates(path: str) -> int:
               f"span(s), {verdict['edges']} edge(s), "
               f"{verdict['requests_linked']} request(s) linked",
               file=sys.stderr)
+    if _chaos_gate():
+        rc = 1
     return rc
+
+
+def _chaos_gate() -> int:
+    """conccheck leg (c): when ``SPARKNET_CHAOS_SCHED`` is armed, the
+    instrumented locks have been recording actual acquisition edges all
+    run — diff them against the banked static graph.  Any observed edge
+    absent from ``docs/conc_contracts/lock_graph.json`` means the
+    static model missed a real interleaving: fail the dryrun.  A no-op
+    (rc 0) when chaos mode is off."""
+    from sparknet_tpu._chaoslock import (
+        chaos_armed, chaos_seed, observed_edges)
+
+    if not chaos_armed():
+        return 0
+    import json
+
+    from sparknet_tpu.analysis.conccheck import MANIFEST_DIR
+
+    path = os.path.join(MANIFEST_DIR, "lock_graph.json")
+    try:
+        with open(path, encoding="utf-8") as f:
+            static = {tuple(e)
+                      for e in json.load(f)["contract"]["edges"]}
+    except (OSError, KeyError, ValueError):
+        print("obs dryrun: CHAOS FAIL — no banked lock_graph manifest "
+              "(run `python -m sparknet_tpu.analysis conc --update`)",
+              file=sys.stderr)
+        return 1
+    observed = observed_edges()
+    novel = sorted(observed - static)
+    if novel:
+        print(f"obs dryrun: CHAOS FAIL — {len(novel)} observed "
+              f"acquisition edge(s) absent from the static graph "
+              f"(seed {chaos_seed()}):", file=sys.stderr)
+        for a, b in novel[:20]:
+            print(f"  {a} -> {b}", file=sys.stderr)
+        return 1
+    print(f"obs dryrun: chaos schedule clean — {len(observed)} "
+          f"observed edge(s) within the {len(static)}-edge static "
+          f"graph (seed {chaos_seed()})", file=sys.stderr)
+    return 0
 
 
 def dryrun_main(argv: list[str]) -> int:

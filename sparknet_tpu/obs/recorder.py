@@ -24,9 +24,9 @@ from __future__ import annotations
 import json
 import os
 import sys
-import threading
 import time
 
+from sparknet_tpu._chaoslock import named_lock
 from sparknet_tpu.obs import schema
 from sparknet_tpu.obs.metrics import MetricsHub
 from sparknet_tpu.obs.sentinel import get_sentinel
@@ -110,7 +110,7 @@ class Recorder:
                  metrics_flush_every: int = 256):
         self.path = path
         self.enabled = bool(path)
-        self._lock = threading.Lock()
+        self._lock = named_lock("Recorder._lock")
         self._started = False
         # the streaming-metrics hub: every journaled event is folded
         # into bounded counters/histograms in-process, and the

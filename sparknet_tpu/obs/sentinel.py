@@ -33,6 +33,8 @@ from __future__ import annotations
 
 import threading
 
+from sparknet_tpu._chaoslock import named_lock
+
 __all__ = ["RecompileSentinel", "get_sentinel"]
 
 # the event name jax 0.4.x records one of per backend compilation
@@ -43,7 +45,7 @@ class RecompileSentinel:
     """Process-wide backend-compilation counter (install once)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = named_lock("RecompileSentinel._lock")
         self._count = 0
         self._by_thread: dict[int, int] = {}
         self._installed = False

@@ -50,6 +50,10 @@ import itertools
 import threading
 import time
 
+# stdlib-only module (the chaos factories return plain threading
+# primitives unless SPARKNET_CHAOS_SCHED is armed — _chaoslock.py)
+from sparknet_tpu._chaoslock import named_condition, named_lock
+
 __all__ = ["DynamicBatcher", "Ticket"]
 
 
@@ -70,7 +74,7 @@ class Ticket:
     # guards lazy event creation against a concurrent resolve; class
     # level (one lock for all tickets) keeps the per-ticket footprint
     # at a plain bool, and the critical section is a few loads
-    _lock = threading.Lock()
+    _lock = named_lock("Ticket._lock")
 
     def __init__(self, rid: int, payload, t_submit: float):
         self.id = rid
@@ -96,8 +100,11 @@ class Ticket:
         # the event early enough for the read below to observe it —
         # both orders signal exactly once (the lock lives in _event,
         # guarding create-once only)
+        # conccheck: unguarded=single-writer protocol; result/error land before the _done_flag store, and _event() re-checks the flag under Ticket._lock, so every waiter observes a fully-written ticket
         self.result = result
+        # conccheck: unguarded=same single-writer store-ordering protocol as result above
         self.error = error
+        # conccheck: unguarded=flag store is the publication point; _event() double-checks it under Ticket._lock so the event is set exactly once in either interleaving
         self._done_flag = True
         ev = self._done
         if ev is not None:
@@ -140,7 +147,7 @@ class DynamicBatcher:
         self.clock = clock
         self._q: list[Ticket] = []
         self._ids = itertools.count()
-        self._cv = threading.Condition()
+        self._cv = named_condition("DynamicBatcher._cv")
         self.closed = False
         # drain-rate EWMA (rows/s), sampled over >= _WIN_S windows of
         # take() history during which a backlog persisted throughout.

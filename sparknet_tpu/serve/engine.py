@@ -52,12 +52,12 @@ is new TPU-first surface).
 from __future__ import annotations
 
 import contextlib
-import threading
 import time
 
 import jax
 import numpy as np
 
+from sparknet_tpu._chaoslock import named_rlock
 from sparknet_tpu.serve.batcher import DynamicBatcher, Ticket
 from sparknet_tpu.serve.residency import AdmissionPolicy, load_fit_table
 
@@ -392,7 +392,7 @@ class ServeEngine:
         # lock (a captured ServedModel is immutable after construction),
         # so the swap-gap is the dict flip + queue steal, not a device
         # call.
-        self._lock = threading.RLock()
+        self._lock = named_rlock("ServeEngine._lock")
         # backend compilations attributed to executable calls (the
         # serving path), per-thread-accounted via obs/sentinel.py; the
         # AOT contract — and the loop dryrun's gate — is that this
@@ -828,8 +828,13 @@ class ServeEngine:
         # per-THREAD attribution: a concurrent rollout builder's
         # compiles land on its own thread's counter, so a nonzero delta
         # here can only mean the executable call itself compiled — the
-        # exact AOT violation the loop dryrun gates on
-        self.serve_path_compiles += sentinel.thread_count() - compiles0
+        # exact AOT violation the loop dryrun gates on.  The delta is
+        # computed BEFORE taking the engine lock so the sentinel's own
+        # lock is never acquired under it (keeps the static acquisition
+        # graph free of an Engine->Sentinel edge).
+        compile_delta = sentinel.thread_count() - compiles0
+        with self._lock:
+            self.serve_path_compiles += compile_delta
         now = self.clock()
         model.batches += 1
         model.padded_rows += bucket - len(tickets)

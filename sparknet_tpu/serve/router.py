@@ -47,12 +47,12 @@ serve membership, and zero-drop re-route are new TPU-first surface).
 
 from __future__ import annotations
 
-import threading
 import time
 
 import jax
 import numpy as np
 
+from sparknet_tpu._chaoslock import named_rlock
 from sparknet_tpu.parallel.mesh import sized_data_mesh
 from sparknet_tpu.serve.batcher import Ticket
 from sparknet_tpu.serve.engine import ServeEngine
@@ -110,7 +110,7 @@ class ReplicaRouter:
             raise ValueError(
                 f"cannot place {replicas} replicas on "
                 f"{len(self._device_pool)} device(s)")
-        self._lock = threading.RLock()
+        self._lock = named_rlock("ReplicaRouter._lock")
         self._replicas: dict[int, Replica] = {}
         self._next_rid = 0
         self._closed = False
@@ -162,10 +162,10 @@ class ReplicaRouter:
         model = engine.load_model(
             self.model_name, family=self.family, arm=self.arm,
             buckets=self.buckets, seed=self.seed, variables=variables)
-        rid = self._next_rid
-        self._next_rid += 1
-        rep = Replica(rid, device, engine, model)
         with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1
+            rep = Replica(rid, device, engine, model)
             self._replicas[rid] = rep
         return rep
 

@@ -11,14 +11,15 @@ across outer-loop closures, keyed the same way.
 
 from __future__ import annotations
 
-import threading
 from typing import Any
+
+from sparknet_tpu._chaoslock import named_lock
 
 
 class WorkerStore:
     def __init__(self):
         self._store: dict[str, Any] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("WorkerStore._lock")
 
     def set(self, key: str, value: Any) -> None:
         with self._lock:

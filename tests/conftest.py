@@ -21,6 +21,9 @@ import jax  # noqa: E402
 # the config route wins over the env var, so force CPU here too.
 jax.config.update("jax_platforms", "cpu")
 
+import threading  # noqa: E402
+import time  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
@@ -28,6 +31,38 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.RandomState(1234)
+
+
+# Thread-leak gate (ISSUE 16 satellite, the threading mirror of
+# test_pipeline's /dev/shm fixture): any test leaving a live NON-daemon
+# thread behind fails — a leaked pump/builder thread keeps locks and
+# file handles alive across tests and turns the next failure into a
+# haunted one.  Daemon threads are exempt (jax/XLA runtime pools, mp
+# feeder threads); named allowlist for non-daemon framework threads
+# that are reaped at interpreter exit by design.
+THREAD_LEAK_ALLOWLIST = (
+    # concurrent.futures workers are non-daemon since 3.9 and are
+    # joined by threading's atexit hook, not by the spawning test
+    "ThreadPoolExecutor-",
+)
+
+
+@pytest.fixture(autouse=True)
+def no_leaked_threads():
+    before = {t.ident for t in threading.enumerate()}
+    yield
+    deadline = time.monotonic() + 2.0
+    leaked: list = []
+    while time.monotonic() < deadline:
+        leaked = [t for t in threading.enumerate()
+                  if t.ident not in before and t.is_alive()
+                  and not t.daemon
+                  and not t.name.startswith(THREAD_LEAK_ALLOWLIST)]
+        if not leaked:
+            return
+        time.sleep(0.05)
+    pytest.fail("test leaked live non-daemon thread(s): "
+                f"{[t.name for t in leaked]}")
 
 
 # Known environment drift (CHANGES.md PR 3/7): some jax builds reject

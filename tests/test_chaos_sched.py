@@ -1,0 +1,290 @@
+"""Chaos-schedule sanitizer: the instrumented-lock mode + the pinned
+SIGKILL-holding-lock regression (ISSUE 16 satellites).
+
+Three surfaces:
+
+* ``sparknet_tpu/_chaoslock.py`` unit contracts — plain primitives when
+  ``SPARKNET_CHAOS_SCHED`` is unset (the off path must be byte-identical
+  runtime behavior), edge recording + reentrancy semantics when armed.
+* The dryrun chaos gate (``obs/__main__._chaos_gate``) — rc 1 exactly
+  when an observed acquisition edge is absent from the banked static
+  graph.
+* PR 8's SIGKILLed-worker-holding-a-queue-lock bug, pinned as a seeded
+  interleaving at the multiprocessing.Queue level: a child SIGKILLed
+  while blocked in ``q.get()`` on an EMPTY queue dies holding the
+  queue's reader lock (a POSIX semaphore — not robust, never released),
+  so a replacement handed the SAME queue deadlocks even once an item
+  arrives; a replacement handed a FRESH queue (what
+  ``ProcessPipeline._respawn_or_raise`` builds) drains immediately.
+  The kill timing is jittered per trial from the chaos seed, so the
+  interleaving is deterministic per seed and replayable.
+"""
+
+import json
+import multiprocessing
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from sparknet_tpu._chaoslock import (
+    _ChaosProxy,
+    _lock_rng,
+    chaos_armed,
+    chaos_seed,
+    named_condition,
+    named_lock,
+    named_rlock,
+    observed_edges,
+    reset_observed,
+)
+
+pytestmark = pytest.mark.smoke
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    reset_observed()
+    yield
+    reset_observed()
+
+
+# -- off path ---------------------------------------------------------------
+
+
+def test_factories_return_plain_primitives_when_off(monkeypatch):
+    monkeypatch.delenv("SPARKNET_CHAOS_SCHED", raising=False)
+    assert not chaos_armed()
+    assert chaos_seed() is None
+    assert type(named_lock("X._l")) is type(threading.Lock())
+    assert type(named_rlock("X._r")) is type(threading.RLock())
+    assert isinstance(named_condition("X._c"), threading.Condition)
+
+
+def test_malformed_seed_never_arms(monkeypatch):
+    monkeypatch.setenv("SPARKNET_CHAOS_SCHED", "not-an-int")
+    assert not chaos_armed()
+    assert type(named_lock("X._l")) is type(threading.Lock())
+
+
+def test_off_mode_records_nothing(monkeypatch):
+    monkeypatch.delenv("SPARKNET_CHAOS_SCHED", raising=False)
+    a, b = named_lock("A._l"), named_lock("B._l")
+    with a:
+        with b:
+            pass
+    assert observed_edges() == set()
+
+
+# -- armed path -------------------------------------------------------------
+
+
+def test_armed_proxy_records_nesting_edges(monkeypatch):
+    monkeypatch.setenv("SPARKNET_CHAOS_SCHED", "7")
+    assert chaos_seed() == 7
+    a, b = named_lock("A._l"), named_lock("B._l")
+    assert isinstance(a, _ChaosProxy)
+    with a:
+        with b:
+            pass
+    assert observed_edges() == {("A._l", "B._l")}
+    # the reverse order is a distinct edge
+    with b:
+        with a:
+            pass
+    assert observed_edges() == {("A._l", "B._l"), ("B._l", "A._l")}
+
+
+def test_reentrant_rlock_records_no_self_edge(monkeypatch):
+    monkeypatch.setenv("SPARKNET_CHAOS_SCHED", "7")
+    r = named_rlock("R._l")
+    with r:
+        with r:  # reentrant re-acquire: no (R._l, R._l) edge
+            pass
+    assert observed_edges() == set()
+
+
+def test_condition_proxy_wait_notify_roundtrip(monkeypatch):
+    monkeypatch.setenv("SPARKNET_CHAOS_SCHED", "3")
+    cv = named_condition("CV._cv")
+    state = {"go": False, "seen": False}
+
+    def waiter():
+        with cv:
+            while not state["go"]:
+                cv.wait(timeout=5.0)
+            state["seen"] = True
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with cv:
+        state["go"] = True
+        cv.notify_all()
+    t.join(timeout=5.0)
+    assert not t.is_alive() and state["seen"]
+
+
+def test_jitter_is_deterministic_per_seed_and_name():
+    r1 = [_lock_rng("A._l", 5).random() for _ in range(4)]
+    r2 = [_lock_rng("A._l", 5).random() for _ in range(4)]
+    r3 = [_lock_rng("B._l", 5).random() for _ in range(4)]
+    assert r1 == r2
+    assert r1 != r3
+
+
+# -- the dryrun chaos gate --------------------------------------------------
+
+
+def _bank_graph(tmp_path, edges):
+    bank = tmp_path / "conc_contracts"
+    bank.mkdir()
+    (bank / "lock_graph.json").write_text(json.dumps(
+        {"contract": {"locks": sorted({x for e in edges for x in e}),
+                      "edges": [list(e) for e in edges]},
+         "allow": {}}))
+    return str(bank)
+
+
+def test_chaos_gate_clean_when_observed_subset(monkeypatch, tmp_path):
+    from sparknet_tpu.analysis import conccheck
+    from sparknet_tpu.obs.__main__ import _chaos_gate
+
+    monkeypatch.setenv("SPARKNET_CHAOS_SCHED", "11")
+    monkeypatch.setattr(conccheck, "MANIFEST_DIR", _bank_graph(
+        tmp_path, [("A._l", "B._l"), ("B._l", "C._l")]))
+    a, b = named_lock("A._l"), named_lock("B._l")
+    with a:
+        with b:
+            pass
+    assert _chaos_gate() == 0
+
+
+def test_chaos_gate_fails_on_novel_edge(monkeypatch, tmp_path, capsys):
+    from sparknet_tpu.analysis import conccheck
+    from sparknet_tpu.obs.__main__ import _chaos_gate
+
+    monkeypatch.setenv("SPARKNET_CHAOS_SCHED", "11")
+    monkeypatch.setattr(conccheck, "MANIFEST_DIR", _bank_graph(
+        tmp_path, [("A._l", "B._l")]))
+    a, b = named_lock("A._l"), named_lock("B._l")
+    with b:
+        with a:  # B -> A is NOT in the static graph
+            pass
+    assert _chaos_gate() == 1
+    assert "B._l -> A._l" in capsys.readouterr().err
+
+
+def test_chaos_gate_fails_without_banked_manifest(monkeypatch, tmp_path):
+    from sparknet_tpu.analysis import conccheck
+    from sparknet_tpu.obs.__main__ import _chaos_gate
+
+    monkeypatch.setenv("SPARKNET_CHAOS_SCHED", "11")
+    monkeypatch.setattr(conccheck, "MANIFEST_DIR",
+                        str(tmp_path / "nowhere"))
+    assert _chaos_gate() == 1
+
+
+def test_chaos_gate_noop_when_off(monkeypatch):
+    from sparknet_tpu.obs.__main__ import _chaos_gate
+
+    monkeypatch.delenv("SPARKNET_CHAOS_SCHED", raising=False)
+    assert _chaos_gate() == 0
+
+
+# -- PR 8 regression: SIGKILL holding the free-queue reader lock ------------
+
+
+def _block_in_get(q, entered):
+    entered.set()
+    q.get()  # empty queue: blocks in recv with the reader lock held
+
+
+def _drain_one(q, out):
+    out.put(q.get(timeout=5.0))
+
+
+def _kill_reader_mid_get(ctx, q, delay_s: float) -> None:
+    """Spawn a reader, SIGKILL it while it is blocked inside ``get()``
+    on the empty queue (the PR 8 death site)."""
+    entered = ctx.Event()
+    victim = ctx.Process(target=_block_in_get, args=(q, entered),
+                         daemon=True)
+    victim.start()
+    assert entered.wait(10.0)
+    # seeded jitter, then kill: by now the reader has acquired the
+    # queue's _rlock and parked in recv — SIGKILL leaks the semaphore
+    time.sleep(0.2 + delay_s)
+    os.kill(victim.pid, signal.SIGKILL)
+    victim.join(10.0)
+
+
+@pytest.mark.parametrize("seed", [77])
+def test_sigkill_in_get_deadlocks_shared_queue_but_not_fresh(seed):
+    """The old free-queue design (respawn reuses the dead worker's
+    queue) deadlocks; the current design (fresh queue, recomputed free
+    set — pipeline._respawn_or_raise) drains.  Kill timing is jittered
+    from the chaos seed so the interleaving replays by seed."""
+    ctx = multiprocessing.get_context("fork")
+    delay = _lock_rng("free_q", seed).random() * 0.2
+
+    # OLD design: replacement handed the SAME queue
+    shared = ctx.Queue()
+    _kill_reader_mid_get(ctx, shared, delay)
+    out = ctx.Queue()
+    shared.put(0)  # an item is available, yet...
+    stuck = ctx.Process(target=_drain_one, args=(shared, out),
+                        daemon=True)
+    stuck.start()
+    stuck.join(3.0)
+    deadlocked = stuck.is_alive()
+    stuck.kill()
+    stuck.join(10.0)
+    shared.cancel_join_thread()
+    out.cancel_join_thread()
+    assert deadlocked, (
+        "reusing the dead reader's queue should deadlock the "
+        "replacement (the PR 8 bug) — if this starts passing, the "
+        "platform's queue lock became robust and the fresh-queue "
+        "respawn path can be revisited")
+
+    # CURRENT design: replacement handed a FRESH queue with the free
+    # set rebuilt by the parent
+    fresh = ctx.Queue()
+    _kill_reader_mid_get(ctx, fresh, delay)
+    replacement_q = ctx.Queue()  # what _respawn_or_raise constructs
+    replacement_q.put(0)
+    out2 = ctx.Queue()
+    ok = ctx.Process(target=_drain_one, args=(replacement_q, out2),
+                     daemon=True)
+    ok.start()
+    got = out2.get(timeout=10.0)
+    ok.join(10.0)
+    fresh.cancel_join_thread()
+    assert got == 0 and not ok.is_alive()
+
+
+def test_respawn_hands_replacement_a_fresh_queue():
+    """Source-level pin of the fix: ``_respawn_or_raise`` must build a
+    NEW context queue for the replacement worker, never reuse
+    ``self._free_qs[wid]`` (the exact regression the trial above
+    demonstrates at the mechanism level)."""
+    import ast
+    import inspect
+
+    from sparknet_tpu.data import pipeline
+
+    src = inspect.getsource(pipeline.ProcessPipeline._respawn_or_raise)
+    tree = ast.parse("class _W:\n" + src if src.startswith("    ")
+                     else src)
+    replaces = [
+        n for n in ast.walk(tree)
+        if isinstance(n, ast.Assign) and any(
+            isinstance(t, ast.Subscript)
+            and isinstance(t.value, ast.Attribute)
+            and t.value.attr == "_free_qs" for t in n.targets)
+        and isinstance(n.value, ast.Call)
+    ]
+    assert replaces, "_respawn_or_raise no longer rebuilds _free_qs[wid]"

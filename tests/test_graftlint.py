@@ -42,6 +42,7 @@ EXPECTED_RULES = {
     "obs-fenced-span",
     "feed-shm-cleanup",
     "obs-vocab-coverage",
+    "conc-manifest-fresh",
 }
 
 
@@ -540,6 +541,70 @@ def test_mem_manifest_fresh_suppressed(tmp_path):
 def test_mem_manifest_fresh_clean_when_hash_matches(tmp_path):
     path = _mem_tree(tmp_path)
     assert not hits(FRESH_SRC, "mem-manifest-fresh", path=path)
+
+
+def _conc_tree(tmp_path, rel="sparknet_tpu/serve/batcher.py",
+               src=FRESH_SRC, record=True, stale=False):
+    """A fake repo: one concurrency-contract source file (+ optional
+    docs/conc_contracts/SOURCES.json recording its hash)."""
+    import hashlib
+    import json as _json
+
+    mod = tmp_path / rel
+    mod.parent.mkdir(parents=True)
+    mod.write_text(src)
+    if record:
+        digest = hashlib.sha256(src.encode()).hexdigest()
+        if stale:
+            digest = "0" * 64
+        cdir = tmp_path / "docs" / "conc_contracts"
+        cdir.mkdir(parents=True)
+        (cdir / "SOURCES.json").write_text(_json.dumps({rel: digest}))
+    return str(mod)
+
+
+def test_conc_manifest_fresh_positive_on_stale_hash(tmp_path):
+    path = _conc_tree(tmp_path, stale=True)
+    found = hits(FRESH_SRC, "conc-manifest-fresh", path=path)
+    assert len(found) == 1
+    assert "conc --update" in found[0].message
+
+
+def test_conc_manifest_fresh_positive_when_never_banked(tmp_path):
+    path = _conc_tree(tmp_path, rel="sparknet_tpu/loop/controller.py",
+                      record=False)
+    found = hits(FRESH_SRC, "conc-manifest-fresh", path=path)
+    assert len(found) == 1
+    assert "SOURCES.json missing" in found[0].message
+
+
+def test_conc_manifest_fresh_covers_window_runner(tmp_path):
+    # the one audited file OUTSIDE sparknet_tpu/: the /tools/ anchor
+    path = _conc_tree(tmp_path, rel="tools/tpu_window_runner.py",
+                      stale=True)
+    found = hits(FRESH_SRC, "conc-manifest-fresh", path=path)
+    assert len(found) == 1
+
+
+def test_conc_manifest_fresh_suppressed(tmp_path):
+    path = _conc_tree(tmp_path, stale=True)
+    src = ("# graftlint: disable-file=conc-manifest-fresh -- "
+           "manifest regen follows in this PR\n" + FRESH_SRC)
+    assert not hits(src, "conc-manifest-fresh", path=path)
+    assert suppressed_hits(src, "conc-manifest-fresh", path=path)
+
+
+def test_conc_manifest_fresh_clean_when_hash_matches(tmp_path):
+    path = _conc_tree(tmp_path)
+    assert not hits(FRESH_SRC, "conc-manifest-fresh", path=path)
+
+
+def test_conc_manifest_fresh_ignores_non_contract_files(tmp_path):
+    # parallel/ is graph/mem surface, not concurrency surface
+    other = tmp_path / "sparknet_tpu" / "parallel" / "modes.py"
+    other.parent.mkdir(parents=True)
+    other.write_text(FRESH_SRC)
+    assert not hits(FRESH_SRC, "conc-manifest-fresh", path=str(other))
 
 
 def test_mem_manifest_fresh_ignores_non_contract_files(tmp_path):
