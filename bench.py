@@ -47,6 +47,15 @@ from sparknet_tpu.common import (  # noqa: E402
     bank_path,
 )
 
+# "bytes accessed" extraction + GB rounding come from the byte model so
+# the banked step_gbytes figure and the `bytes` engine's headline
+# reconciliation share one definition (stdlib-only module: importing it
+# never initializes a backend — safe before the probe).
+from sparknet_tpu.analysis.byte_model import (  # noqa: E402
+    gbytes,
+    xla_cost_step_bytes,
+)
+
 # obs journaling (sparknet_tpu/obs, off unless SPARKNET_OBS is set): the
 # Recorder registers a common.bank_guard observer, so every banked
 # record and this script's own measurements share ONE code path for the
@@ -215,11 +224,20 @@ def _build_step(batch: int, model: str, crop: int, dtype_name: str,
     solver_cfg = getattr(models, f"{model}_solver")()
     # A/B knob: the bf16 step is HBM-bound (the roofline's bytes term
     # dominates), so recomputing activations under grad can trade cheap
-    # MXU flops for traffic. Off by default — flip on to measure.
-    if os.environ.get("SPARKNET_BENCH_REMAT", "0") == "1":
+    # MXU flops for traffic.  Off by default — flip on to measure.
+    # "1" is the legacy boolean (SolverConfig.remat → plain
+    # jax.checkpoint = the "full" policy); a policy name ("full",
+    # "dots", "blocks") routes Config.remat through solvers/solver.py
+    # apply_remat — the same knob the banked
+    # docs/byte_contracts/remat_policy.json winner rides, so the
+    # remat_ab queue job measures exactly what the byte model scored.
+    remat_env = os.environ.get("SPARKNET_BENCH_REMAT", "0")
+    if remat_env == "1":
         import dataclasses
 
         solver_cfg = dataclasses.replace(solver_cfg, remat=True)
+    elif remat_env not in ("", "0"):
+        set_config(remat=remat_env)
     solver = Solver(solver_cfg, net_param)
     if scan > 1:
         step, variables, slots, key = solver.jitted_scan_steps(scan, donate=True)
@@ -358,6 +376,12 @@ def measured_run(batch: int, iters: int, warmup: int, model: str, crop: int,
         storage = os.environ.get("SPARKNET_BENCH_STORAGE_DTYPE", "f32")
         if storage != "f32":
             rec["storage_dtype"] = storage
+    remat_env = os.environ.get("SPARKNET_BENCH_REMAT", "0")
+    if remat_env not in ("", "0"):
+        # A/B provenance (same rule as the fused/layout stamps): "1" is
+        # the legacy boolean = the "full" policy; names are Config.remat
+        # policies out of docs/byte_contracts/remat_policy.json
+        rec["remat"] = "full" if remat_env == "1" else remat_env
     # Window-runner provenance: which journaled dial (probe) this record
     # rode, so the judge can corroborate it against the tunnel log without
     # matching timestamps by hand (docs/evidence_r*/journal.jsonl).  Typed
@@ -397,7 +421,14 @@ def measured_run(batch: int, iters: int, warmup: int, model: str, crop: int,
             rec.update(bw)
         try:
             cost = step.lower(variables, slots, 0, feeds, key).compile().cost_analysis()
+            # "bytes accessed" extraction + GB rounding live in the byte
+            # model (analysis/byte_model.py) — the same arithmetic the
+            # `bytes` engine reconciles this record's step_gbytes against
+            # (docs/byte_contracts/headline.json), so the two sides of
+            # that gate can never disagree on what "step bytes" means.
+            bytes_accessed = xla_cost_step_bytes(cost)
             cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+            cost = cost or {}
             # HloCostAnalysis counts a while/scan BODY once, independent of
             # trip count (verified empirically: an 8-iter scanned matmul
             # reports ~1 iteration's flops), so the scan program's cost is
@@ -405,10 +436,9 @@ def measured_run(batch: int, iters: int, warmup: int, model: str, crop: int,
             # value-vs-bound guard below catches any backend that counts
             # differently rather than banking a contradiction.
             flops = float(cost.get("flops", 0.0))
-            bytes_accessed = float(cost.get("bytes accessed", 0.0))
             if flops > 0:
                 rec["step_gflop"] = round(flops / 1e9, 1)
-                rec["step_gbytes"] = round(bytes_accessed / 1e9, 2)
+                rec["step_gbytes"] = gbytes(bytes_accessed)
                 peak = V5E_PEAK_FLOPS.get(dtype_name)
                 if peak and bytes_accessed > 0:
                     t_bound = max(flops / peak, bytes_accessed / V5E_HBM_BYTES_S)
@@ -527,9 +557,9 @@ def cost_model_estimate(batch: int, model: str, crop: int, dtype_name: str) -> d
     step, variables, slots, key, feeds = _build_step(batch, model, crop, dtype_name)
     compiled = step.lower(variables, slots, 0, feeds, key).compile()
     cost = compiled.cost_analysis()
+    bytes_accessed = xla_cost_step_bytes(cost)
     cost = cost[0] if isinstance(cost, (list, tuple)) else cost
-    flops = float(cost.get("flops", 0.0))
-    bytes_accessed = float(cost.get("bytes accessed", 0.0))
+    flops = float((cost or {}).get("flops", 0.0))
     peak = V5E_PEAK_FLOPS.get(dtype_name, V5E_PEAK_FLOPS["bf16"])
     t_bound = max(flops / peak, bytes_accessed / V5E_HBM_BYTES_S)
     if t_bound <= 0:
@@ -537,7 +567,7 @@ def cost_model_estimate(batch: int, model: str, crop: int, dtype_name: str) -> d
     return {
         "roofline_img_s_upper_bound": round(batch / t_bound, 1),
         "step_gflop": round(flops / 1e9, 1),
-        "step_gbytes": round(bytes_accessed / 1e9, 2),
+        "step_gbytes": gbytes(bytes_accessed),
     }
 
 

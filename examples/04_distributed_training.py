@@ -14,6 +14,11 @@ its Spark apps); this example shows the three sync modes of
 
 Runs on any mesh: real TPU chips, or a virtual 8-device CPU mesh via
 --platform cpu (XLA_FLAGS=--xla_force_host_platform_device_count=8).
+
+--smoke is the plumbing check (CI): all three trainers compile and run
+a couple of rounds each, gated on finiteness instead of the accuracy
+bar — the full run is the convergence evidence (~10 min on a 1-core
+box; the smoke arm fits the tier-1 deadline).
 """
 
 import os
@@ -49,11 +54,14 @@ def main():
     from sparknet_tpu.parallel.trainer import ParallelTrainer
     from sparknet_tpu.solvers.solver import Solver
 
+    smoke = "--smoke" in sys.argv
     n = len(jax.devices())
     per_worker = 8
     global_batch = per_worker * n
-    rounds = 30
-    print(f"mesh: {n} devices; global batch {global_batch}")
+    rounds = 2 if smoke else 30
+    n_test = 1 if smoke else 5
+    print(f"mesh: {n} devices; global batch {global_batch}"
+          + (" (smoke)" if smoke else ""))
 
     def solver(batch):
         return Solver(models.cifar10_quick_solver(), models.cifar10_quick(batch))
@@ -65,7 +73,9 @@ def main():
     sync = ParallelTrainer(solver(global_batch), tau=1)
     for _ in range(rounds * 5):  # same optimizer-step budget as tau=5
         loss = sync.train_round(lambda it: make_batch(rs, global_batch))
-    results["sync tau=1"] = sync.test(5, lambda b: make_batch(rs, global_batch))
+    results["sync tau=1"] = sync.test(
+        n_test, lambda b: make_batch(rs, global_batch)
+    )
 
     # 2. The SparkNet algorithm: tau local steps, then average.  Feeds
     #    carry a [tau, B_global, ...] axis — tau batches per round.
@@ -80,7 +90,7 @@ def main():
     for _ in range(rounds):
         loss = spark.train_round(tau_feeds)
     results[f"tau={tau} averaging"] = spark.test(
-        5, lambda b: make_batch(rs, global_batch)
+        n_test, lambda b: make_batch(rs, global_batch)
     )
 
     # 3. EASGD: same feed contract, elastic center instead of averaging.
@@ -90,13 +100,20 @@ def main():
     )
     for _ in range(rounds):
         loss = easgd.train_round(tau_feeds)
-    results["easgd"] = easgd.test(5, lambda b: make_batch(rs, global_batch))
+    results["easgd"] = easgd.test(
+        n_test, lambda b: make_batch(rs, global_batch)
+    )
 
     del loss
     for name, scores in results.items():
         print(f"{name:18s} accuracy={scores['accuracy']:.3f} "
               f"loss={scores['loss']:.4f}")
-        assert scores["accuracy"] > 0.5, (name, scores)
+        if smoke:
+            assert np.isfinite(scores["loss"]), (name, scores)
+        else:
+            assert scores["accuracy"] > 0.5, (name, scores)
+    if smoke:
+        print("PASS (smoke: all three sync modes ran, losses finite)")
     return 0
 
 

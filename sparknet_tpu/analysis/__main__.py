@@ -20,6 +20,12 @@ Three engines share one front door and one findings schema:
   banking docs/conc_contracts/; the chaos scheduler
   ``SPARKNET_CHAOS_SCHED`` cross-validates the banked graph at
   dryrun time).  Pure AST — no jax, no lowering, zero chip time.
+* ``bytes`` — bytecheck, the static per-step HBM traffic census
+  (gross eqn census + per-op-class floor over the same CPU-mesh
+  tracings, reconciled against the measured headline step bytes,
+  banking docs/byte_contracts/; ``--remat`` runs the chip-free
+  remat/donation schedule search that banks the ``Config.remat``
+  policy table).
 
 Exit codes (all subcommands): 0 clean (or suppressed-only), 1
 unsuppressed findings, 2 usage error.  ``--json`` (or the legacy
@@ -315,12 +321,94 @@ def conc_main(argv: list[str] | None = None) -> int:
     return 1 if any(not f.suppressed for f in findings) else 0
 
 
+def bytes_main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m sparknet_tpu.analysis bytes",
+        description="bytecheck: statically census each parallel mode's "
+        "per-step HBM traffic on the virtual CPU mesh (gross eqn census "
+        "+ per-op-class floor), reconcile the headline config against "
+        "the measured step bytes, and diff against the banked manifests "
+        "(docs/byte_contracts/) — zero chip time.  --remat runs the "
+        "chip-free remat/donation schedule search instead and banks the "
+        "bytes-minimal Config.remat policy per zoo family x dtype "
+        "(docs/byte_contracts/remat_policy.json)",
+    )
+    ap.add_argument("--mode", action="append", default=[],
+                    help="census only this mode (repeatable; default all)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--json", action="store_true",
+                    help="shorthand for --format json")
+    ap.add_argument("--update", action="store_true",
+                    help="rewrite the banked manifests (and SOURCES.json "
+                    "on a full run) instead of diffing against them")
+    ap.add_argument("--remat", action="store_true",
+                    help="run the remat/donation schedule search instead "
+                    "of the per-mode census (banks docs/byte_contracts/"
+                    "remat_policy.json with --update)")
+    ap.add_argument("--family", action="append", default=[],
+                    help="--remat: search only this zoo family "
+                    "(repeatable)")
+    ap.add_argument("--show-suppressed", action="store_true")
+    ap.add_argument("--list-modes", action="store_true",
+                    help="print the mode registry and exit")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the byte-rule catalog and exit")
+    ap.add_argument("--devices", type=int, default=8,
+                    help="virtual CPU mesh width (default 8, the test "
+                    "harness mesh)")
+    args = ap.parse_args(argv)
+
+    from sparknet_tpu.analysis import bytecheck
+
+    if args.list_rules:
+        for rule_id, summary in bytecheck.iter_rules():
+            print(f"{rule_id}: {summary}")
+        return 0
+    if args.list_modes:
+        from sparknet_tpu.parallel.modes import list_modes
+
+        for name in list_modes():
+            print(name)
+        return 0
+
+    as_json = args.json or args.format == "json"
+    try:
+        if args.remat:
+            progress = None if as_json else (
+                lambda f: print(f"bytecheck: scoring {f} ...",
+                                file=sys.stderr))
+            findings, _ = bytecheck.run_remat_search(
+                update=args.update, families=args.family or None,
+                n_devices=args.devices, progress=progress)
+        else:
+            progress = None if as_json else (
+                lambda m: print(f"bytecheck: censusing {m} ...",
+                                file=sys.stderr))
+            findings, _ = bytecheck.run_bytecheck(
+                args.mode or None, update=args.update,
+                n_devices=args.devices, progress=progress)
+    except KeyError as e:
+        print(e.args[0], file=sys.stderr)
+        return 2
+    if as_json:
+        print(render_json(findings))
+    else:
+        print(render_text(findings, show_suppressed=args.show_suppressed,
+                          label="bytecheck"))
+        if args.update:
+            print(f"bytecheck: manifests updated in "
+                  f"{os.path.relpath(bytecheck.MANIFEST_DIR)}")
+    return 1 if any(not f.suppressed for f in findings) else 0
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "graph":
         return graph_main(argv[1:])
     if argv and argv[0] == "mem":
         return mem_main(argv[1:])
+    if argv and argv[0] == "bytes":
+        return bytes_main(argv[1:])
     if argv and argv[0] == "conc":
         return conc_main(argv[1:])
     if argv and argv[0] == "lint":

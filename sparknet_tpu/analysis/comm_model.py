@@ -101,7 +101,9 @@ def expected_comm(mode: str, *, param_bytes: int, state_bytes: int = 0,
     arena instead of the per-blob grads.  Raises KeyError for unknown
     modes — a new parallel mode must state its communication contract
     here before it can bank a manifest."""
-    if mode in ("solo", "solo_nhwc", "solo_fused"):
+    # solo_remat shares solo's contract: rematerialization recomputes
+    # on-chip, it never creates a wire
+    if mode in ("solo", "solo_nhwc", "solo_fused", "solo_remat"):
         return CommExpectation(
             required={},
             forbidden=COLLECTIVE_KINDS,
@@ -118,8 +120,10 @@ def expected_comm(mode: str, *, param_bytes: int, state_bytes: int = 0,
         )
     # dp_nhwc shares dp's budget exactly: params never reorient under
     # the nhwc layout (ops/layout.py), so the grad all-reduce moves the
-    # same bytes — a layout that changed this block would be a bug
-    if mode in ("dp", "dp_bf16", "mobilenet_dp", "dp_nhwc"):
+    # same bytes — a layout that changed this block would be a bug.
+    # dp_remat likewise: recompute changes what the backward reads,
+    # not what the mesh reduces.
+    if mode in ("dp", "dp_bf16", "mobilenet_dp", "dp_nhwc", "dp_remat"):
         return CommExpectation(
             required={"all-reduce": _window(param_bytes, state_bytes)},
             forbidden=("all-to-all", "collective-permute", "all-gather"),

@@ -1098,6 +1098,98 @@ def check_conc_manifest_fresh(ctx: ModuleContext) -> Iterator[tuple[int, str]]:
 
 
 # ---------------------------------------------------------------------------
+# byte-manifest-fresh
+# ---------------------------------------------------------------------------
+
+# the byte-contract source surface: editing any of these changes what
+# bytecheck censuses (layer geometry, optimizer traffic, layout, the
+# comm windows, the block-boundary save tags) so the banked
+# docs/byte_contracts/ manifests — census, headline reconciliation,
+# AND the remat-policy table Config.remat consumers read — must be
+# regenerated in the same PR (kept in sync with
+# bytecheck.BYTE_SOURCE_PATTERNS — spelled out here too so this module
+# stays importable without bytecheck)
+_BYTE_SOURCE_DIRS = (
+    "sparknet_tpu/parallel/",
+    "sparknet_tpu/serve/",
+)
+_BYTE_SOURCE_FILES = (
+    "sparknet_tpu/models/zoo.py",
+    "sparknet_tpu/compiler/graph.py",
+    "sparknet_tpu/ops/pallas_kernels.py",
+    "sparknet_tpu/ops/layout.py",
+    "sparknet_tpu/solvers/solver.py",
+    "sparknet_tpu/solvers/updates.py",
+    "sparknet_tpu/solvers/arena.py",
+    "sparknet_tpu/analysis/bytecheck.py",
+    "sparknet_tpu/analysis/byte_model.py",
+    "sparknet_tpu/analysis/comm_model.py",
+    "sparknet_tpu/analysis/memcheck.py",
+    "sparknet_tpu/analysis/mem_model.py",
+)
+_BYTE_REGEN = ("regenerate with `python -m sparknet_tpu.analysis bytes "
+               "--update` (+ `--remat --update` for the policy table)")
+
+
+def _byte_source_rel(path: str) -> tuple[str, str] | None:
+    """(repo_root, repo_relative_path) when ``path`` is part of the
+    byte-contract source surface, else None."""
+    norm = os.path.abspath(path).replace(os.sep, "/")
+    idx = norm.rfind("/sparknet_tpu/")
+    if idx < 0:
+        return None
+    root, rel = norm[:idx], norm[idx + 1:]
+    if rel.startswith(_BYTE_SOURCE_DIRS) or rel in _BYTE_SOURCE_FILES:
+        return root, rel
+    return None
+
+
+@rule(
+    "byte-manifest-fresh",
+    "a PR touching the byte-contract surface (parallel/, serve/, "
+    "compiler/graph.py, models/zoo.py, ops/, solvers/, or bytecheck "
+    "itself) must regenerate the docs/byte_contracts/ manifests",
+)
+def check_byte_manifest_fresh(ctx: ModuleContext) -> Iterator[tuple[int, str]]:
+    """The byte manifests are the repo's step-bytes contract: the
+    headline reconciliation says the analytic census still describes
+    the program the bench measured, and the remat-policy table is what
+    ``Config.remat`` actually routes (parallel/modes.
+    _banked_remat_policy).  A stale table silently runs yesterday's
+    schedule.  ``bytes --update`` banks a sha256 per source file in
+    ``docs/byte_contracts/SOURCES.json``; this rule re-hashes the
+    linted source and flags any mismatch — the mem-manifest-fresh
+    mechanism on the traffic surface.  Blind spot: an edit that
+    reverts to the banked bytes passes (correctly — the censused
+    programs are the banked ones again)."""
+    hit = _byte_source_rel(ctx.path)
+    if hit is None:
+        return
+    root, rel = hit
+    src = os.path.join(root, "docs", "byte_contracts", "SOURCES.json")
+    if not os.path.exists(src):
+        yield (1, f"{rel} is byte-contract source but no manifests are "
+                  f"banked (docs/byte_contracts/SOURCES.json missing) "
+                  f"— {_BYTE_REGEN}")
+        return
+    try:
+        with open(src, encoding="utf-8") as f:
+            recorded = json.load(f)
+    except (OSError, ValueError):
+        yield (1, f"docs/byte_contracts/SOURCES.json unreadable — "
+                  f"{_BYTE_REGEN}")
+        return
+    want = recorded.get(rel)
+    digest = hashlib.sha256(ctx.source.encode("utf-8")).hexdigest()
+    if want is None:
+        yield (1, f"{rel} is new byte-contract source not covered by "
+                  f"the banked manifests — {_BYTE_REGEN}")
+    elif want != digest:
+        yield (1, f"{rel} changed since the byte manifests were banked "
+                  f"— {_BYTE_REGEN}")
+
+
+# ---------------------------------------------------------------------------
 # queue-job-hygiene
 # ---------------------------------------------------------------------------
 

@@ -1141,10 +1141,16 @@ def cmd_time(args) -> int:
         step, v, s, key = solver.jitted_train_step(donate=False)
         compiled = step.lower(v, s, 0, feeds, key).compile()
         cost = compiled.cost_analysis() or {}
+        # "bytes accessed" extraction lives in the byte model — the same
+        # arithmetic bench.py banks and the `bytes` engine reconciles,
+        # so "hbm_bytes_per_step" here can never drift from the banked
+        # step_gbytes definition (analysis/byte_model.py)
+        from sparknet_tpu.analysis.byte_model import xla_cost_step_bytes
+
+        bytes_ = xla_cost_step_bytes(cost)
         if isinstance(cost, list):  # older jax returns [dict]
             cost = cost[0] if cost else {}
         flops = float(cost.get("flops", 0.0))
-        bytes_ = float(cost.get("bytes accessed", 0.0))
         batch = next(iter(feeds.values())).shape[0]
         mem = compiled.memory_analysis()
         print(json.dumps({
@@ -1219,10 +1225,14 @@ def _time_trace(args, net_param, solver_cfg) -> int:
     # not two — compiles are minutes-scale for big nets on the tunnel)
     compiled = step.lower(v, s, 0, feeds, key).compile()
     cost = compiled.cost_analysis() or {}
+    # bytes through the byte model's shared extraction (the drift pin in
+    # tests/test_bytecheck.py covers this path too)
+    from sparknet_tpu.analysis.byte_model import xla_cost_step_bytes
+
+    hbm_bytes = xla_cost_step_bytes(cost)
     if isinstance(cost, list):
         cost = cost[0] if cost else {}
     flops = float(cost.get("flops", 0.0))
-    hbm_bytes = float(cost.get("bytes accessed", 0.0))
 
     batch = next(iter(feeds.values())).shape[0]
     device = jax.devices()[0]

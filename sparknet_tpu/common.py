@@ -97,6 +97,22 @@ class Config:
     # Only consulted when ``fused_update`` is on; checkpoints stay
     # blob-wise in the net's param dtype either way (dtype-invariant).
     storage_dtype: str = os.environ.get("SPARKNET_STORAGE_DTYPE", "f32").lower()
+    # Rematerialization policy for the train step's forward (the bytes
+    # diet the bytecheck schedule search scores chip-free — ROADMAP
+    # item 5): ``""`` (default — off, every traced program byte-
+    # identical to the banked manifests), ``"full"`` (jax.checkpoint,
+    # nothing saveable — the maximal recompute arm), ``"dots"``
+    # (dots_saveable — matmul outputs kept, convs recomputed), or
+    # ``"blocks"`` (per-block boundaries: pooling-layer outputs tagged
+    # ``checkpoint_name`` in compiler/graph.py and saved via
+    # save_only_these_names; everything between boundaries recomputed).
+    # Routed through ``solvers/solver.py remat_policy`` into every
+    # step builder; the banked winner per family lives in
+    # ``docs/byte_contracts/remat_policy.json``.  Read at Solver
+    # CONSTRUCTION/trace time like every Config field;
+    # ``SPARKNET_REMAT`` seeds it, the bench A/B flips it via
+    # ``SPARKNET_BENCH_REMAT``.
+    remat: str = os.environ.get("SPARKNET_REMAT", "").lower()
     # Default mesh axis names: data parallelism over 'data', within-layer
     # (tensor) sharding over 'model', sequence/context parallelism over
     # 'seq' (ring / Ulysses attention).
@@ -158,6 +174,14 @@ def set_config(**overrides) -> Config:
             raise ValueError(f"storage_dtype must be 'f32' or 'bf16', got "
                              f"{overrides['storage_dtype']!r}")
         overrides = {**overrides, "storage_dtype": sd}
+    if "remat" in overrides:
+        rp = str(overrides["remat"]).lower()
+        rp = {"none": "", "off": ""}.get(rp, rp)
+        if rp not in ("", "full", "dots", "blocks"):
+            raise ValueError(
+                f"remat must be one of '', 'full', 'dots', 'blocks', got "
+                f"{overrides['remat']!r}")
+        overrides = {**overrides, "remat": rp}
     with _lock:
         _config = dataclasses.replace(_config, **overrides)
     return _config

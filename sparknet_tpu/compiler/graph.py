@@ -35,6 +35,17 @@ from sparknet_tpu.proto.text_format import Message
 Params = dict[str, list[jax.Array]]
 State = dict[str, dict[str, jax.Array]]
 
+# The per-block remat boundary tag (Config.remat == "blocks"): pooling
+# outputs are a CNN's natural block edges (each conv/relu stack drains
+# into one), so ``apply`` names them via ``jax.ad_checkpoint.
+# checkpoint_name`` and the "blocks" checkpoint policy
+# (solvers/solver.py apply_remat: save_only_these_names) keeps exactly
+# these alive for backward — everything inside a block recomputes.
+# Families with no pooling layers (transformer) degrade to the "full"
+# policy's save-nothing behavior, which keeps the bytecheck
+# monotonicity contract (more recompute => never more saved bytes).
+BLOCK_SAVE_NAME = "sparknet_block_boundary"
+
 
 @dataclasses.dataclass
 class NetVars:
@@ -390,6 +401,11 @@ class Network:
         # (BatchNorm stats) are cast back to their stored dtype.
         cdt = get_config().compute_dtype
         mixed = cdt != jnp.float32
+        # block-boundary tagging is trace-time and strictly gated: with
+        # Config.remat != "blocks" (the default) no name primitive is
+        # emitted and the traced program is byte-identical to the
+        # banked manifests
+        tag_blocks = get_config().remat == "blocks"
 
         def _cast(x, dt):
             return (
@@ -443,6 +459,12 @@ class Network:
             # time --trace); '/' would nest scopes, so flatten it
             with jax.named_scope("L." + layer.name.replace("/", ".")):
                 out = layer.apply(p, s, ins, train=train, rng=sub)
+            if tag_blocks and layer.type == "Pooling":
+                from jax.ad_checkpoint import checkpoint_name
+
+                out = dataclasses.replace(out, outputs=[
+                    checkpoint_name(o, BLOCK_SAVE_NAME)
+                    for o in out.outputs])
             if out.state:
                 if mixed and layer.name in variables.state:
                     prev = variables.state[layer.name]

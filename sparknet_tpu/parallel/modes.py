@@ -28,9 +28,14 @@ the fused ``update`` block), ``tp``
 all-to-all sequence parallelism — the ring impl is trace-broken under
 the pinned jax, see test_seq_parallel's seed state), ``gpipe``
 (pipeline ppermute), ``moe`` (expert all_to_all dispatch),
-``elastic_w{8,6,4}`` (width-parameterized τ-averaging twins), and
+``elastic_w{8,6,4}`` (width-parameterized τ-averaging twins),
 ``serve_b{1,8,64,256}`` (the serving engine's AOT bucket forwards —
-single-chip, forward-only, zero collectives).
+single-chip, forward-only, zero collectives), and
+``solo_remat``/``dp_remat`` (the rematerialization twins — the banked
+bytes-minimal ``Config.remat`` policy from
+``docs/byte_contracts/remat_policy.json`` routed through the same
+build, identical comm contracts; they exist to prove the byte model's
+modeled saved-activation drop lowers as predicted).
 """
 
 from __future__ import annotations
@@ -143,13 +148,16 @@ def _fused_update_block(layout) -> dict:
 def _trainer_target(name: str, family_name: str, mesh, *, tau: int = 1,
                     elastic_alpha: float = 0.0, per_device_batch: int = 2,
                     rules=None, compute_dtype=None, layout=None,
-                    fused: bool = False,
+                    fused: bool = False, remat: str | None = None,
                     expects_sharded_params: bool = False) -> TraceTarget:
     """The shared trainer-mode factory: construct Solver+ParallelTrainer
     exactly as the dryrun does, stop at the jitted round function.
     ``layout``: internal activation layout for the whole build+trace
     (None = leave the global config alone).  ``fused``: build the
-    Solver with the one-pass arena update (Config.fused_update)."""
+    Solver with the one-pass arena update (Config.fused_update).
+    ``remat``: rematerialization policy (Config.remat) for the whole
+    build+trace — the dp_remat twin routes the banked byte-minimal
+    policy here."""
     from sparknet_tpu.common import get_config, set_config
     from sparknet_tpu.models.zoo import GRAPH_SWEEP_FAMILIES
     from sparknet_tpu.parallel.trainer import ParallelTrainer
@@ -169,6 +177,8 @@ def _trainer_target(name: str, family_name: str, mesh, *, tau: int = 1,
             overrides["layout"] = layout
         if fused:
             overrides["fused_update"] = True
+        if remat is not None:
+            overrides["remat"] = remat
         if not overrides:
             yield
             return
@@ -221,6 +231,8 @@ def _trainer_target(name: str, family_name: str, mesh, *, tau: int = 1,
         "dtype": "bf16" if compute_dtype == jnp.bfloat16 else "f32",
         "layout": layout or "nchw",
     }
+    if remat is not None:
+        meta["remat"] = remat
     if fused:
         meta["fused"] = True
         # the comm model's hi bound prices the PADDED arena (GSPMD may
@@ -255,7 +267,8 @@ def _trainer_target(name: str, family_name: str, mesh, *, tau: int = 1,
 
 
 def _mode_solo(devices, layout: str | None = None,
-               name: str = "solo", fused: bool = False) -> TraceTarget:
+               name: str = "solo", fused: bool = False,
+               remat: str | None = None) -> TraceTarget:
     """Single-chip Solver step — the negative control (no mesh, so the
     lowered program must contain ZERO collectives) and the donation
     audit's original catch: ``Solver._train_step`` shipped undonated
@@ -263,7 +276,9 @@ def _mode_solo(devices, layout: str | None = None,
     ``layout="nhwc"`` builds the channels-last twin (mode solo_nhwc),
     whose manifest pins the zero-interior-transpose layout contract;
     ``fused=True`` builds the one-pass-update twin (mode solo_fused),
-    whose manifest pins the arena update block."""
+    whose manifest pins the arena update block; ``remat`` builds the
+    rematerialization twin (mode solo_remat) under the given
+    Config.remat policy."""
     from sparknet_tpu.common import get_config, set_config
     from sparknet_tpu.models.zoo import GRAPH_SWEEP_FAMILIES
     from sparknet_tpu.solvers.solver import Solver
@@ -278,6 +293,8 @@ def _mode_solo(devices, layout: str | None = None,
             overrides["layout"] = layout
         if fused:
             overrides["fused_update"] = True
+        if remat is not None:
+            overrides["remat"] = remat
         if not overrides:
             yield
             return
@@ -297,6 +314,8 @@ def _mode_solo(devices, layout: str | None = None,
     carry_out = sum(len(jax.tree_util.tree_leaves(t)) for t in args[:2])
     meta = {"family": "cifar10_quick", "mesh": {}, "tau": 1,
             "batch": B, "dtype": "f32", "layout": layout or "nchw"}
+    if remat is not None:
+        meta["remat"] = remat
     if fused:
         meta["fused"] = True
         meta["arena_bytes"] = solver._arena.total_bytes
@@ -359,6 +378,49 @@ def _mode_dp_fused(devices) -> TraceTarget:
     same ``update`` block as solo_fused."""
     return _trainer_target("dp_fused", "cifar10_quick",
                            _data_mesh(devices), fused=True)
+
+
+def _banked_remat_policy(family: str = "cifar10_quick",
+                         dtype: str = "f32") -> str:
+    """The bytes-minimal remat policy the schedule search banked in
+    ``docs/byte_contracts/remat_policy.json`` for (family, dtype) —
+    the remat twins route THIS policy so the banked graph+mem
+    manifests pin the very schedule ``Config.remat`` would run.
+    Deterministic ``"full"`` fallback when the table is absent or
+    predates the family (first bank of a fresh clone)."""
+    import json
+    import pathlib
+
+    from sparknet_tpu.analysis.byte_model import selected_policy
+
+    path = (pathlib.Path(__file__).resolve().parents[2]
+            / "docs" / "byte_contracts" / "remat_policy.json")
+    try:
+        table = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return "full"
+    return selected_policy(table, family, dtype, default="full")
+
+
+def _mode_solo_remat(devices) -> TraceTarget:
+    """The rematerialization twin of solo: same family/batch/layout,
+    the loss built under the banked bytes-minimal ``Config.remat``
+    policy (solvers/solver.py apply_remat).  The banked mem manifest
+    is the proof obligation for the byte model's modeled
+    saved-activation drop — remat changes residency, never the
+    zero-collective comm contract."""
+    return _mode_solo(devices, name="solo_remat",
+                      remat=_banked_remat_policy())
+
+
+def _mode_dp_remat(devices) -> TraceTarget:
+    """tau=1 GSPMD DP under the banked remat policy: the comm contract
+    is dp's exactly (recompute changes what the backward reads, not
+    what the mesh reduces — the grad all-reduce moves the same param
+    bytes), plus the mem twin pinning the residency drop at width 8."""
+    return _trainer_target("dp_remat", "cifar10_quick",
+                           _data_mesh(devices),
+                           remat=_banked_remat_policy())
 
 
 def _mode_mobilenet_dp(devices) -> TraceTarget:
@@ -584,9 +646,11 @@ MODES: dict[str, Callable] = {
     "solo": _mode_solo,
     "solo_nhwc": _mode_solo_nhwc,
     "solo_fused": _mode_solo_fused,
+    "solo_remat": _mode_solo_remat,
     "dp": _mode_dp,
     "dp_nhwc": _mode_dp_nhwc,
     "dp_fused": _mode_dp_fused,
+    "dp_remat": _mode_dp_remat,
     "dp_bf16": _mode_dp_bf16,
     "tau": _mode_tau,
     "easgd": _mode_easgd,

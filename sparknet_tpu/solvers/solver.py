@@ -160,6 +160,49 @@ def load_solver_net(solver_msg: Message, root: str = "") -> Message:
 DataFn = Callable[[int], dict[str, Any]]  # iteration -> feed dict
 
 
+def remat_policy(cfg: SolverConfig) -> str:
+    """The effective rematerialization policy for a step build.
+
+    Two knobs merge here: the per-solver prototxt bool
+    (``SolverConfig.remat`` — the pre-existing coarse switch, mapped to
+    the ``"full"`` policy it always meant) and the global
+    ``Config.remat`` string (``SPARKNET_REMAT`` / ``set_config`` — the
+    bytecheck schedule search's routing, ``docs/byte_contracts/
+    remat_policy.json``).  Empty string = off; with both knobs off
+    every step builder below is byte-identical to the banked
+    graph/mem manifests (the bit-identity pin in
+    tests/test_bytecheck.py)."""
+    if cfg.remat:
+        return "full"
+    return get_config().remat
+
+
+def apply_remat(loss_fn, policy: str):
+    """Wrap ``loss_fn`` in ``jax.checkpoint`` under ``policy``:
+    ``""``/``"none"`` = untouched (the off path returns the SAME
+    function object — zero trace perturbation), ``"full"`` = nothing
+    saveable (plain ``jax.checkpoint``), ``"dots"`` = dots_saveable
+    (matmul outputs kept, convs recomputed), ``"blocks"`` = save only
+    the pooling-boundary activations ``Network.apply`` tags with
+    ``checkpoint_name`` when ``Config.remat == "blocks"``
+    (compiler/graph.py BLOCK_SAVE_NAME)."""
+    if not policy or policy == "none":
+        return loss_fn
+    if policy == "full":
+        return jax.checkpoint(loss_fn)
+    from jax import checkpoint_policies as _cp
+
+    if policy == "dots":
+        return jax.checkpoint(loss_fn, policy=_cp.dots_saveable)
+    if policy == "blocks":
+        from sparknet_tpu.compiler.graph import BLOCK_SAVE_NAME
+
+        return jax.checkpoint(
+            loss_fn, policy=_cp.save_only_these_names(BLOCK_SAVE_NAME))
+    raise ValueError(f"unknown remat policy {policy!r} "
+                     "(want '', 'full', 'dots', or 'blocks')")
+
+
 def build_train_step(cfg: SolverConfig, net: Network, specs,
                      debug: bool = False):
     """The fused train step as a module-level builder:
@@ -185,8 +228,7 @@ def build_train_step(cfg: SolverConfig, net: Network, specs,
         )
         return loss, (new_state, sink if debug else {})
 
-    if cfg.remat:
-        loss_fn = jax.checkpoint(loss_fn)
+    loss_fn = apply_remat(loss_fn, remat_policy(cfg))
 
     def train_step(variables, slots, it, feeds, key):
         rng = step_key(key, it)
@@ -264,8 +306,7 @@ def build_fused_core(cfg: SolverConfig, net: Network, layout):
         )
         return loss, new_state
 
-    if cfg.remat:
-        loss_fn = jax.checkpoint(loss_fn)
+    loss_fn = apply_remat(loss_fn, remat_policy(cfg))
 
     def core(param_arena, slot_arenas, state, it, feeds, key):
         rng = step_key(key, it)
@@ -463,8 +504,7 @@ class Solver:
             )
             return loss, (new_state, sink if debug else {})
 
-        if cfg.remat:
-            loss_fn = jax.checkpoint(loss_fn)
+        loss_fn = apply_remat(loss_fn, remat_policy(cfg))
 
         def train_step(variables, slots, it, feeds, key):
             rng = step_key(key, it)

@@ -20,7 +20,10 @@ SCRIPTS = {
     "02_brewing_logreg.py": 560,
     "03_fine_tuning.py": 560,
     "net_surgery.py": 560,
-    "04_distributed_training.py": 1100,
+    # full run is the convergence evidence (~10 min, over the tier-1
+    # deadline); the smoke arm compiles all three shard_map programs
+    # and runs 2 rounds each, gated on finiteness
+    "04_distributed_training.py": (560, ["--smoke"]),
     "06_listfile_sources.py": 560,
     "08_db_backends.py": 560,
     "09_int8_deploy.py": 560,

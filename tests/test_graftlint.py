@@ -43,6 +43,7 @@ EXPECTED_RULES = {
     "feed-shm-cleanup",
     "obs-vocab-coverage",
     "conc-manifest-fresh",
+    "byte-manifest-fresh",
 }
 
 
@@ -481,6 +482,68 @@ def test_graph_manifest_fresh_suppressed(tmp_path):
 def test_graph_manifest_fresh_clean_when_hash_matches(tmp_path):
     path = _graph_tree(tmp_path)
     assert not hits(FRESH_SRC, "graph-manifest-fresh", path=path)
+
+
+# -- byte-manifest-fresh ----------------------------------------------------
+
+
+def _byte_tree(tmp_path, src=FRESH_SRC, record=True, stale=False,
+               rel="sparknet_tpu/solvers/solver.py"):
+    """A fake repo: one byte-contract source file (+ optional
+    docs/byte_contracts/SOURCES.json recording its hash)."""
+    import hashlib
+    import json as _json
+
+    mod = tmp_path.joinpath(*rel.split("/"))
+    mod.parent.mkdir(parents=True, exist_ok=True)
+    mod.write_text(src)
+    if record:
+        digest = hashlib.sha256(src.encode()).hexdigest()
+        if stale:
+            digest = "0" * 64
+        cdir = tmp_path / "docs" / "byte_contracts"
+        cdir.mkdir(parents=True)
+        (cdir / "SOURCES.json").write_text(_json.dumps({rel: digest}))
+    return str(mod)
+
+
+def test_byte_manifest_fresh_positive_on_stale_hash(tmp_path):
+    path = _byte_tree(tmp_path, stale=True)
+    found = hits(FRESH_SRC, "byte-manifest-fresh", path=path)
+    assert len(found) == 1
+    assert "bytes --update" in found[0].message
+
+
+def test_byte_manifest_fresh_positive_when_never_banked(tmp_path):
+    path = _byte_tree(tmp_path, record=False)
+    found = hits(FRESH_SRC, "byte-manifest-fresh", path=path)
+    assert len(found) == 1
+    assert "SOURCES.json missing" in found[0].message
+
+
+def test_byte_manifest_fresh_covers_the_serve_dir(tmp_path):
+    path = _byte_tree(tmp_path, record=False,
+                      rel="sparknet_tpu/serve/engine.py")
+    assert hits(FRESH_SRC, "byte-manifest-fresh", path=path)
+
+
+def test_byte_manifest_fresh_ignores_non_surface_files(tmp_path):
+    path = _byte_tree(tmp_path, record=False,
+                      rel="sparknet_tpu/obs/report.py")
+    assert not hits(FRESH_SRC, "byte-manifest-fresh", path=path)
+
+
+def test_byte_manifest_fresh_suppressed(tmp_path):
+    path = _byte_tree(tmp_path, stale=True)
+    src = ("# graftlint: disable-file=byte-manifest-fresh -- "
+           "manifest regen follows in this PR\n" + FRESH_SRC)
+    assert not hits(src, "byte-manifest-fresh", path=path)
+    assert suppressed_hits(src, "byte-manifest-fresh", path=path)
+
+
+def test_byte_manifest_fresh_clean_when_hash_matches(tmp_path):
+    path = _byte_tree(tmp_path)
+    assert not hits(FRESH_SRC, "byte-manifest-fresh", path=path)
 
 
 def test_graph_manifest_fresh_ignores_non_contract_files(tmp_path):
