@@ -1190,6 +1190,109 @@ def check_byte_manifest_fresh(ctx: ModuleContext) -> Iterator[tuple[int, str]]:
 
 
 # ---------------------------------------------------------------------------
+# ctl-manifest-fresh
+# ---------------------------------------------------------------------------
+
+# the control-plane contract surface: editing any of these changes what
+# the scenario replay derives (burn-window math, controller decision
+# order, the traffic programs themselves, or the gate manifest the
+# engine loads), so the banked docs/ctl_contracts/ action traces must
+# be regenerated in the same PR (kept in sync with SOURCE_FILES in
+# tools/ctl_scenarios.py — spelled out here too so this module stays
+# importable without the harness)
+_CTL_SOURCES = (
+    "sparknet_tpu/obs/burn.py",
+    "sparknet_tpu/loop/autoctl.py",
+    "tools/ctl_scenarios.py",
+)
+# non-python source the linter never visits: re-hashed from disk on any
+# surface hit (the manifest decides every gate's bound and id)
+_CTL_DATA_SOURCE = "docs/slo_manifest.json"
+_CTL_SCENARIOS = ("diurnal_ramp", "flash_crowd", "straggler_storm",
+                  "poison_canary")
+_CTL_REGEN = "regenerate with `python tools/ctl_scenarios.py --update`"
+
+
+def _ctl_source_rel(path: str) -> tuple[str, str] | None:
+    """(repo_root, repo_relative_path) when ``path`` is part of the
+    control-plane contract surface, else None.  Two anchors: the
+    surface spans the package (burn engine + controller) AND tools/
+    (the replay harness that banks the traces)."""
+    norm = os.path.abspath(path).replace(os.sep, "/")
+    for anchor in ("/sparknet_tpu/", "/tools/"):
+        idx = norm.rfind(anchor)
+        if idx < 0:
+            continue
+        root, rel = norm[:idx], norm[idx + 1:]
+        if rel in _CTL_SOURCES:
+            return root, rel
+    return None
+
+
+@rule(
+    "ctl-manifest-fresh",
+    "a PR touching the control-plane surface (obs/burn.py, "
+    "loop/autoctl.py, tools/ctl_scenarios.py, or docs/slo_manifest."
+    "json) must regenerate the docs/ctl_contracts/ action traces",
+)
+def check_ctl_manifest_fresh(ctx: ModuleContext) -> Iterator[tuple[int, str]]:
+    """The ctl manifests are the controller's banked behavior: the
+    exact action trace each scenario replay must reproduce before
+    ``obs dryrun --ctl`` passes.  A stale trace either blesses
+    yesterday's decision order or fails a correct controller against
+    retired expectations.  ``tools/ctl_scenarios.py --update`` banks a
+    sha256 per source file in ``docs/ctl_contracts/SOURCES.json``;
+    this rule re-hashes the linted source (plus the gate manifest,
+    which the linter never visits as python) and flags any mismatch —
+    the conc-manifest-fresh mechanism on the control surface.  Blind
+    spot: an edit that reverts to the banked bytes passes (correctly —
+    the derived traces are the banked ones again)."""
+    hit = _ctl_source_rel(ctx.path)
+    if hit is None:
+        return
+    root, rel = hit
+    src = os.path.join(root, "docs", "ctl_contracts", "SOURCES.json")
+    if not os.path.exists(src):
+        yield (1, f"{rel} is control-plane contract source but no "
+                  f"traces are banked (docs/ctl_contracts/SOURCES.json "
+                  f"missing) — {_CTL_REGEN}")
+        return
+    try:
+        with open(src, encoding="utf-8") as f:
+            recorded = json.load(f)
+    except (OSError, ValueError):
+        yield (1, f"docs/ctl_contracts/SOURCES.json unreadable — "
+                  f"{_CTL_REGEN}")
+        return
+    want = recorded.get(rel)
+    digest = hashlib.sha256(ctx.source.encode("utf-8")).hexdigest()
+    if want is None:
+        yield (1, f"{rel} is new control-plane contract source not "
+                  f"covered by the banked traces — {_CTL_REGEN}")
+    elif want != digest:
+        yield (1, f"{rel} changed since the ctl traces were banked — "
+                  f"{_CTL_REGEN}")
+    # the gate manifest is data, not a linted module — re-hash it from
+    # disk while we are on a surface hit so a bound change cannot ride
+    # in without a re-bank
+    data = os.path.join(root, _CTL_DATA_SOURCE)
+    try:
+        with open(data, "rb") as f:
+            data_digest = hashlib.sha256(f.read()).hexdigest()
+    except OSError:
+        data_digest = None
+    if recorded.get(_CTL_DATA_SOURCE) != data_digest:
+        yield (1, f"{_CTL_DATA_SOURCE} changed since the ctl traces "
+                  f"were banked — {_CTL_REGEN}")
+    for name in _CTL_SCENARIOS:
+        if not os.path.exists(os.path.join(
+                root, "docs", "ctl_contracts", f"{name}.json")):
+            yield (1, f"docs/ctl_contracts/{name}.json missing — the "
+                      f"scenario catalog banks all four traces — "
+                      f"{_CTL_REGEN}")
+
+
+# ---------------------------------------------------------------------------
 # queue-job-hygiene
 # ---------------------------------------------------------------------------
 

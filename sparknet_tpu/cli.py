@@ -1834,6 +1834,7 @@ def cmd_serve(args) -> int:
             replicas=args.replicas, family=args.family, arm=args.arm,
             buckets=buckets, max_wait_ms=args.max_wait_ms,
             rate=args.rate, seconds=args.seconds,
+            controller=args.controller,
             log=lambda m: print(f"serve: {m}", file=sys.stderr))
         print(_json.dumps(
             {k: v for k, v in summary.items() if k != "per_replica"}))
@@ -1880,6 +1881,7 @@ def cmd_loop(args) -> int:
         family=args.family, arm=args.arm, buckets=buckets,
         width=args.width, tau=args.tau, requests=args.requests,
         max_wait_ms=args.max_wait_ms, workdir=args.workdir or None,
+        controller=args.controller,
         log=lambda m: print(f"loop: {m}", file=sys.stderr))
     print(_json.dumps(summary))
     return 0 if summary["ok"] else 1
@@ -2225,6 +2227,10 @@ def main(argv=None) -> int:
                     help="pod mode: offered open-loop req/s")
     sp.add_argument("--seconds", type=float, default=1.0,
                     help="pod mode: open-loop run length")
+    sp.add_argument("--controller", action="store_true",
+                    help="pod mode: arm the SLO burn controller "
+                         "(loop/autoctl.py — priced join/kill off the "
+                         "live burn stream; docs/CONTROL.md)")
     sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser(
@@ -2248,6 +2254,10 @@ def main(argv=None) -> int:
     sp.add_argument("--max-wait-ms", type=float, default=5.0)
     sp.add_argument("--workdir", default="",
                     help="checkpoint dir (default: a temp dir)")
+    sp.add_argument("--controller", action="store_true",
+                    help="arm the SLO burn controller (loop/autoctl.py "
+                         "— lend/restore training width + canary "
+                         "rollback; docs/CONTROL.md)")
     sp.set_defaults(fn=cmd_loop)
 
     sp = sub.add_parser("device_query", help="show devices")

@@ -146,6 +146,11 @@ class ProductionLoop:
                                 self.serve_name, info["version"]),
                             "parent": ckpt_span},
                    wall_s=round(time.perf_counter() - t0, 6))
+        # the candidate build + swap AOT-compiled on purpose — fold
+        # those into the by-design ledger so the next training round's
+        # record does not claim them as unexpected recompiles
+        from sparknet_tpu.obs.recorder import get_recorder
+        get_recorder().absorb_compiles("deploy")
         return info
 
     def rollback(self):

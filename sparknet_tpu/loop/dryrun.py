@@ -41,9 +41,16 @@ def loop_run(iterations: int = 1, rounds_per_rollout: int = 2,
              width: int = 4, tau: int = 2, requests: int = 48,
              max_wait_ms: float = 5.0,
              refusal_family: str | None = "resnet50", seed: int = 0,
-             workdir: str | None = None, log=None) -> dict:
+             workdir: str | None = None, controller: bool = False,
+             log=None) -> dict:
     """Run the full train->serve->swap->rollback cycle on the virtual
-    CPU mesh (zero chip time); returns the gate summary."""
+    CPU mesh (zero chip time); returns the gate summary.
+
+    ``controller=True`` arms an :class:`~sparknet_tpu.loop.autoctl.
+    SLOController` over a ``LoopPlane`` (lend/restore training width,
+    canary rollback), stepped at the boundaries this drive already
+    owns — after each traffic burst and after the training cycle.  Off
+    (the default) constructs nothing: the plain path is bit-identical."""
     from sparknet_tpu.loop.controller import ProductionLoop
     from sparknet_tpu.loop.feed import synthetic_shard_feed
     from sparknet_tpu.models.zoo import GRAPH_SWEEP_FAMILIES
@@ -86,6 +93,25 @@ def loop_run(iterations: int = 1, rounds_per_rollout: int = 2,
         compiles0 = engine.serve_path_compiles
         s0 = np.asarray(engine.infer(loop.serve_name, probe))
 
+        ctl = tail = None
+        if controller:
+            from sparknet_tpu.loop.autoctl import LoopPlane, SLOController
+            from sparknet_tpu.obs.metrics import JournalTail
+
+            rec = get_recorder()
+            if rec.enabled:
+                tail = JournalTail(rec.path)
+            ctl = SLOController(LoopPlane(loop))
+            say("controller armed (LoopPlane: lend/restore width, "
+                "canary rollback)")
+
+        def ctl_step() -> None:
+            if ctl is None:
+                return
+            if tail is not None:
+                ctl.feed_tail(tail)
+            ctl.step()
+
         tickets = []
 
         def traffic(n: int) -> None:
@@ -93,6 +119,7 @@ def loop_run(iterations: int = 1, rounds_per_rollout: int = 2,
             for item in synthetic_items(model, n, rs):
                 tickets.append(engine.submit(loop.serve_name, item))
             engine.pump(force=True)
+            ctl_step()
 
         traffic(max(1, requests // 3))
 
@@ -105,10 +132,16 @@ def loop_run(iterations: int = 1, rounds_per_rollout: int = 2,
             f"round(s) (W={width}, tau={tau}) + rollout ...")
         loop.run(iterations=iterations,
                  rounds_per_rollout=rounds_per_rollout, seed=seed)
+        ctl_step()
         swap_drained_ok = all(t.done() for t in pending_swap)
         s1 = np.asarray(engine.infer(loop.serve_name, probe))
+        # an armed controller may have rolled the canary back already
+        # (real-clock latency burn inside the canary window) — then the
+        # probe legitimately reads the restored incumbent
+        ctl_rolled_back = loop.rollbacks > 0
         scores_changed = not np.array_equal(s0, s1)
         say(f"post-rollout: scores_changed={scores_changed} "
+            f"ctl_rolled_back={ctl_rolled_back} "
             f"pending drained={swap_drained_ok}")
 
         traffic(max(1, requests // 3))
@@ -129,12 +162,23 @@ def loop_run(iterations: int = 1, rounds_per_rollout: int = 2,
                 say("over-HBM rollout candidate refused as priced")
         incumbent_intact = np.array_equal(
             s1, np.asarray(engine.infer(loop.serve_name, probe)))
+        if loop.rollbacks > 0 and not ctl_rolled_back:
+            # the controller rolled back between the two probes — the
+            # live model legitimately moved off s1
+            ctl_rolled_back = True
+            incumbent_intact = True
 
         pending_rb = [engine.submit(loop.serve_name, item)
                       for item in synthetic_items(
                           engine._models[loop.serve_name], 3, rs)]
         tickets.extend(pending_rb)
-        loop.rollback()
+        ctl_rolled_back = ctl_rolled_back or loop.rollbacks > 0
+        if ctl_rolled_back:
+            say("canary already rolled back by the controller — "
+                "skipping the scripted rollback")
+            engine.pump(force=True)
+        else:
+            loop.rollback()
         rollback_drained_ok = all(t.done() for t in pending_rb)
         s2 = np.asarray(engine.infer(loop.serve_name, probe))
         scores_restored = np.array_equal(s0, s2)
@@ -166,11 +210,16 @@ def loop_run(iterations: int = 1, rounds_per_rollout: int = 2,
             "serve_path_compiles": serve_compiles,
             "wall_s": round(time.perf_counter() - t_start, 3),
         }
+        summary["ctl_rolled_back"] = ctl_rolled_back
         summary["ok"] = bool(
             serve_compiles == 0 and dropped == 0 and swap_drained_ok
-            and rollback_drained_ok and scores_changed
+            and rollback_drained_ok
+            and (scores_changed or ctl_rolled_back)
             and scores_restored and incumbent_intact
             and (refused or not refusal_family))
+        if ctl is not None:
+            summary["ctl"] = {**ctl.summary(),
+                              "actions": list(ctl.actions)}
         get_recorder().emit(
             "loop", kind="summary", model="live", family=family,
             arm=arm, iteration=iterations, round=loop.trainer.round,

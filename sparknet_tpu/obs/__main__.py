@@ -40,7 +40,13 @@
   re-routed, zero dropped), holds queue p99 inside max_wait + one pump
   tick on a steady no-fault leg, survives live join/kill/swap with
   zero serving-path compiles, and pins continuous-batching exactness;
-  exit 1 unless every gate holds.  Render with ``report``.
+  exit 1 unless every gate holds.  ``--ctl`` replays the four
+  control-plane scenarios (tools/ctl_scenarios.py) through the
+  SLOController on virtual time: action traces diffed against the
+  banked ``docs/ctl_contracts/`` manifests, controller-vs-bare A/B
+  (the bare arm must burn ≥ 1 gate per scenario, the controlled arm
+  must hold every gate with zero drops); exit 1 on any divergence —
+  zero chip time, and no jax import at all.  Render with ``report``.
 """
 
 from __future__ import annotations
@@ -180,10 +186,15 @@ def top_main(argv: list[str]) -> int:
 
     from sparknet_tpu.obs import metrics as obs_metrics
 
+    from collections import deque
+
     tail = obs_metrics.JournalTail(args.journal)
     # fold-only hub: the flush clock never fires (top reads state
     # directly; it must not mint metrics events for someone's journal)
     hub = obs_metrics.MetricsHub(flush_every=1 << 62)
+    # the live ctl decision stream: last few decide/act/cooldown lines
+    # verbatim (the counters say how many; these say WHAT)
+    ctl_recent: deque = deque(maxlen=5)
     folded = 0
     frames = 0
     try:
@@ -193,8 +204,12 @@ def top_main(argv: list[str]) -> int:
                 if isinstance(kind, str):
                     hub.observe_event(kind, ev)
                     folded += 1
+                    if kind == "ctl" and ev.get("kind") in (
+                            "decide", "act", "cooldown"):
+                        ctl_recent.append(ev)
             frames += 1
-            print(_top_frame(args.journal, folded, hub), flush=True)
+            print(_top_frame(args.journal, folded, hub, ctl_recent),
+                  flush=True)
             if args.once or (args.frames and frames >= args.frames):
                 return 0
             time.sleep(args.interval)
@@ -202,7 +217,7 @@ def top_main(argv: list[str]) -> int:
         return 0
 
 
-def _top_frame(path: str, folded: int, hub) -> str:
+def _top_frame(path: str, folded: int, hub, ctl_recent=()) -> str:
     from sparknet_tpu.obs import metrics as obs_metrics
 
     lines = [f"== obs top {path} — {folded} event(s) folded =="]
@@ -218,6 +233,15 @@ def _top_frame(path: str, folded: int, hub) -> str:
         lines.append(
             f"  {name}: n={snap['count']} p50={p50:.3f} "
             f"p99={p99:.3f} max={snap['max']:.3f}")
+    if ctl_recent:
+        lines.append("  -- ctl decisions (most recent last) --")
+        for ev in ctl_recent:
+            t = ev.get("t")
+            bits = [f"t={t:g}" if isinstance(t, (int, float)) else None,
+                    ev.get("action"), ev.get("gate"),
+                    ev.get("reason") or ev.get("note")]
+            lines.append(f"  ctl/{ev.get('kind', '?')}: "
+                         + " ".join(b for b in bits if b))
     if len(lines) == 1:
         lines.append("  (no metric-bearing events yet)")
     return "\n".join(lines)
@@ -299,6 +323,44 @@ def _chaos_gate() -> int:
     return 0
 
 
+def _ctl_dryrun(out: str) -> int:
+    """Dryrun mode 21's CLI surface: full scenario replay + banked
+    trace diff, then the four CONTROLLED journals concatenated into
+    ``out`` — the bankable specimen.  Bare-arm journals burn their
+    gates BY DESIGN and stay in the tmp dir: they must never land next
+    to banked evidence, where every journal is required to pass the
+    SLO manifest."""
+    import importlib.util
+    import tempfile
+
+    path = os.path.join(_REPO, "tools", "ctl_scenarios.py")
+    spec = importlib.util.spec_from_file_location("ctl_scenarios", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    tmp = tempfile.mkdtemp(prefix="ctl_dryrun_")
+    summary = mod.replay(
+        update=False, journal_dir=tmp,
+        log=lambda m: print(f"obs dryrun [ctl]: {m}", file=sys.stderr))
+    out_dir = os.path.dirname(os.path.abspath(out))
+    os.makedirs(out_dir, exist_ok=True)
+    with open(out, "w", encoding="utf-8") as dst:
+        for record in summary["scenarios"]:
+            with open(record["controlled"]["journal"],
+                      encoding="utf-8") as src:
+                dst.write(src.read())
+    acted = sum(len(r["controlled"]["actions"])
+                for r in summary["scenarios"])
+    print(f"obs dryrun [ctl]: {len(summary['scenarios'])} scenario(s), "
+          f"{acted} controller action(s), traces "
+          f"{'MATCH' if summary['ok'] else 'DIVERGED'} vs "
+          "docs/ctl_contracts/ (bare arms burned, controlled arms "
+          "held, zero drops)")
+    print(f"obs dryrun: journal at {out} — render with "
+          f"`python -m sparknet_tpu.obs report {out}`")
+    gates = _dryrun_gates(out)
+    return 0 if summary["ok"] and gates == 0 else 1
+
+
 def dryrun_main(argv: list[str]) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m sparknet_tpu.obs dryrun",
@@ -344,7 +406,21 @@ def dryrun_main(argv: list[str]) -> int:
         "pass — still zero chip time")
     ap.add_argument("--replicas", type=int, default=4,
                     help="pool width for --replica (default 4)")
+    ap.add_argument(
+        "--ctl", action="store_true",
+        help="replay the four control-plane scenarios "
+        "(tools/ctl_scenarios.py) INSTEAD of the training legs: "
+        "deterministic virtual-time traffic through the SLOController, "
+        "action traces diffed against docs/ctl_contracts/, and the "
+        "controller-vs-bare A/B (bare must burn, controlled must hold "
+        "with zero drops); exit 1 on any divergence — zero chip time, "
+        "no jax import")
     args = ap.parse_args(argv)
+
+    if args.ctl:
+        # pure host-side sim: no backend, no mesh, no Recorder here —
+        # the harness arms one Recorder per scenario arm itself
+        return _ctl_dryrun(args.out)
 
     # pin the CPU platform via the config route (the env var alone does
     # not win against the site hook) and force the virtual device count

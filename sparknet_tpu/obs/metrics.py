@@ -232,6 +232,22 @@ class MetricsHub:
                 value = fields.get(field)
                 if isinstance(value, (int, float)):
                     self.inc(f"{event}/{field}", value)
+        elif event == "ctl":
+            # control-plane stream (obs/burn.py + loop/autoctl.py):
+            # count each lifecycle kind; observe events also carry the
+            # per-gate burn rates, folded as gauges so `obs top` can
+            # render live burn dials without replaying the journal
+            kind = fields.get("kind", "?")
+            self.inc(f"ctl/{kind}")
+            if kind == "observe":
+                for gate in fields.get("gates") or ():
+                    if not isinstance(gate, dict):
+                        continue
+                    gid = gate.get("id", "?")
+                    for win in ("fast", "slow"):
+                        rate = gate.get(win)
+                        if isinstance(rate, (int, float)):
+                            self.set_gauge(f"ctl/burn/{gid}/{win}", rate)
         self._dirty = True
         self._since_flush += 1
         if self._since_flush >= self.flush_every:
@@ -260,10 +276,14 @@ class MetricsHub:
 
 
 class JournalTail:
-    """Incremental reader for a GROWING journal (``obs top``): each
-    :meth:`poll` parses only the complete lines appended since the last
-    call, never re-reading the file.  Torn trailing lines (a writer
-    mid-append) are left for the next poll."""
+    """Incremental reader for a GROWING journal (``obs top``, and the
+    burn engine mid-run): each :meth:`poll` parses only the complete
+    lines appended since the last call, never re-reading the file.
+    Torn trailing lines (a writer mid-append) are left for the next
+    poll.  A journal that SHRINKS between polls (rotated or truncated
+    by a fresh run re-arming the same path) resets the cursor to 0 and
+    re-reads from the top — the old cursor would otherwise sit past
+    EOF and read empty forever."""
 
     def __init__(self, path: str):
         self.path = path
@@ -272,6 +292,9 @@ class JournalTail:
     def poll(self) -> Iterator[dict]:
         try:
             with open(self.path, encoding="utf-8") as f:
+                f.seek(0, 2)
+                if f.tell() < self._pos:
+                    self._pos = 0  # rotated/truncated underneath us
                 f.seek(self._pos)
                 chunk = f.read()
         except OSError:

@@ -257,6 +257,26 @@ class Recorder:
         self._n_rounds += 1
         self.emit("round", **fields)
 
+    def absorb_compiles(self, where: str) -> int:
+        """Fold backend compiles since the last round record into the
+        by-design ledger: a deploy-arm candidate build / AOT warmup
+        between training rounds compiles on purpose, and without this
+        resync the NEXT round's record would claim those compiles as
+        its own unexpected recompiles (the compiles-zero SLO gate and
+        the streaming burn engine would both count a phantom burn).
+        Journals the delta as an ``expected`` recompile event so the
+        compile ledger stays complete; returns the delta."""
+        if not self.enabled:
+            return 0
+        total = self.sentinel.count
+        n = total - self._last_compiles
+        self._last_compiles = total
+        if n > 0:
+            self.emit("recompile", count=n,
+                      total=total - self._compiles0, where=where,
+                      expected=True)
+        return n
+
     def bench(self, record: dict, *, wall_s: float | None = None,
               fence_value: float | None = None,
               fenced: bool = False) -> None:

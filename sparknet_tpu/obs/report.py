@@ -391,15 +391,68 @@ def _metrics_lines(ev: dict) -> list[str]:
 
 def _slo_lines(ev: dict) -> list[str]:
     """One SLO verdict (obs/slo.py, journaled by the window runner):
-    which gates were applicable, and the burn list when any failed."""
+    which gates were applicable, the burn list when any failed, and
+    which greens passed VACUOUSLY (zero subject events) — a reader
+    citing this verdict as evidence must see which gates never
+    measured anything."""
     burned = ev.get("burned") or []
+    vacuous = ev.get("vacuous") or []
     verdict = "PASS" if ev.get("ok") else "**BURNED**"
     detail = ("" if not burned
               else " — burned: " + ", ".join(f"`{b}`" for b in burned))
+    if vacuous:
+        detail += (" — vacuous (no subject events): "
+                   + ", ".join(f"`{v}`" for v in vacuous))
     src = f" over `{ev.get('journal')}`" if ev.get("journal") else ""
     return [f"- SLO {verdict} `{ev.get('job', '?')}`: "
             f"{ev.get('applicable', 0)}/{ev.get('gates', 0)} gate(s) "
             f"applicable{src}{detail}"]
+
+
+def _ctl_lines(ctls: list[dict]) -> list[str]:
+    """The control-plane stream (obs/burn.py + loop/autoctl.py "ctl"
+    events): one roll-up line for the observe cadence, then every
+    decide / act / cooldown / summary verbatim enough to replay the
+    controller's reasoning from the report alone."""
+    lines = []
+    observes = [ev for ev in ctls if ev.get("kind") == "observe"]
+    if observes:
+        burn_steps = sum(1 for ev in observes if ev.get("burning"))
+        lines.append(
+            f"- {len(observes)} burn evaluation(s) folded "
+            f"({burn_steps} saw ≥1 gate burning — per-gate fast/slow "
+            "rates live in the streaming-metrics ctl/burn gauges)")
+    for ev in ctls:
+        kind = ev.get("kind", "?")
+        t = ev.get("t")
+        at = f"t={t:g}s " if isinstance(t, (int, float)) else ""
+        if kind == "decide":
+            lines.append(
+                f"- {at}decide `{ev.get('action', '?')}` on gate "
+                f"`{ev.get('gate', '?')}` — {ev.get('reason', '?')}")
+        elif kind == "act":
+            bits = [f"{key}={ev[key]}" for key in
+                    ("replica", "width", "from_width", "to_width",
+                     "count", "round", "version") if key in ev]
+            extra = f" ({', '.join(bits)})" if bits else ""
+            lines.append(
+                f"- {at}**ACT** `{ev.get('action', '?')}`{extra}")
+        elif kind == "cooldown":
+            lines.append(
+                f"- {at}cooldown: decision on `{ev.get('gate', '?')}` "
+                f"suppressed for {ev.get('cooldown_s', 0):g} s more")
+        elif kind == "summary":
+            lines.append(
+                f"- summary: {ev.get('observes', 0)} observe(s), "
+                f"{ev.get('decides', 0)} decide(s), "
+                f"{ev.get('acts', 0)} act(s), "
+                f"{ev.get('cooldowns', 0)} cooldown(s), "
+                f"{ev.get('refused', 0)} refused join(s); burning at "
+                f"close: {ev.get('burning') or 'none'}")
+        elif kind != "observe":
+            note = ev.get("note")
+            lines.append(f"- {at}{kind}" + (f" — {note}" if note else ""))
+    return lines
 
 
 def _runner_lines(events: list[dict]) -> list[str]:
@@ -650,7 +703,7 @@ def render(events: Iterable[dict], source: str = "journal",
                               "member": [], "feed": [], "recompile": [],
                               "bench": [], "bank": [], "end": [],
                               "serve": [], "loop": [], "metrics": [],
-                              "replica": []}
+                              "replica": [], "ctl": []}
         if kind == "request":
             agg = request_aggs.get(run_id)
             if agg is None:
@@ -710,6 +763,9 @@ def render(events: Iterable[dict], source: str = "journal",
         if group["replica"]:
             lines += ["", "### replica pool (pod-scale serving)", ""]
             lines += _replica_lines(group["replica"])
+        if group["ctl"]:
+            lines += ["", "### control plane (burn → action)", ""]
+            lines += _ctl_lines(group["ctl"])
         if run_id in request_aggs:
             lines += ["", "### request latency (p50/p99 per model × "
                           "bucket)", ""]

@@ -96,7 +96,8 @@ EVENTS: dict[str, tuple[dict, dict]] = {
     # journal (the rest pass vacuously), ``burned`` the failing gate ids
     "slo": (
         {"job": str, "ok": bool, "gates": int, "applicable": int},
-        {"burned": list, "journal": str, "manifest": str, "note": str},
+        {"burned": list, "vacuous": list, "journal": str,
+         "manifest": str, "note": str},
     ),
     "runner_done": ({"reason": str}, {"blocked_jobs": list}),
     # one survival-policy scheduling decision (tools/window_policy.py;
@@ -271,6 +272,28 @@ EVENTS: dict[str, tuple[dict, dict]] = {
          "loss": _NUM, "wall_s": _NUM, "drained": int, "requests": int,
          "compiles": int, "rollouts": int, "rollbacks": int,
          "checkpoints": int, "note": str, "lineage": dict},
+    ),
+    # -- control plane (sparknet_tpu/loop/autoctl.py + obs/burn.py) -----
+    # one burn-engine / SLOController lifecycle event, discriminated by
+    # ``kind``: observe (a multi-window burn evaluation — ``gates`` is
+    # the per-gate list of {id, fast, slow, burning, suspended} dicts) /
+    # decide (a proposed action with its triggering gate + burn rates) /
+    # act (the action EXECUTED through the control plane, with the
+    # width/replica/version outcome) / cooldown (a decision suppressed
+    # by hysteresis — at most one line per cooldown window) / summary
+    # (a controller-run roll-up).  ``t`` is the controller clock
+    # (virtual seconds in scenario replay, perf_counter live).
+    "ctl": (
+        {"run_id": str, "kind": str},
+        {"gate": str, "gates": list, "burning": list, "action": str,
+         "reason": str, "fast": _NUM, "slow": _NUM, "value": _NUM,
+         "bound": _NUM, "t": _NUM, "cooldown_s": _NUM, "scenario": str,
+         "replicas": int, "replica": int, "width": int,
+         "from_width": int, "to_width": int, "count": int, "round": int,
+         "fits": bool, "rerouted": int, "version": int, "ok": bool,
+         "observes": int, "decides": int, "acts": int, "cooldowns": int,
+         "refused": int, "predicted_bytes": int, "budget_bytes": int,
+         "note": str, "lineage": dict},
     ),
     # one served request's latency decomposition (the p50/p99 material):
     # queue_wait (submit -> flush) + batch_assembly (pad/fill) + device

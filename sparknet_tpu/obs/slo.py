@@ -248,6 +248,11 @@ def evaluate(events: Iterable[dict], manifest: dict) -> list[dict]:
                           "(manifest newer than evaluator?)"})
             continue
         applicable, ok, value, bound, detail = gate(spec, events)
+        if not applicable and ok:
+            # vacuous-pass visibility: a subject-free journal must not
+            # read identically to a measured green when cited as
+            # evidence (ISSUE 18 hygiene satellite)
+            detail = f"vacuous pass — {detail}"
         results.append({
             "id": spec.get("id", kind), "kind": kind, "ok": bool(ok),
             "applicable": bool(applicable), "value": value,
@@ -270,6 +275,8 @@ def verdict_fields(job: str, results: list[dict], *,
     """The ``slo`` journal event's fields for one evaluated job (the
     window runner writes this through schema.make_event)."""
     burned = [r["id"] for r in results if not r["ok"]]
+    vacuous = [r["id"] for r in results
+               if r["ok"] and not r["applicable"]]
     fields: dict = {
         "job": job,
         "ok": not burned,
@@ -278,6 +285,10 @@ def verdict_fields(job: str, results: list[dict], *,
     }
     if burned:
         fields["burned"] = burned
+    if vacuous:
+        # name the gates that passed with zero subject events so the
+        # verdict line itself says which greens are unmeasured
+        fields["vacuous"] = vacuous
     if journal:
         fields["journal"] = journal
     if manifest_path:

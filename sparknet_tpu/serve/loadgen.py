@@ -83,12 +83,18 @@ def pod_run(replicas: int = 2, family: str = "transformer",
             arm: str = "f32", buckets: tuple = (1, 8, 64),
             max_wait_ms: float = 25.0, rate: float = 2000.0,
             seconds: float = 1.0, seed: int = 0, chunk_s: float = 0.005,
-            log=None) -> dict:
+            controller: bool = False, log=None) -> dict:
     """Steady open-loop load through a K-replica pod (no fault plan —
     that is dryrun mode 20's job).  Backs ``tpunet serve --replicas K``:
     boots a ``ReplicaRouter``, warms every bucket on every replica,
     snapshots the recompile sentinel, then sprays a seeded Poisson
     schedule in ``chunk_s`` horizons with deadline shedding on.
+
+    ``controller=True`` arms an :class:`~sparknet_tpu.loop.autoctl.
+    SLOController` over a ``RouterPlane`` — stepped from THIS loop
+    (never a thread of its own), tailing the armed obs journal for the
+    request stream when ``SPARKNET_OBS`` is set.  Off (the default)
+    constructs nothing: the plain pod path is bit-identical.
 
     Returns the pod summary; ``compiles_post_warmup`` and ``dropped``
     are the gates (both must be 0)."""
@@ -110,6 +116,28 @@ def pod_run(replicas: int = 2, family: str = "transformer",
     rs = np.random.RandomState(seed)
     router.warmup(rs)
     compiles0 = sentinel.count
+
+    ctl = tail = None
+    if controller:
+        from sparknet_tpu.loop.autoctl import RouterPlane, SLOController
+        from sparknet_tpu.obs.metrics import JournalTail
+        from sparknet_tpu.obs.recorder import get_recorder
+
+        rec = get_recorder()
+        if rec.enabled:
+            tail = JournalTail(rec.path)
+        ctl = SLOController(RouterPlane(router, baseline=replicas))
+        say("controller armed (RouterPlane: priced join/kill"
+            + (", tailing the obs journal)" if tail is not None
+               else "; no journal armed — burn gates see only "
+                    "summaries)"))
+
+    def ctl_step() -> None:
+        if ctl is None:
+            return
+        if tail is not None:
+            ctl.feed_tail(tail)
+        ctl.step()
 
     schedule = open_loop_schedule(rate, seconds, seed=seed)
     say(f"traffic: {len(schedule)} open-loop arrival(s) at "
@@ -136,17 +164,21 @@ def pod_run(replicas: int = 2, family: str = "transformer",
             i = j
         else:
             time.sleep(min(chunk_s, schedule[i] - now))
+        ctl_step()
     for t in tickets:
         t.wait(timeout=60.0)
     wall_s = time.perf_counter() - t0
     stop.set()
     pump.join(timeout=5.0)
     router.pump(force=True)
+    ctl_step()
     summary = router.emit_summary(wall_s)
     summary["offered"] = len(schedule)
     summary["admitted"] = len(tickets)
     summary["compiles_post_warmup"] = sentinel.count - compiles0
     summary["wall_s"] = round(wall_s, 3)
+    if ctl is not None:
+        summary["ctl"] = {**ctl.summary(), "actions": list(ctl.actions)}
     router.shutdown()
     return summary
 

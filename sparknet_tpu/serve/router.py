@@ -198,6 +198,14 @@ class ReplicaRouter:
     def width(self) -> int:
         return len(self._replicas)
 
+    def free_devices(self) -> int:
+        """Pool devices not currently hosting a replica (the
+        SLOController's can-grow preview — loop/autoctl.py asks this
+        before pricing a join, so an exhausted pool is a decision
+        input, not a boot-time RuntimeError)."""
+        with self._lock:
+            return len(self._device_pool) - len(self._replicas)
+
     def kill_replica(self, rid: int) -> int:
         """A replica dies: steal its pending tickets and adopt them
         onto the least-loaded survivor (zero dropped — the SAME Ticket

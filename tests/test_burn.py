@@ -1,0 +1,280 @@
+"""Streaming burn-rate engine (sparknet_tpu/obs/burn.py): the
+multi-window trip/clear contract on synthetic event streams.
+
+The engine's CLAIMS: a bounded gate trips only when BOTH windows sit
+over the level (fast catches the spike, slow proves it is not a blip);
+clearing is asymmetric — the FAST window alone proves recovery, so the
+slow window's 30 s memory cannot latch the alarm past the drained
+backlog; disturbances suspend only the latency gate and EXPIRE; the
+zero-tolerance ledgers burn on any in-window occurrence.  Every test
+drives virtual time through the injectable clock — no sleeps, no wall
+clock, smoke-tier.
+
+Also pins the JournalTail rotation/truncation contract the live
+``feed_tail`` path leans on (torn-tail-then-append is pinned in
+tests/test_obs_metrics.py).
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from sparknet_tpu.obs import schema
+from sparknet_tpu.obs.burn import (
+    DEFAULT_CLEAR_RATIO,
+    BurnEngine,
+    GateState,
+    _Window,
+    _p99,
+)
+from sparknet_tpu.obs.metrics import JournalTail
+
+pytestmark = pytest.mark.smoke
+
+
+def _manifest(*specs) -> dict:
+    return {"version": 1, "slos": list(specs)}
+
+
+_P99_GATE = {"id": "warm-queue-p99", "kind": "warm_queue_p99",
+             "max_ms": 40.0, "warmup_requests": 0}
+_DROP_GATE = {"id": "zero-drop", "kind": "dropped_zero"}
+
+
+def _engine(*specs, fast_s=1.0, slow_s=30.0, suspend_s=5.0):
+    return BurnEngine(_manifest(*specs), fast_s=fast_s, slow_s=slow_s,
+                      suspend_s=suspend_s, clock=lambda: 0.0)
+
+
+def _request(wait_ms: float) -> dict:
+    return {"model": "m", "bucket": 8, "queue_wait_ms": wait_ms}
+
+
+def _state(engine: BurnEngine, gate_id: str):
+    return next(g for g in engine.gates if g.gate_id == gate_id)
+
+
+# -- window mechanics -------------------------------------------------------
+
+
+def test_window_prunes_by_duration():
+    w = _Window(1.0)
+    w.add(0.0, 1.0)
+    w.add(0.5, 2.0)
+    w.add(1.2, 3.0)
+    assert w.values(1.3) == [2.0, 3.0]  # 0.0 aged out of [0.3, 1.3]
+    assert w.total(2.5) == 0.0
+
+
+def test_p99_nearest_rank_small_and_large():
+    assert _p99([7.0]) == 7.0
+    assert _p99([1.0, 2.0, 3.0, 4.0]) == 4.0
+    big = [float(i) for i in range(1, 201)]
+    assert _p99(big) == 198.0  # rank round(0.99*200) = 198
+
+
+# -- trip: both windows must burn -------------------------------------------
+
+
+def test_fast_spike_alone_does_not_trip():
+    eng = _engine(_P99_GATE)
+    # long healthy history fills the slow window under the bound
+    for i in range(60):
+        eng.observe("request", _request(10.0), t=i * 0.5)
+    # one fast-window spike: fast > 1.0 but slow p99 still healthy
+    eng.observe("request", _request(500.0), t=30.0)
+    [res] = eng.evaluate(30.1)
+    assert res["fast"] > 1.0
+    assert res["slow"] <= 1.0
+    assert not res["burning"]
+
+
+def test_sustained_breach_trips_both_windows():
+    eng = _engine(_P99_GATE)
+    for i in range(40):
+        eng.observe("request", _request(90.0), t=i * 0.1)
+    [res] = eng.evaluate(4.0)
+    assert res["fast"] > 1.0 and res["slow"] > 1.0
+    assert res["burning"]
+    assert eng.burning(4.0) == ["warm-queue-p99"]
+
+
+# -- clear: fast window alone, with hysteresis ------------------------------
+
+
+def test_clear_on_fast_window_only():
+    eng = _engine(_P99_GATE)
+    for i in range(40):
+        eng.observe("request", _request(90.0), t=i * 0.1)
+    assert eng.burning(4.0) == ["warm-queue-p99"]
+    # recovery: fast window fills with healthy waits; the slow window
+    # STILL holds the 90 ms burn era (its p99 stays over the level)
+    for i in range(20):
+        eng.observe("request", _request(5.0), t=4.1 + i * 0.05)
+    [res] = eng.evaluate(5.2)
+    assert res["slow"] > 1.0  # the 30 s memory has not forgotten
+    assert res["fast"] <= DEFAULT_CLEAR_RATIO
+    assert not res["burning"]  # ... and yet the alarm clears
+
+
+def test_clear_needs_hysteresis_margin():
+    eng = _engine(_P99_GATE)
+    for i in range(40):
+        eng.observe("request", _request(90.0), t=i * 0.1)
+    assert eng.burning(4.0) == ["warm-queue-p99"]
+    # fast p99 drops to 0.95x the level: under trip, but NOT under the
+    # 0.9 clear ratio — the latch must hold
+    for i in range(20):
+        eng.observe("request", _request(38.0), t=4.1 + i * 0.05)
+    [res] = eng.evaluate(5.2)
+    assert DEFAULT_CLEAR_RATIO < res["fast"] <= 1.0
+    assert res["burning"]
+
+
+def test_empty_fast_window_clears_a_latched_gate():
+    eng = _engine(_P99_GATE)
+    for i in range(40):
+        eng.observe("request", _request(90.0), t=i * 0.1)
+    assert eng.burning(4.0) == ["warm-queue-p99"]
+    # traffic stops entirely: the fast window empties — no evidence of
+    # continued burn means the alarm releases
+    [res] = eng.evaluate(10.0)
+    assert res["fast"] is None
+    assert not res["burning"]
+
+
+# -- disturbance suspension -------------------------------------------------
+
+
+def test_disturbance_suspends_latency_gate_then_expires():
+    eng = _engine(_P99_GATE, _DROP_GATE, suspend_s=5.0)
+    for i in range(40):
+        eng.observe("request", _request(90.0), t=i * 0.1)
+    assert eng.burning(4.0) == ["warm-queue-p99"]
+    # a replica join lands: elevated waits are by design for suspend_s
+    eng.observe("replica", {"kind": "replica_up"}, t=4.5)
+    res = {r["id"]: r for r in eng.evaluate(4.6)}
+    assert res["warm-queue-p99"]["suspended"]
+    assert not res["warm-queue-p99"]["burning"]
+    # ... but suspension EXPIRES: the breach persists past the settle
+    # window and the gate re-arms
+    for i in range(40):
+        eng.observe("request", _request(90.0), t=9.6 + i * 0.01)
+    res = {r["id"]: r for r in eng.evaluate(10.1)}
+    assert not res["warm-queue-p99"]["suspended"]
+    assert res["warm-queue-p99"]["burning"]
+
+
+def test_suspension_does_not_cover_zero_bound_gates():
+    eng = _engine(_P99_GATE, _DROP_GATE)
+    eng.observe("replica", {"kind": "replica_up"}, t=0.0)
+    eng.observe("replica", {"kind": "summary", "dropped": 3}, t=0.1)
+    assert eng.burning(0.2) == ["zero-drop"]
+
+
+# -- zero-tolerance immediacy -----------------------------------------------
+
+
+def test_dropped_burns_on_single_occurrence():
+    eng = _engine(_DROP_GATE)
+    [res] = eng.evaluate(0.0)
+    assert not res["burning"]  # applicable by absence: quiet is healthy
+    eng.observe("serve", {"kind": "summary", "dropped": 1}, t=0.5)
+    assert eng.burning(0.6) == ["zero-drop"]
+    # the occurrence ages out of BOTH windows -> clears
+    assert eng.burning(100.0) == []
+
+
+def test_unexpected_recompile_burns_compiles_gate():
+    eng = _engine({"id": "post-warmup-compiles", "kind": "compiles_zero"})
+    eng.observe("recompile", {"expected": True, "count": 1}, t=0.0)
+    assert eng.burning(0.1) == []  # expected compiles are by design
+    eng.observe("recompile", {"expected": False, "count": 1}, t=0.2)
+    assert eng.burning(0.3) == ["post-warmup-compiles"]
+
+
+def test_warmup_requests_skipped_per_model_bucket():
+    spec = dict(_P99_GATE, warmup_requests=2)
+    eng = _engine(spec)
+    state = _state(eng, "warm-queue-p99")
+    for i in range(2):  # warmup: never folded
+        eng.observe("request", _request(900.0), t=i * 0.1)
+    assert state.fast.values(0.2) == []
+    eng.observe("request", _request(900.0), t=0.3)  # first counted
+    assert state.fast.values(0.4) == [900.0]
+
+
+# -- feed / feed_tail -------------------------------------------------------
+
+
+def test_feed_tail_folds_journal_lines(tmp_path):
+    path = tmp_path / "j.jsonl"
+    with open(path, "w") as f:
+        for _ in range(12):
+            f.write(json.dumps({"event": "serve", "kind": "summary",
+                                "dropped": 1}) + "\n")
+    eng = _engine(_DROP_GATE)
+    assert eng.feed_tail(JournalTail(str(path)), t=1.0) == 12
+    assert eng.burning(1.1) == ["zero-drop"]
+
+
+def test_journal_tail_truncation_resets_cursor(tmp_path):
+    path = tmp_path / "j.jsonl"
+    with open(path, "w") as f:
+        for i in range(5):
+            f.write(json.dumps({"event": "a", "i": i}) + "\n")
+    tail = JournalTail(str(path))
+    assert len(list(tail.poll())) == 5
+    # a fresh run re-arms the same path: the file SHRINKS underneath
+    # the tail — the cursor must reset to 0 and re-read from the top
+    with open(path, "w") as f:
+        f.write(json.dumps({"event": "b"}) + "\n")
+    got = [ev["event"] for ev in tail.poll()]
+    assert got == ["b"]
+
+
+def test_journal_tail_rotation_replaced_file(tmp_path):
+    path = tmp_path / "j.jsonl"
+    with open(path, "w") as f:
+        for i in range(3):
+            f.write(json.dumps({"event": "old", "i": i}) + "\n")
+    tail = JournalTail(str(path))
+    assert len(list(tail.poll())) == 3
+    # rotate: the path is replaced by a shorter successor file
+    rotated = tmp_path / "j.jsonl.1"
+    path.rename(rotated)
+    with open(path, "w") as f:
+        f.write(json.dumps({"event": "new"}) + "\n")
+    assert [ev["event"] for ev in tail.poll()] == ["new"]
+
+
+# -- the ctl event family is schema-valid -----------------------------------
+
+
+def test_ctl_events_validate():
+    for fields in (
+        {"kind": "observe", "t": 1.0, "gates": [], "burning": []},
+        {"kind": "decide", "t": 1.0, "gate": "warm-queue-p99",
+         "action": "join_replica", "reason": "why", "fast": 1.2,
+         "slow": 1.1},
+        {"kind": "act", "t": 1.0, "action": "join_replica",
+         "replica": 2, "width": 3, "fits": True},
+        {"kind": "act", "t": 2.0, "action": "lend_width",
+         "from_width": 6, "to_width": 5, "count": 1, "round": 8},
+        {"kind": "cooldown", "t": 1.0, "gate": "warm-queue-p99",
+         "cooldown_s": 2.5, "note": "suppressed"},
+        {"kind": "summary", "t": 9.0, "ok": True, "observes": 4,
+         "decides": 1, "acts": 1, "cooldowns": 0, "refused": 0,
+         "burning": []},
+    ):
+        line = schema.make_event("ctl", run_id="t", **fields)
+        assert schema.validate_line(line) == [], fields
+
+
+def test_gate_state_rejects_nothing_silently():
+    # an event the gate does not subscribe to must not perturb state
+    g = GateState(dict(_P99_GATE), 1.0, 30.0)
+    g.fold("feed", {"stages": {"slot_wait": 1.0}}, 0.0)
+    assert g.fast.values(0.1) == []

@@ -1,0 +1,334 @@
+"""Telemetry control plane (loop/autoctl.py + tools/ctl_scenarios.py):
+the decision ladder on a fake plane, the four banked scenario A/Bs,
+and the rendering surfaces (report / top / slo vacuous visibility).
+
+The controller's CLAIMS: burn answers with the cheapest reversible
+move (canary rollback > priced join > width loan), every action is
+separated by a cooldown, a priced refusal journals instead of booting,
+release is patient (healthy_s before any give-back, replicas before
+width), and the whole ladder replays bit-identically against the
+traces banked in docs/ctl_contracts/.  Virtual time throughout — no
+sleeps, no jax, smoke-tier.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from sparknet_tpu.loop.autoctl import SLOController
+from sparknet_tpu.obs import schema
+from sparknet_tpu.obs import slo as _slo
+from sparknet_tpu.obs.report import render_path
+
+pytestmark = pytest.mark.smoke
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_MANIFEST = {"version": 1, "slos": [
+    {"id": "warm-queue-p99", "kind": "warm_queue_p99", "max_ms": 40.0,
+     "warmup_requests": 0},
+    {"id": "zero-drop", "kind": "dropped_zero"},
+]}
+
+
+class FakePlane:
+    """Duck-typed control plane with programmable capacity."""
+
+    def __init__(self, free=1, fits=True, lendable=0, rollback_ok=False):
+        self.width = 2
+        self.free = free
+        self.fits = fits
+        self.lendable = lendable
+        self.rollback_ok = rollback_ok
+        self.calls = []
+
+    def serve_width(self):
+        return self.width
+
+    def can_grow(self):
+        if self.free <= 0:
+            return None
+        return {"fits": self.fits, "predicted_bytes": 640,
+                "budget_bytes": 1300}
+
+    def grow(self):
+        self.calls.append("grow")
+        self.free -= 1
+        self.width += 1
+        return {"replica": self.width - 1, "width": self.width}
+
+    def shrink(self):
+        self.calls.append("shrink")
+        self.width -= 1
+        self.free += 1
+        return {"replica": self.width, "width": self.width, "rerouted": 0}
+
+    def can_lend(self):
+        return self.lendable > 0
+
+    def lend(self):
+        self.calls.append("lend")
+        self.lendable -= 1
+        return {"count": 1, "from_width": 4, "to_width": 3, "round": 2}
+
+    def restore(self):
+        self.calls.append("restore")
+        return {"count": 1, "from_width": 3, "to_width": 4, "round": 5}
+
+    def rollback(self):
+        self.calls.append("rollback")
+        if self.rollback_ok:
+            return {"ok": True, "version": 1}
+        return None
+
+
+def _ctl(plane, **kw):
+    kw.setdefault("manifest", _MANIFEST)
+    kw.setdefault("cooldown_s", 3.0)
+    kw.setdefault("healthy_s", 10.0)
+    kw.setdefault("clock", lambda: 0.0)
+    return SLOController(plane, **kw)
+
+
+def _burn(ctl, t0=0.0, n=40, wait_ms=90.0):
+    """Sustained breach: fills both windows over the 40 ms bound."""
+    for i in range(n):
+        ctl.observe("request", {"model": "m", "bucket": 8,
+                                "queue_wait_ms": wait_ms},
+                    t=t0 + i * 0.1)
+
+
+def _recover(ctl, t0, n=20):
+    for i in range(n):
+        ctl.observe("request", {"model": "m", "bucket": 8,
+                                "queue_wait_ms": 5.0},
+                    t=t0 + i * 0.05)
+
+
+# -- the decision ladder ----------------------------------------------------
+
+
+def test_join_on_burn():
+    plane = FakePlane(free=1)
+    ctl = _ctl(plane)
+    _burn(ctl)
+    acts = ctl.step(t=4.0)
+    assert [a["action"] for a in acts] == ["join_replica"]
+    assert plane.calls == ["grow"]
+    assert acts[0]["replica"] == 2 and acts[0]["width"] == 3
+    assert acts[0]["fits"] is True  # the admission verdict rides along
+
+
+def test_cooldown_suppresses_and_journals_once():
+    plane = FakePlane(free=2)
+    ctl = _ctl(plane, cooldown_s=3.0)
+    _burn(ctl)
+    assert ctl.step(t=4.0)  # first join
+    _burn(ctl, t0=4.05)  # still breaching
+    assert ctl.step(t=5.0) == []  # inside cooldown: suppressed
+    assert ctl.step(t=6.0) == []  # still inside: no re-log
+    assert ctl.counts["cooldowns"] == 1
+    _burn(ctl, t0=6.5)
+    assert ctl.step(t=7.5)  # cooldown over: second join allowed
+    assert plane.calls == ["grow", "grow"]
+
+
+def test_priced_refusal_journals_without_booting():
+    plane = FakePlane(free=1, fits=False)
+    ctl = _ctl(plane)
+    _burn(ctl)
+    assert ctl.step(t=4.0) == []
+    assert ctl.counts["refused"] == 1
+    assert "grow" not in plane.calls  # refusal is an outcome, no boot
+
+
+def test_lend_when_pool_exhausted():
+    plane = FakePlane(free=0, lendable=1)
+    ctl = _ctl(plane)
+    _burn(ctl)
+    acts = ctl.step(t=4.0)
+    assert [a["action"] for a in acts] == ["lend_width"]
+    assert plane.calls == ["lend"]
+    assert acts[0]["round"] == 2  # applied at the NEXT round boundary
+
+
+def test_canary_burn_rolls_back_first():
+    plane = FakePlane(free=1, rollback_ok=True)
+    ctl = _ctl(plane, canary_s=60.0)
+    ctl.observe("serve", {"kind": "rollout"}, t=0.0)
+    # the rollout suspends the latency gate for suspend_s — burn AFTER
+    # the settle window so the canary answers for it, not the swap
+    _burn(ctl, t0=5.1)
+    acts = ctl.step(t=9.2)
+    assert [a["action"] for a in acts] == ["rollback"]
+    assert plane.calls == ["rollback"]  # capacity never consulted
+    # a second burn AFTER the rollback scales instead (canary closed)
+    _burn(ctl, t0=13.0)
+    acts = ctl.step(t=17.0)
+    assert [a["action"] for a in acts] == ["join_replica"]
+
+
+def test_release_is_patient_replicas_then_width():
+    plane = FakePlane(free=1, lendable=1)
+    ctl = _ctl(plane, cooldown_s=1.0, healthy_s=10.0)
+    _burn(ctl)
+    assert ctl.step(t=4.0)  # join
+    plane.free = 0
+    _burn(ctl, t0=5.5)
+    assert ctl.step(t=6.5)  # lend (pool now exhausted)
+    assert plane.calls == ["grow", "lend"]
+    # recovery AFTER the breach stream ends (the burn samples ran to
+    # t=9.4): the fast window fills with healthy waits and clears
+    _recover(ctl, t0=10.0)
+    assert ctl.step(t=11.0) == []  # cleared but not healthy long enough
+    assert ctl.step(t=20.0) == []  # healthy_s counts from the CLEAR
+    acts = ctl.step(t=21.5)  # 11.0 + 10.0 healthy_s elapsed
+    assert [a["action"] for a in acts] == ["kill_replica"]
+    acts = ctl.step(t=23.0)  # next cooldown-separated step
+    assert [a["action"] for a in acts] == ["restore_width"]
+    assert plane.calls == ["grow", "lend", "shrink", "restore"]
+
+
+def test_summary_counts_round_trip():
+    plane = FakePlane(free=1)
+    ctl = _ctl(plane)
+    _burn(ctl)
+    ctl.step(t=4.0)
+    s = ctl.summary(t=5.0)
+    assert s["acts"] == 1 and s["decides"] == 1 and s["observes"] == 1
+    line = schema.make_event("ctl", run_id="t", kind="summary", **s)
+    assert schema.validate_line(line) == []
+
+
+# -- the banked scenario replay ---------------------------------------------
+
+
+def _load_harness():
+    spec = importlib.util.spec_from_file_location(
+        "ctl_scenarios", os.path.join(_REPO, "tools", "ctl_scenarios.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_all_four_scenarios_replay_against_banked_traces(tmp_path):
+    mod = _load_harness()
+    summary = mod.replay(update=False, journal_dir=str(tmp_path),
+                         log=lambda m: None)
+    assert summary["ok"], summary
+    assert len(summary["scenarios"]) == 4
+    for pair in summary["scenarios"]:
+        bare, ctl = pair["bare"], pair["controlled"]
+        assert bare["slo_burned"], bare["scenario"]  # A-arm must burn
+        assert ctl["slo_burned"] == [], ctl["scenario"]
+        assert ctl["dropped"] == 0, ctl["scenario"]
+        banked = json.load(open(os.path.join(
+            _REPO, "docs", "ctl_contracts",
+            f"{ctl['scenario']}.json")))
+        assert ctl["actions"] == banked["actions"], ctl["scenario"]
+
+
+def test_flash_crowd_lends_and_returns_width(tmp_path):
+    mod = _load_harness()
+    rec = mod.run_scenario("flash_crowd", controlled=True,
+                           journal=str(tmp_path / "fc.jsonl"))
+    names = [a["action"] for a in rec["actions"]]
+    assert "lend_width" in names and "restore_width" in names
+    assert names.index("lend_width") < names.index("restore_width")
+    assert rec["train_width"] == mod.SCENARIOS["flash_crowd"]["train_width"]
+    assert rec["end_burning"] == []
+
+
+def test_poison_canary_rolls_back_not_scales(tmp_path):
+    mod = _load_harness()
+    rec = mod.run_scenario("poison_canary", controlled=True,
+                           journal=str(tmp_path / "pc.jsonl"))
+    names = [a["action"] for a in rec["actions"]]
+    assert names == ["rollback"]  # capacity cannot fix a poisoned model
+
+
+# -- rendering surfaces -----------------------------------------------------
+
+
+def _ctl_journal(tmp_path):
+    path = tmp_path / "ctl.jsonl"
+    events = [
+        schema.make_event("run_start", run_id="r", argv=["test"]),
+        schema.make_event("ctl", run_id="r", kind="observe", t=1.0,
+                          gates=[], burning=[]),
+        schema.make_event("ctl", run_id="r", kind="decide", t=2.0,
+                          gate="warm-queue-p99", action="join_replica",
+                          reason="projected-wait burn", fast=1.4,
+                          slow=1.2),
+        schema.make_event("ctl", run_id="r", kind="act", t=2.0,
+                          action="join_replica", replica=2, width=3),
+        schema.make_event("ctl", run_id="r", kind="cooldown", t=3.0,
+                          gate="warm-queue-p99", cooldown_s=2.0,
+                          note="suppressed"),
+        schema.make_event("ctl", run_id="r", kind="summary", t=9.0,
+                          ok=True, observes=1, decides=1, acts=1,
+                          cooldowns=1, refused=0, burning=[]),
+        schema.make_event("run_end", run_id="r", rounds=0, spans=0,
+                          compiles=0),
+    ]
+    with open(path, "w") as f:
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+    return str(path)
+
+
+def test_report_renders_control_plane_section(tmp_path):
+    md = render_path(_ctl_journal(tmp_path))
+    assert "### control plane" in md
+    assert "**ACT** `join_replica`" in md
+    assert "decide `join_replica` on gate `warm-queue-p99`" in md
+    assert "1 burn evaluation(s) folded" in md
+    assert "cooldown" in md
+    assert "1 act(s)" in md
+
+
+def test_top_renders_ctl_decision_stream(tmp_path):
+    out = subprocess.run(
+        [sys.executable, "-m", "sparknet_tpu.obs", "top",
+         _ctl_journal(tmp_path), "--once"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr
+    assert "ctl decisions" in out.stdout
+    assert "join_replica" in out.stdout
+
+
+def test_slo_vacuous_pass_is_visible(tmp_path):
+    # a journal with ONLY a serve summary: compiles/dropped measure,
+    # the latency/feed/roofline gates pass vacuously — and must say so
+    path = tmp_path / "v.jsonl"
+    events = [
+        schema.make_event("run_start", run_id="r", argv=["test"]),
+        schema.make_event("serve", run_id="r", kind="summary",
+                          model="m", requests=4, dropped=0, compiles=0,
+                          wall_s=1.0),
+        schema.make_event("run_end", run_id="r", rounds=0, spans=0,
+                          compiles=0),
+    ]
+    with open(path, "w") as f:
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+    results = _slo.evaluate_journal(str(path))
+    vac = [r for r in results if r["ok"] and not r["applicable"]]
+    assert vac, "expected at least one vacuous gate"
+    for r in vac:
+        assert r["detail"].startswith("vacuous pass")
+    fields = _slo.verdict_fields("job", results, journal=str(path))
+    assert set(fields["vacuous"]) == {r["id"] for r in vac}
+    # the rendered report carries the distinction
+    verdict = schema.make_event("slo", **fields)
+    with open(path, "a") as f:
+        f.write(json.dumps(verdict) + "\n")
+    md = render_path(str(path))
+    assert "vacuous (no subject events)" in md

@@ -44,6 +44,7 @@ EXPECTED_RULES = {
     "obs-vocab-coverage",
     "conc-manifest-fresh",
     "byte-manifest-fresh",
+    "ctl-manifest-fresh",
 }
 
 

@@ -351,6 +351,32 @@ def test_recompile_flagged_on_shape_polymorphic_step(rec):
     assert alarms[0]["where"] == "solo"
 
 
+def test_absorb_compiles_keeps_deploy_builds_expected(rec):
+    """Deploy-arm candidate AOT builds happen BETWEEN training rounds;
+    absorb_compiles folds them into the by-design ledger so the next
+    round does not claim them as phantom unexpected recompiles (the
+    ProductionLoop.rollout -> elastic round seam)."""
+    get_sentinel().install()
+    rec.round(mode="elastic", tau=1, devices=2, iters=1, batch=8,
+              wall_s=0.1, loss=1.0, fenced=True)  # warms "elastic"
+    # a candidate build compiles off the round path
+    jax.jit(lambda x: x * 3 - 1)(jnp.ones((11,)))
+    n = rec.absorb_compiles("deploy")
+    assert n > 0
+    alarms = events_of(rec, "recompile")
+    assert len(alarms) == 1
+    assert alarms[0]["where"] == "deploy"
+    assert alarms[0]["expected"] is True
+    assert alarms[0]["count"] == n
+    # the next warm round sees a clean ledger: no phantom alarm
+    rec.round(mode="elastic", tau=1, devices=2, iters=1, batch=8,
+              wall_s=0.1, loss=1.0, fenced=True)
+    assert len(events_of(rec, "recompile")) == 1
+    # idempotent when nothing compiled since
+    assert rec.absorb_compiles("deploy") == 0
+    assert len(events_of(rec, "recompile")) == 1
+
+
 # -- Solver instrumentation -------------------------------------------------
 
 
