@@ -118,6 +118,13 @@ def expected_comm(mode: str, *, param_bytes: int, state_bytes: int = 0,
             note="single-chip AOT serving forward: any collective is a "
                  "lowering bug",
         )
+    if mode.startswith("decode"):
+        return CommExpectation(
+            required={},
+            forbidden=COLLECTIVE_KINDS,
+            note="single-chip paged/rectangle decode step: any "
+                 "collective is a lowering bug",
+        )
     # dp_nhwc shares dp's budget exactly: params never reorient under
     # the nhwc layout (ops/layout.py), so the grad all-reduce moves the
     # same bytes — a layout that changed this block would be a bug.

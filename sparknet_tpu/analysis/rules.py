@@ -1007,6 +1007,98 @@ def check_replica_manifest_fresh(ctx: ModuleContext) -> Iterator[tuple[int, str]
 
 
 # ---------------------------------------------------------------------------
+# paged-manifest-fresh
+# ---------------------------------------------------------------------------
+
+# The paged decode engine (serve/paged.py) is the cached token-serving
+# layer: its contract claim is SHAPE STABILITY across occupancy — the
+# decode step at occupancy 1 and at full arena must lower to the same
+# program (that IS the zero-post-warmup-compiles guarantee, made
+# machine-checkable), pinned by the occupancy-parameterized
+# decode_paged_o* twins next to the decode_rect rectangle baseline.
+# serve-manifest-fresh already checks that paged.py is folded into the
+# graph+mem SOURCES fingerprints (it sits on the serve/ surface); what
+# it cannot see is whether the occupancy twins were ever banked, nor
+# the byte_contracts family (the capacity claim is a BYTES claim).
+# Anchored on paged.py alone so the coverage finding lands once.
+_PAGED_SOURCE = "sparknet_tpu/serve/paged.py"
+_PAGED_MIN_OCCUPANCIES = 2
+_PAGED_REGEN = {
+    **_ELASTIC_REGEN,
+    "byte_contracts": "regenerate with `python -m sparknet_tpu.analysis "
+                      "bytes --update`",
+}
+
+
+def _paged_source_rel(path: str) -> tuple[str, str] | None:
+    norm = os.path.abspath(path).replace(os.sep, "/")
+    idx = norm.rfind("/sparknet_tpu/")
+    if idx < 0:
+        return None
+    root, rel = norm[:idx], norm[idx + 1:]
+    if rel == _PAGED_SOURCE:
+        return root, rel
+    return None
+
+
+@rule(
+    "paged-manifest-fresh",
+    "the paged decode engine (serve/paged.py) must be folded into the "
+    "graph+mem+byte SOURCES fingerprints with decode_paged_o* twins "
+    "banked at >= 2 occupancies plus the decode_rect baseline in "
+    "every family",
+)
+def check_paged_manifest_fresh(ctx: ModuleContext) -> Iterator[tuple[int, str]]:
+    """The decode_paged_o* twins pin the occupancy shape-stability
+    contract — the cached step's program must not depend on how many
+    rows are live (occupancy changes DATA, never a shape), which is
+    what keeps the recompile sentinel at zero across admission churn.
+    One banked occupancy would prove nothing about stability, so each
+    manifest family must carry >= ``_PAGED_MIN_OCCUPANCIES`` of them,
+    plus the decode_rect baseline the A/B is priced against, and the
+    banked SOURCES.json must record paged.py at all.  Blind spot
+    (deliberate): hash staleness is NOT re-checked here — that belongs
+    to graph-/mem-/byte-manifest-fresh on the serve/ surface.
+    """
+    hit = _paged_source_rel(ctx.path)
+    if hit is None:
+        return
+    root, rel = hit
+    for fam, regen in _PAGED_REGEN.items():
+        cdir = os.path.join(root, "docs", fam)
+        src = os.path.join(cdir, "SOURCES.json")
+        if not os.path.exists(src):
+            yield (1, f"{rel} is paged-decode contract source but no "
+                      f"manifests are banked (docs/{fam}/SOURCES.json "
+                      f"missing) — {regen}")
+            continue
+        try:
+            with open(src, encoding="utf-8") as f:
+                recorded = json.load(f)
+        except (OSError, ValueError):
+            yield (1, f"docs/{fam}/SOURCES.json unreadable — {regen}")
+            continue
+        if rel not in recorded:
+            yield (1, f"{rel} is not folded into the docs/{fam} SOURCES "
+                      f"fingerprint — the banked manifests predate the "
+                      f"paged decode layer; {regen}")
+        try:
+            names = os.listdir(cdir)
+        except OSError:
+            names = []
+        twins = [n for n in names
+                 if n.startswith("decode_paged_o") and n.endswith(".json")]
+        if len(twins) < _PAGED_MIN_OCCUPANCIES:
+            yield (1, f"docs/{fam} banks {len(twins)} decode_paged_o* "
+                      f"twin manifest(s); the occupancy shape-stability "
+                      f"contract needs >= {_PAGED_MIN_OCCUPANCIES} "
+                      f"occupancies — {regen}")
+        if "decode_rect.json" not in names:
+            yield (1, f"docs/{fam} lacks the decode_rect baseline twin "
+                      f"the paged A/B is priced against — {regen}")
+
+
+# ---------------------------------------------------------------------------
 # conc-manifest-fresh
 # ---------------------------------------------------------------------------
 

@@ -1,18 +1,83 @@
 """Autoregressive sampling from a trained causal LM (``models.charlm``).
 
 No reference analog (the reference is CNN-only; long-context is this
-framework's first-class extra).  Decoding reuses the ordinary TEST-phase
-forward program — the same compiled graph that evaluates accuracy — with
-a fixed [1, seq_len] window so there is exactly ONE compilation: the
-prompt/continuation is RIGHT-padded and logits are read at the last real
-position, which causal masking leaves independent of the padding.
+framework's first-class extra).  Decoding rides the cached per-token
+step (``models/zoo.build_decode_step`` — the serve/paged.py engine's
+program): the prompt is ONE full-window prefill that writes K/V through
+a single-slot block table, then every generated char is ONE O(1) cached
+step instead of an O(seq_len) re-forward.  Exactly two compilations
+(prefill + step), both cached on the net handle across calls.  Greedy
+output is bitwise-identical to the uncached full-window decode
+(tests/test_paged.py pins it) — the cached step attends over the
+same values the full forward would recompute, masked to the same rows.
+
+When the requested continuation cannot fit the window
+(``len(prompt) + n > seq_len``) the cache would have to slide, and
+absolute RoPE positions make a slid cache line invalid — those calls
+take the legacy sliding-window full-forward path instead.
 """
 
 from __future__ import annotations
 
+import math
+
 import numpy as np
 
 from sparknet_tpu.data.text import CharVocab
+
+
+def _cached_decode_fns(net, seq_len: int, logits_blob: str):
+    """Build (or fetch) the prefill + decode-step executables and the
+    single-slot pool geometry for ``net``'s TEST graph.  Returns None
+    when the graph is not a cacheable decoder family (decode_spec
+    refuses) — callers fall back to the full-forward path."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparknet_tpu.models.zoo import (
+        build_decode_step, build_prefill, decode_spec)
+
+    cache = getattr(net, "_decode_cache", None)
+    if cache is None:
+        cache = net._decode_cache = {}
+    key = (seq_len, logits_blob)
+    if key in cache:
+        return cache[key]
+    network = net.test_net
+    try:
+        spec = decode_spec(network, end=logits_blob)
+    except (KeyError, ValueError):
+        cache[key] = None
+        return None
+    if spec.seq_len != seq_len:
+        cache[key] = None
+        return None
+    block_tokens = 8
+    mb = math.ceil(seq_len / block_tokens)
+    n_attn = len(spec.attn_layers)
+    k_pool = jnp.zeros((n_attn, 1 + mb, block_tokens, spec.heads,
+                        spec.head_dim), jnp.float32)
+    tables = np.arange(1, mb + 1, dtype=np.int32)[None, :]
+    cache[key] = {
+        "prefill": jax.jit(build_prefill(network, end=logits_blob)),
+        "step": jax.jit(build_decode_step(network, end=logits_blob)),
+        "k_pool": k_pool,
+        "v_pool": jnp.zeros_like(k_pool),
+        "tables": tables,
+    }
+    return cache[key]
+
+
+def _pick(logits: np.ndarray, temperature: float, top_k: int, rs) -> int:
+    logits = logits.astype(np.float64)
+    if top_k > 0:
+        cut = np.sort(logits)[-top_k]
+        logits = np.where(logits < cut, -np.inf, logits)
+    if temperature <= 0:
+        return int(np.argmax(logits))
+    z = (logits - logits.max()) / temperature
+    p = np.exp(z) / np.exp(z).sum()
+    return int(rs.choice(p.size, p=p))
 
 
 def generate_chars(
@@ -30,14 +95,42 @@ def generate_chars(
     built over ``models.charlm(batch=1, seq_len=seq_len, ...)``.
 
     ``temperature=0`` decodes greedily; ``top_k > 0`` restricts sampling
-    to the k most likely chars.  The context is the last ``seq_len``
-    ids (sliding window — charlm has no cache; fine at demo scale).
+    to the k most likely chars.  While the continuation fits the
+    ``seq_len`` window the decode is CACHED — one prefill, then one
+    O(1) step per char; longer requests slide the window through the
+    full forward (absolute positions invalidate a slid cache).
     """
     if not prompt:
         raise ValueError("prompt must be non-empty")
+    if n <= 0:
+        return ""
     rs = np.random.RandomState(seed)
     ids = list(vocab.encode(prompt))
     n_prompt = len(ids)
+
+    fns = None
+    if n_prompt + n <= seq_len:
+        fns = _cached_decode_fns(net, seq_len, logits_blob)
+    if fns is not None:
+        variables = net.solver.variables
+        tokens = np.zeros((1, seq_len), np.int32)
+        tokens[0, :n_prompt] = ids
+        lengths = np.asarray([n_prompt], np.int32)
+        k_pool, v_pool, last = fns["prefill"](
+            variables, tokens, lengths, fns["k_pool"], fns["v_pool"],
+            fns["tables"])
+        ids.append(_pick(np.asarray(last)[0], temperature, top_k, rs))
+        for _ in range(n - 1):
+            tok = np.asarray([[ids[-1]]], np.int32)
+            pos = np.asarray([len(ids) - 1], np.int32)
+            k_pool, v_pool, logits = fns["step"](
+                variables, k_pool, v_pool, tok, pos, fns["tables"])
+            ids.append(_pick(np.asarray(logits)[0, 0], temperature,
+                             top_k, rs))
+        return vocab.decode(ids[n_prompt:])
+
+    # legacy sliding-window path: the only shape that can outrun the
+    # window — every step pays the O(seq_len) full forward
     dummy_label = np.zeros((1, seq_len), np.int32)
     for _ in range(n):
         window = ids[-seq_len:]
@@ -45,15 +138,6 @@ def generate_chars(
         data = np.zeros((1, seq_len), np.int32)
         data[0, : len(window)] = window  # right-pad: causal-safe
         blobs = net.forward({"data": data, "label": dummy_label})
-        logits = np.asarray(blobs[logits_blob])[0, t].astype(np.float64)
-        if top_k > 0:
-            cut = np.sort(logits)[-top_k]
-            logits = np.where(logits < cut, -np.inf, logits)
-        if temperature <= 0:
-            nxt = int(np.argmax(logits))
-        else:
-            z = (logits - logits.max()) / temperature
-            p = np.exp(z) / np.exp(z).sum()
-            nxt = int(rs.choice(p.size, p=p))
-        ids.append(nxt)
+        ids.append(_pick(np.asarray(blobs[logits_blob])[0, t],
+                         temperature, top_k, rs))
     return vocab.decode(ids[n_prompt:])

@@ -1017,6 +1017,246 @@ def charlm_solver() -> SolverConfig:
 
 
 # ---------------------------------------------------------------------------
+# Cached per-token decode step (ISSUE 19, ROADMAP item 4).
+#
+# The rectangle decode path (serve/continuous.py) rebuilds the FULL
+# [slots, seq_len] forward for every emitted token — O(seq_len) recompute
+# per token, because the prototxt graph has no KV cache (the gap
+# models/generate.py documents).  The builders below grow the
+# transformer families a cached twin: ``build_decode_step`` replays the
+# SAME layer graph one token at a time against a block-paged KV pool
+# (ops/pallas_kernels.paged_attention), and ``build_prefill`` runs the
+# ordinary full-window forward once while also writing every layer's
+# K/V into the pool.  Both are mini-interpreters over ``network.layers``
+# that call each non-attention layer's own ``layer.apply`` — Embed /
+# Eltwise / InnerProduct(axis=2) / ReLU math is literally the layer's
+# own code, so there is no second implementation to drift; only the
+# attention core is swapped for its cached form (the exact qkv/rope/
+# out-proj expressions from ops/attention.py with the S axis narrowed
+# to the current token).
+#
+# Pool layout (shared with serve/paged.py): K/V arenas
+# [n_attn_layers, num_blocks, block_tokens, heads, head_dim]; one
+# per-slot block table [MB] int32 shared by all layers (every layer
+# caches the same token at the same (block, offset)); block 0 is the
+# null block inactive table entries point at — masked columns
+# contribute exactly 0.0 after softmax, so its garbage never reaches a
+# live row's output.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class DecodeSpec:
+    """Static geometry of one transformer family's cached decode path
+    (what serve/paged.py prices blocks and arenas from)."""
+
+    vocab: int
+    embed_dim: int
+    heads: int
+    head_dim: int
+    seq_len: int
+    attn_layers: tuple
+    end: str
+
+
+def decode_spec(network, end: str = "fc") -> DecodeSpec:
+    """Introspect a TEST-phase transformer ``Network`` into the static
+    geometry the paged decode step needs.  Raises ``ValueError`` for
+    any family whose graph the cached step cannot replay exactly: the
+    head must be a per-token InnerProduct (axis=2 — the charlm LM head;
+    the axis=1 sequence CLASSIFIER head has no per-token decode
+    meaning), attention must be causal with one head count, and every
+    layer up to the head must be one of the five cached-twin types."""
+    from sparknet_tpu.ops.attention import MultiHeadAttentionLayer as _Attn
+    from sparknet_tpu.ops.blocks import Eltwise, Embed, InnerProduct
+    from sparknet_tpu.ops.data_layers import InputLayer
+    from sparknet_tpu.ops.neuron import ReLU
+
+    ei = network.layer_index(end)
+    head = network.layers[ei]
+    if not isinstance(head, InnerProduct) or head.lp.get_msg(
+            "inner_product_param").get_int("axis", 1) != 2:
+        raise ValueError(
+            f"decode head {end!r} must be a per-token InnerProduct "
+            "(axis=2); sequence-classifier heads have no cached decode")
+    vocab = head.lp.get_msg("inner_product_param").get_int("num_output")
+    embed_dim = None
+    heads = None
+    attn: list = []
+    for layer in network.layers[: ei + 1]:
+        if isinstance(layer, InputLayer):
+            continue
+        if isinstance(layer, _Attn):
+            if not layer.causal:
+                raise ValueError(
+                    f"{layer.name}: cached decode needs causal attention")
+            if heads is None:
+                heads = layer.num_heads
+            elif heads != layer.num_heads:
+                raise ValueError("cached decode needs one head count "
+                                 "across attention layers")
+            attn.append(layer.name)
+        elif isinstance(layer, Embed):
+            embed_dim = layer.lp.get_msg("embed_param").get_int("num_output")
+        elif not isinstance(layer, (Eltwise, InnerProduct, ReLU)):
+            raise ValueError(
+                f"layer {layer.name!r} ({layer.type}) has no cached "
+                "decode twin")
+    if not attn or embed_dim is None or heads is None:
+        raise ValueError("cached decode needs an Embed front and at "
+                         "least one attention layer")
+    seq_len = int(network.feed_shapes()["data"][1])
+    return DecodeSpec(vocab=vocab, embed_dim=embed_dim, heads=heads,
+                      head_dim=embed_dim // heads, seq_len=seq_len,
+                      attn_layers=tuple(attn), end=end)
+
+
+def build_decode_step(network, end: str = "fc", proposed_width: int = 1):
+    """One cached decode step over a block-paged KV pool.
+
+    Returns ``step(variables, k_pool, v_pool, tokens, positions,
+    tables) -> (k_pool, v_pool, logits)`` — pools first (the carry
+    convention; callers jit with the pools donated), ``tokens`` [B, W]
+    int32, ``positions`` [B] int32 absolute position of each row's
+    token, ``tables`` [B, MB] int32 block tables.  Each attention layer
+    writes the token's K/V through the table at ``(pos // T, pos % T)``
+    and attends via :func:`paged_attention` — per-token work is
+    O(position), never O(seq_len) recompute, and every row's output is
+    a pure function of its own (token, position, table), which is the
+    interleaved == alone exactness gate.
+
+    ``proposed_width`` is the speculative-decoding seam (next PR): the
+    step's token axis is [B, W]; only W == 1 lowers today."""
+    if proposed_width != 1:
+        raise NotImplementedError(
+            "speculative decode (proposed_ids width > 1) is the "
+            "declared seam — not lowered yet")
+    import jax.numpy as jnp
+
+    from sparknet_tpu.ops.attention import (
+        MultiHeadAttentionLayer as _Attn, rope_at)
+    from sparknet_tpu.ops.data_layers import InputLayer
+    from sparknet_tpu.ops.pallas_kernels import paged_attention
+
+    spec = decode_spec(network, end=end)
+    ei = network.layer_index(end)
+    H, D = spec.heads, spec.head_dim
+
+    def step(variables, k_pool, v_pool, tokens, positions, tables):
+        T = k_pool.shape[2]
+        B = tokens.shape[0]
+        blob = {"data": tokens.astype(jnp.int32)}
+        a = 0
+        for layer in network.layers[: ei + 1]:
+            if isinstance(layer, InputLayer):
+                continue
+            p = network._resolve_shared(
+                layer, variables.params.get(layer.name, []),
+                variables.params)
+            ins = [blob[b] for b in layer.bottoms]
+            if isinstance(layer, _Attn):
+                x = ins[0]  # [B, 1, E]
+                w_qkv, b_qkv, w_out, b_out = p
+                E = x.shape[-1]
+                qkv = jnp.einsum("bse,fe->bsf", x, w_qkv) + b_qkv
+                q, k, v = jnp.split(qkv, 3, axis=-1)
+                split = lambda t: t.reshape(B, 1, H, D).transpose(0, 2, 1, 3)
+                q, k, v = split(q), split(k), split(v)  # [B, H, 1, D]
+                if layer.rope:
+                    pw = positions[:, None]
+                    q, k = rope_at(q, pw), rope_at(k, pw)
+                blk = jnp.take_along_axis(
+                    tables, (positions // T)[:, None], axis=1)[:, 0]
+                off = positions % T
+                k_pool = k_pool.at[a, blk, off].set(k[:, :, 0, :])
+                v_pool = v_pool.at[a, blk, off].set(v[:, :, 0, :])
+                o = paged_attention(q[:, :, 0, :], k_pool[a], v_pool[a],
+                                    tables, positions)  # [B, H, D]
+                y = jnp.einsum("bse,fe->bsf", o.reshape(B, 1, E),
+                               w_out) + b_out
+                blob[layer.tops[0]] = y
+                a += 1
+                continue
+            out = layer.apply(p, variables.state.get(layer.name, {}),
+                              ins, train=False, rng=None)
+            for top, o in zip(layer.tops, out.outputs):
+                blob[top] = o
+        return k_pool, v_pool, blob[network.layers[ei].tops[0]]
+
+    return step
+
+
+def build_prefill(network, end: str = "fc"):
+    """The prompt pass of the disaggregated serve path: one ordinary
+    full-window causal forward (the same einsum/rope/flash-attention
+    expressions ops/attention.py lowers — NOT a second attention
+    implementation) that also writes every layer's K/V through the
+    block tables.  Returns ``prefill(variables, tokens, lengths,
+    k_pool, v_pool, tables) -> (k_pool, v_pool, last_logits)`` with
+    ``last_logits`` [B, vocab] taken at each row's ``lengths - 1``
+    (the first generated token's distribution).  Padded positions >=
+    length write garbage K/V into the slot's own blocks; the decode
+    step overwrites position p before any row ever attends to it, so
+    the garbage is dead by construction."""
+    import jax.numpy as jnp
+
+    from sparknet_tpu.ops.attention import (
+        MultiHeadAttentionLayer as _Attn, rope)
+    from sparknet_tpu.ops.data_layers import InputLayer
+    from sparknet_tpu.ops.pallas_kernels import flash_attention
+
+    spec = decode_spec(network, end=end)
+    ei = network.layer_index(end)
+    H, D = spec.heads, spec.head_dim
+
+    def prefill(variables, tokens, lengths, k_pool, v_pool, tables):
+        T = k_pool.shape[2]
+        B, S = tokens.shape
+        blob = {"data": tokens.astype(jnp.int32)}
+        a = 0
+        for layer in network.layers[: ei + 1]:
+            if isinstance(layer, InputLayer):
+                continue
+            p = network._resolve_shared(
+                layer, variables.params.get(layer.name, []),
+                variables.params)
+            ins = [blob[b] for b in layer.bottoms]
+            if isinstance(layer, _Attn):
+                x = ins[0]  # [B, S, E]
+                w_qkv, b_qkv, w_out, b_out = p
+                E = x.shape[-1]
+                qkv = jnp.einsum("bse,fe->bsf", x, w_qkv) + b_qkv
+                q, k, v = jnp.split(qkv, 3, axis=-1)
+                split = lambda t: t.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+                q, k, v = split(q), split(k), split(v)  # [B, H, S, D]
+                if layer.rope:
+                    q, k = rope(q), rope(k)
+                pos = jnp.arange(S, dtype=jnp.int32)
+                blk = jnp.take_along_axis(
+                    tables, jnp.broadcast_to(pos // T, (B, S)), axis=1)
+                off = jnp.broadcast_to(pos % T, (B, S))
+                k_pool = k_pool.at[a, blk, off].set(k.transpose(0, 2, 1, 3))
+                v_pool = v_pool.at[a, blk, off].set(v.transpose(0, 2, 1, 3))
+                o = flash_attention(q, k, v, causal=layer.causal)
+                o = o.transpose(0, 2, 1, 3).reshape(B, S, E)
+                y = jnp.einsum("bse,fe->bsf", o, w_out) + b_out
+                blob[layer.tops[0]] = y
+                a += 1
+                continue
+            out = layer.apply(p, variables.state.get(layer.name, {}),
+                              ins, train=False, rng=None)
+            for top, o in zip(layer.tops, out.outputs):
+                blob[top] = o
+        logits = blob[network.layers[ei].tops[0]]  # [B, S, V]
+        last = jnp.take_along_axis(
+            logits, (lengths - 1)[:, None, None].astype(jnp.int32),
+            axis=1)[:, 0]
+        return k_pool, v_pool, last
+
+    return prefill
+
+
+# ---------------------------------------------------------------------------
 # Graph-contract sweep configs (sparknet_tpu/analysis/graphcheck.py).
 #
 # Tiny, shape-valid instantiations of the zoo families the static graph

@@ -106,6 +106,8 @@ class GateState:
         self.gate_id = spec.get("id", self.kind)
         if self.kind == "warm_queue_p99":
             self.bound = float(spec.get("max_ms", 40.0))
+        elif self.kind == "ttft_p99":
+            self.bound = float(spec.get("max_ms", 250.0))
         elif self.kind == "feed_stage_share":
             self.bound = float(spec.get("max_share", 0.05))
         elif self.kind == "bench_roofline":
@@ -133,6 +135,16 @@ class GateState:
             if n >= warmup and isinstance(wait, (int, float)):
                 self.fast.add(t, wait)
                 self.slow.add(t, wait)
+        elif kind == "ttft_p99":
+            if event != "token" or fields.get("kind") != "request":
+                return
+            n = self._warm_seen.get("token", 0)
+            self._warm_seen["token"] = n + 1
+            warmup = int(self.spec.get("warmup_requests", 8))
+            ttft = fields.get("ttft_ms")
+            if n >= warmup and isinstance(ttft, (int, float)):
+                self.fast.add(t, ttft)
+                self.slow.add(t, ttft)
         elif kind == "feed_stage_share":
             if event != "feed":
                 return
@@ -191,7 +203,7 @@ class GateState:
         values = window.values(now)
         if not values:
             return None
-        if self.kind == "warm_queue_p99":
+        if self.kind in ("warm_queue_p99", "ttft_p99"):
             return _p99(values) / self.bound
         if self.kind == "bench_roofline":
             return max(values)  # already value/roofline fractions
@@ -202,7 +214,7 @@ class GateState:
         fast = self._rate(self.fast, now)
         slow = self._rate(self.slow, now)
         trip = 1.0 if self.bound else 0.0
-        if suspended and self.kind == "warm_queue_p99":
+        if suspended and self.kind in ("warm_queue_p99", "ttft_p99"):
             self.burning = False
         elif self.burning:
             # hysteretic clear on the FAST window only: the short window
@@ -263,7 +275,7 @@ class BurnEngine:
         if kinds and fields.get("kind") in kinds:
             until = now + self.suspend_s
             for g in self.gates:
-                if g.kind == "warm_queue_p99":
+                if g.kind in ("warm_queue_p99", "ttft_p99"):
                     g.suspended_until = max(g.suspended_until, until)
         for g in self.gates:
             g.fold(event, fields, now)

@@ -455,6 +455,61 @@ def _ctl_lines(ctls: list[dict]) -> list[str]:
     return lines
 
 
+def _token_lines(toks: list[dict]) -> list[str]:
+    """The token-serving stream (serve/paged.py "token" events): one
+    roll-up line over the per-request latency decompositions (TTFT /
+    inter-token cadence), then prefill / admission_refused / summary
+    lines with the block-pool gauges — enough to read the zero-leak
+    ledger and the flat-cadence claim straight off the report."""
+    lines = []
+    reqs = [ev for ev in toks if ev.get("kind") == "request"]
+    if reqs:
+        ttft = sorted(ev.get("ttft_ms", 0.0) for ev in reqs)
+        p50s = sorted(ev.get("inter_token_p50_ms", 0.0) for ev in reqs)
+        total = sum(ev.get("tokens", 0) for ev in reqs)
+        lines.append(
+            f"- {len(reqs)} generation(s), {total} token(s); TTFT p50 "
+            f"{ttft[len(ttft) // 2]:.3f} ms / max {ttft[-1]:.3f} ms; "
+            f"inter-token p50-of-p50s {p50s[len(p50s) // 2]:.3f} ms")
+    for ev in toks:
+        kind = ev.get("kind", "?")
+        if kind == "prefill":
+            lines.append(
+                f"- prefill: {ev.get('rows', 0)} row(s) on bucket "
+                f"{ev.get('bucket', '?')} ({ev.get('prompt_tokens', 0)} "
+                f"prompt token(s), {ev.get('wall_ms', 0):g} ms); pool "
+                f"{ev.get('blocks_free', '?')}/"
+                f"{ev.get('blocks_total', '?')} blocks free")
+        elif kind == "admission_refused":
+            lines.append(
+                f"- **ADMISSION REFUSED** (priced pre-compile): "
+                f"predicted {ev.get('predicted_bytes', 0):,} B > budget "
+                f"{ev.get('budget_bytes', 0):,} B")
+        elif kind == "summary":
+            leaked = ev.get("leaked", 0)
+            dropped = ev.get("dropped", 0)
+            compiles = ev.get("compiles", 0)
+            flags = []
+            if leaked:
+                flags.append(f"**LEAKED {leaked}**")
+            if dropped:
+                flags.append(f"**DROPPED {dropped}**")
+            if compiles:
+                flags.append(f"**{compiles} POST-WARMUP COMPILE(S)**")
+            verdict = ", ".join(flags) if flags else \
+                "ledger exact, zero compiles"
+            lines.append(
+                f"- summary: {ev.get('requests', 0)} request(s), "
+                f"{ev.get('steps', 0)} decode step(s), "
+                f"{ev.get('prefills', 0)} prefill(s); blocks "
+                f"allocated {ev.get('allocated', 0)} / freed "
+                f"{ev.get('freed', 0)} — {verdict}")
+        elif kind != "request":
+            note = ev.get("note")
+            lines.append(f"- {kind}" + (f" — {note}" if note else ""))
+    return lines
+
+
 def _runner_lines(events: list[dict]) -> list[str]:
     """The window-runner evidence ledger (tools/tpu_window_runner.py):
     dials, jobs, refusals, and per-job SLO verdicts — rendered here so
@@ -703,7 +758,7 @@ def render(events: Iterable[dict], source: str = "journal",
                               "member": [], "feed": [], "recompile": [],
                               "bench": [], "bank": [], "end": [],
                               "serve": [], "loop": [], "metrics": [],
-                              "replica": [], "ctl": []}
+                              "replica": [], "ctl": [], "token": []}
         if kind == "request":
             agg = request_aggs.get(run_id)
             if agg is None:
@@ -766,6 +821,9 @@ def render(events: Iterable[dict], source: str = "journal",
         if group["ctl"]:
             lines += ["", "### control plane (burn → action)", ""]
             lines += _ctl_lines(group["ctl"])
+        if group["token"]:
+            lines += ["", "### token serving (paged decode)", ""]
+            lines += _token_lines(group["token"])
         if run_id in request_aggs:
             lines += ["", "### request latency (p50/p99 per model × "
                           "bucket)", ""]

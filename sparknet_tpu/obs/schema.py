@@ -295,6 +295,29 @@ EVENTS: dict[str, tuple[dict, dict]] = {
          "refused": int, "predicted_bytes": int, "budget_bytes": int,
          "note": str, "lineage": dict},
     ),
+    # -- token serving (sparknet_tpu/serve/paged.py) --------------------
+    # one paged-decode lifecycle event, discriminated by ``kind``:
+    # prefill (one ladder-bucket prompt forward — ``rows`` live rows
+    # riding ``bucket``, block-pool gauges after the K/V writes) /
+    # request (one drained generation's latency decomposition: ttft_ms
+    # is submit -> first token, inter_token_* the per-step cadence the
+    # flat-±20% acceptance gate reads) / admission_refused (the decode
+    # plane priced itself out of HBM BEFORE any compile — the
+    # serve/residency.py stance) / summary (a drained-run roll-up:
+    # ``compiles`` MUST be 0 post-warmup, ``leaked`` and ``dropped``
+    # MUST be 0 — the zero-leak ledger).
+    "token": (
+        {"run_id": str, "kind": str},
+        {"tokens": int, "prompt_tokens": int, "rows": int, "bucket": int,
+         "requests": int, "steps": int, "prefills": int, "compiles": int,
+         "ttft_ms": _NUM, "total_ms": _NUM, "inter_token_p50_ms": _NUM,
+         "inter_token_max_ms": _NUM, "wall_ms": _NUM, "wall_s": _NUM,
+         "tokens_per_sec": _NUM, "occupancy": int, "replicas": int,
+         "allocated": int, "freed": int, "leaked": int, "dropped": int,
+         "blocks_free": int, "blocks_total": int,
+         "predicted_bytes": int, "budget_bytes": int,
+         "note": str, "lineage": dict},
+    ),
     # one served request's latency decomposition (the p50/p99 material):
     # queue_wait (submit -> flush) + batch_assembly (pad/fill) + device
     # (executable call, fence included) = total.  ``bucket`` is the

@@ -158,7 +158,8 @@ def _gate_compiles_zero(spec: dict, events: list[dict]):
             applicable = True
             if not ev.get("expected"):
                 recompiles += ev.get("count", 1)
-        elif kind in ("serve", "loop") and ev.get("kind") == "summary":
+        elif kind in ("serve", "loop", "token") and \
+                ev.get("kind") == "summary":
             c = ev.get("compiles")
             if isinstance(c, int):
                 applicable = True
@@ -181,7 +182,7 @@ def _gate_dropped_zero(spec: dict, events: list[dict]):
     total = 0
     applicable = False
     for ev in events:
-        if ev.get("event") in ("serve", "replica", "loop"):
+        if ev.get("event") in ("serve", "replica", "loop", "token"):
             dropped = ev.get("dropped")
             if isinstance(dropped, int):
                 applicable = True
@@ -222,8 +223,37 @@ def _gate_bench_roofline(spec: dict, events: list[dict]):
     return True, not burns, round(worst, 4), 1.0, detail
 
 
+def _gate_ttft_p99(spec: dict, events: list[dict]):
+    """Time-to-first-token p99 ≤ its bound over paged token serving
+    (serve/paged.py ``token`` request events).  "Warm" skips the first
+    ``warmup_requests`` generations — their TTFT includes admission
+    backlog behind the cold start; what must hold the bound is steady
+    token traffic.  Vacuous on journals with no token events (every
+    pre-existing specimen).  Same fixed-boundary histogram as the
+    queue-wait gate (≤ ~5.93% conservative-side estimate error)."""
+    warmup = int(spec.get("warmup_requests", 8))
+    bound = float(spec.get("max_ms", 250.0))
+    hist = _metrics.Histogram()
+    seen = 0
+    for ev in events:
+        if ev.get("event") != "token" or ev.get("kind") != "request":
+            continue
+        seen += 1
+        if seen <= warmup:
+            continue
+        ttft = ev.get("ttft_ms")
+        if isinstance(ttft, (int, float)):
+            hist.observe(ttft)
+    if hist.count == 0:
+        return False, True, None, bound, "no post-warmup token requests"
+    p99 = _metrics.percentile(hist.snapshot(), 99.0)
+    return True, p99 <= bound, round(p99, 3), bound, (
+        f"TTFT p99 {p99:.3f} ms over {hist.count} generations")
+
+
 _GATES = {
     "warm_queue_p99": _gate_warm_queue_p99,
+    "ttft_p99": _gate_ttft_p99,
     "feed_stage_share": _gate_feed_stage_share,
     "compiles_zero": _gate_compiles_zero,
     "dropped_zero": _gate_dropped_zero,

@@ -150,6 +150,31 @@ def rope(x: jax.Array, base: float = 10000.0) -> jax.Array:
     ).astype(x.dtype)
 
 
+def rope_at(x: jax.Array, positions: jax.Array,
+            base: float = 10000.0) -> jax.Array:
+    """:func:`rope` at explicit absolute positions — the decode-path
+    twin.  ``x`` is [B, H, W, D] (W the proposed-token width, 1 for
+    plain decode) and ``positions`` [B, W] int32 absolute positions.
+    Bitwise contract with :func:`rope`: for ``positions[b, w] == t`` the
+    rotation applied here is the SAME float expression :func:`rope`
+    applies at sequence index t (identical theta/cos/sin/rotate-half
+    arithmetic), so a cached K written through this path equals the K
+    the full-window forward computes at that row.
+    """
+    B, H, W, D = x.shape
+    if D % 2:
+        raise ValueError(f"rope needs an even head dim, got {D}")
+    half = D // 2
+    theta = base ** (-jnp.arange(half, dtype=jnp.float32) / half)  # [half]
+    ang = positions.astype(jnp.float32)[..., None] * theta  # [B, W, half]
+    cos = jnp.cos(ang)[:, None]  # [B, 1, W, half] — broadcast over heads
+    sin = jnp.sin(ang)[:, None]
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    ).astype(x.dtype)
+
+
 @register
 class MultiHeadAttentionLayer(Layer):
     TYPE = "MultiHeadAttention"

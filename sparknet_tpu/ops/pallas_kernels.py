@@ -704,6 +704,14 @@ def vmem_audit_points() -> list:
                                            "(2 slots, 2 B storage, f32 "
                                            "register math)",
          "bytes": fused_update_vmem_bytes(2, itemsize=2)},
+        {"kernel": "paged", "note": "charlm decode block (T=16, H=4, "
+                                    "D=16 per head, f32 pools)",
+         "bytes": paged_vmem_bytes(16, 4, 16)},
+        {"kernel": "paged", "note": "long-context planning point "
+                                    "(T=64, H=8, D=64, f32): per-cell "
+                                    "VMEM is one block, NOT one fiber "
+                                    "— seq_len-independent by design",
+         "bytes": paged_vmem_bytes(64, 8, 64)},
     ]
 
 
@@ -723,3 +731,167 @@ def flash_attention(q, k, v, causal: bool = False, force: str | None = None):
     if force == "pallas":
         return _flash_diff(q, k, v, causal, False)
     return attention_xla(q, k, v, causal)
+
+
+# ---------------------------------------------------------------------------
+# Paged decode attention: one query token against a block-paged KV cache.
+# ---------------------------------------------------------------------------
+#
+# The serving decode path (serve/paged.py, ISSUE 19) stores K/V in
+# fixed-size blocks inside a shared [num_blocks, block_tokens, H, D]
+# pool; each slot owns a small int32 block TABLE instead of a contiguous
+# [seq_len] rectangle.  Attention then needs a block-GATHER: row b reads
+# the T-token blocks its table names, in table order, and runs the same
+# online-softmax recurrence the flash kernel uses — columns beyond the
+# row's current position are masked to -1e30 BEFORE the softmax, so
+# garbage in unwritten cache lines (the null block, a freed block's
+# stale contents, a neighbour slot's tokens) contributes exactly 0.0 and
+# every row's output is a pure function of its own (q, table, position).
+# That independence is the paged exactness gate: interleaved decode is
+# bitwise equal to decoding alone under the SAME compiled program.
+#
+# The pallas path DMAs each table-named block from ANY-space pools into
+# a VMEM scratch (PrefetchScalarGridSpec scalar-prefetches the tables so
+# the copy addresses are known before the body runs) — the kernel never
+# materializes the [B, MB*T, H, D] gather the XLA twin pays for.
+# Forward-only by design (decode is inference; no vjp), so unlike the
+# flash kernel there is no custom_vjp pairing.
+
+
+def paged_attention_xla(q, k_pool, v_pool, tables, positions):
+    """Gather-then-attend oracle for the paged decode step.
+
+    ``q`` [B, H, D] (one query token per slot), ``k_pool``/``v_pool``
+    [num_blocks, block_tokens, H, D], ``tables`` [B, MB] int32 pool
+    block ids in sequence order, ``positions`` [B] int32 absolute
+    position of each row's query token (row b attends to logical
+    columns 0..positions[b] inclusive).  Same stable-softmax f32 core
+    as :func:`attention_xla`."""
+    B, H, D = q.shape
+    T = k_pool.shape[1]
+    MB = tables.shape[1]
+    k = k_pool[tables].reshape(B, MB * T, H, D)
+    v = v_pool[tables].reshape(B, MB * T, H, D)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    s = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    cols = jnp.arange(MB * T, dtype=jnp.int32)
+    s = jnp.where(cols[None, None, :] <= positions[:, None, None],
+                  s, -1e30)
+    return jnp.einsum("bhs,bshd->bhd", jax.nn.softmax(s, axis=-1),
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _paged_kernel(block_tokens: int, blocks_per_slot: int, scale: float,
+                  tbl_ref, pos_ref, q_ref, kp_ref, vp_ref, o_ref):
+    """One grid cell = one slot row: walk the row's block table, DMA
+    each named K/V block from the ANY-space pools into VMEM scratch,
+    and fold it into the flash-style online-softmax carry."""
+    b = pl.program_id(0)
+    H, D = q_ref.shape[1], q_ref.shape[2]
+    q = q_ref[0].astype(jnp.float32)  # [H, D]
+
+    def body(kb, vb, sem):
+        def step(m, carry):
+            o_acc, mx, l = carry
+            blk = tbl_ref[b, m]
+            cp = pltpu.make_async_copy(kp_ref.at[blk], kb, sem)
+            cp.start()
+            cp.wait()
+            cp = pltpu.make_async_copy(vp_ref.at[blk], vb, sem)
+            cp.start()
+            cp.wait()
+            k = kb[...].astype(jnp.float32)  # [T, H, D]
+            v = vb[...].astype(jnp.float32)
+            s = jnp.einsum("hd,thd->ht", q, k) * scale
+            cols = m * block_tokens + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(cols <= pos_ref[b], s, -1e30)
+            m_new = jnp.maximum(mx, jnp.max(s, axis=1))
+            p = jnp.exp(s - m_new[:, None])
+            corr = jnp.exp(mx - m_new)
+            l_new = l * corr + jnp.sum(p, axis=1)
+            o_new = o_acc * corr[:, None] + jnp.einsum("ht,thd->hd", p, v)
+            return o_new, m_new, l_new
+
+        o0 = jnp.zeros((H, D), jnp.float32)
+        m0 = jnp.full((H,), -1e30, jnp.float32)
+        l0 = jnp.zeros((H,), jnp.float32)
+        o_acc, _, l = jax.lax.fori_loop(0, blocks_per_slot, step,
+                                        (o0, m0, l0))
+        # positions are clamped >= 0, so column 0 is always live and
+        # l > 0 for every row (idle slots included)
+        o_ref[0] = (o_acc / l[:, None]).astype(o_ref.dtype)
+
+    pl.run_scoped(
+        body,
+        kb=pltpu.VMEM((block_tokens, H, D), kp_ref.dtype),
+        vb=pltpu.VMEM((block_tokens, H, D), vp_ref.dtype),
+        sem=pltpu.SemaphoreType.DMA(()),
+    )
+
+
+def _paged_pallas(q, k_pool, v_pool, tables, positions,
+                  interpret: bool = False):
+    B, H, D = q.shape
+    T = k_pool.shape[1]
+    MB = tables.shape[1]
+    kernel = functools.partial(
+        _paged_kernel, T, MB, 1.0 / float(D) ** 0.5)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B,),
+            in_specs=[
+                pl.BlockSpec((1, H, D), lambda b, *_: (b, 0, 0)),
+                pl.BlockSpec(memory_space=pltpu.ANY),
+                pl.BlockSpec(memory_space=pltpu.ANY),
+            ],
+            out_specs=pl.BlockSpec((1, H, D), lambda b, *_: (b, 0, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        interpret=interpret,
+    )(tables, positions, q, k_pool, v_pool)
+
+
+def paged_attention(q, k_pool, v_pool, tables, positions,
+                    force: str | None = None):
+    """Paged decode attention dispatcher; ``force`` = 'pallas' |
+    'interpret' | 'xla' | None (None consults ``SPARKNET_PAGED_IMPL``,
+    default xla — the virtual CPU mesh twin and the exactness-gate
+    path).  Forward-only: the decode step never differentiates."""
+    import os
+
+    if force is None:
+        force = os.environ.get("SPARKNET_PAGED_IMPL", "xla")
+    if force == "xla" or not _HAS_PALLAS:
+        return paged_attention_xla(q, k_pool, v_pool, tables, positions)
+    if force == "interpret":
+        return _paged_pallas(q, k_pool, v_pool, tables, positions,
+                             interpret=True)
+    if force == "pallas":
+        return _paged_pallas(q, k_pool, v_pool, tables, positions,
+                             interpret=False)
+    return paged_attention_xla(q, k_pool, v_pool, tables, positions)
+
+
+def paged_vmem_bytes(block_tokens: int, heads: int, head_dim: int,
+                     itemsize: int = 4) -> int:
+    """Static VMEM bound for one ``_paged_kernel`` grid cell.  Unlike
+    the flash kernel's full-fiber K/V residency, the paged kernel keeps
+    exactly ONE [T, H, D] block of K and V resident (the run_scoped
+    scratch the DMA lands in), so the bound is linear in block_tokens
+    and INDEPENDENT of sequence length — the arithmetic form of "per
+    token decode work stops paying O(seq_len)".  Terms: q + o [1, H, D]
+    blocks (double-buffered by the pipeline, x2 each), the K/V scratch
+    at pool itemsize, and the f32 compute temporaries (k/v casts, the
+    s/p [H, T] score tiles, o_acc, and the m/l running stats)."""
+    hd = heads * head_dim
+    blocks = 2 * (2 * hd) * itemsize            # q + o, double-buffered
+    scratch = 2 * block_tokens * hd * itemsize  # kb + vb DMA landing
+    temps = (2 * block_tokens * hd              # k/v f32 casts
+             + 2 * heads * block_tokens         # s, p score tiles
+             + heads * head_dim                 # o_acc
+             + 4 * heads) * 4                   # m, l, m_new, corr
+    return blocks + scratch + temps

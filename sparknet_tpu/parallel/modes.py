@@ -642,6 +642,53 @@ def _mode_serve_replica(devices, width: int) -> TraceTarget:
     )
 
 
+DECODE_OCCUPANCIES = (1, 4)
+
+
+def _mode_decode_paged(devices, occupancy: int) -> TraceTarget:
+    """Occupancy-parameterized paged-decode twin (ISSUE 19): the EXACT
+    cached per-token step the ``PagedDecoder`` AOT-compiles
+    (``serve/paged.build_decode_program`` — one token per slot row,
+    K/V written through the block tables, attention via the block
+    gather).  Occupancy changes only the DATA (live tables/positions),
+    never a shape, so every occupancy twin must lower byte-identical —
+    that IS the shape-stability contract behind zero post-warmup
+    compiles at any admission churn.  Single chip, zero collectives;
+    the K/V pools are the carry (donated, returned first)."""
+    from sparknet_tpu.serve.paged import build_decode_program
+
+    fn, args, alt_args, meta = build_decode_program(occupancy)
+    return TraceTarget(
+        name=f"decode_paged_o{occupancy}", fn=fn,
+        args=args, alt_args=alt_args, meta=meta,
+        param_bytes=_tree_bytes(args[0].params),
+        state_bytes=_tree_bytes(args[0].state),
+        carry_argnums=(1, 2), carry_out_leaves=2,
+    )
+
+
+def _mode_decode_rect(devices) -> TraceTarget:
+    """The rectangle decode baseline (serve/continuous.py): the full
+    [slots, seq_len] forward the cacheless ``ContinuousDecoder`` pays
+    on EVERY emitted token — banked so the byte model prices the
+    paged-vs-rectangle A/B from manifests alone.  No carry (the
+    rectangle holds no device state between steps; that is the
+    point)."""
+    from sparknet_tpu.serve.paged import build_rect_program
+
+    fn, variables, feeds, alt_feeds = build_rect_program()
+    return TraceTarget(
+        name="decode_rect", fn=fn,
+        args=(variables, feeds),
+        alt_args=(variables, alt_feeds),
+        meta={"family": "charlm", "mesh": {}, "tau": 1,
+              "batch": int(feeds["data"].shape[0]), "dtype": "f32",
+              "layout": "nchw", "serve": True, "decode": "rect"},
+        param_bytes=_tree_bytes(variables.params),
+        state_bytes=_tree_bytes(variables.state),
+    )
+
+
 MODES: dict[str, Callable] = {
     "solo": _mode_solo,
     "solo_nhwc": _mode_solo_nhwc,
@@ -683,6 +730,14 @@ MODES.update({
     f"serve_r{w}": partial(_mode_serve_replica, width=w)
     for w in SERVE_REPLICA_WIDTHS
 })
+
+# occupancy-parameterized paged-decode twins (ISSUE 19) + the rectangle
+# baseline: equal-program-at-every-occupancy is the banked contract
+MODES.update({
+    f"decode_paged_o{o}": partial(_mode_decode_paged, occupancy=o)
+    for o in DECODE_OCCUPANCIES
+})
+MODES["decode_rect"] = _mode_decode_rect
 
 
 def list_modes() -> list[str]:

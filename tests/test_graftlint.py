@@ -37,6 +37,7 @@ EXPECTED_RULES = {
     "serve-manifest-fresh",
     "loop-manifest-fresh",
     "replica-manifest-fresh",
+    "paged-manifest-fresh",
     "queue-job-hygiene",
     "queue-policy-fields",
     "obs-fenced-span",
@@ -964,6 +965,88 @@ def test_replica_manifest_fresh_ignores_other_serve_files(tmp_path):
     other.write_text(FRESH_SRC)
     assert not hits(FRESH_SRC, "replica-manifest-fresh", path=str(other))
     assert not hits(FRESH_SRC, "replica-manifest-fresh")
+
+
+# -- paged-manifest-fresh ---------------------------------------------------
+
+
+def _paged_tree(tmp_path, record=True, covered=True, occupancies=(1, 4),
+                rect=True,
+                families=("graph_contracts", "mem_contracts",
+                          "byte_contracts")):
+    """A fake repo around serve/paged.py: SOURCES.json (optionally not
+    covering it) + decode_paged_o*.json occupancy twins and the
+    decode_rect.json baseline per family."""
+    import hashlib
+    import json as _json
+
+    rel = "sparknet_tpu/serve/paged.py"
+    mod = tmp_path / rel
+    mod.parent.mkdir(parents=True, exist_ok=True)
+    mod.write_text(FRESH_SRC)
+    digest = hashlib.sha256(FRESH_SRC.encode()).hexdigest()
+    for fam in families:
+        cdir = tmp_path / "docs" / fam
+        cdir.mkdir(parents=True, exist_ok=True)
+        if record:
+            entry = {rel: digest} if covered else {"other.py": digest}
+            (cdir / "SOURCES.json").write_text(_json.dumps(entry))
+        for o in occupancies:
+            (cdir / f"decode_paged_o{o}.json").write_text("{}")
+        if rect:
+            (cdir / "decode_rect.json").write_text("{}")
+    return str(mod)
+
+
+def test_paged_manifest_fresh_clean_when_banked(tmp_path):
+    path = _paged_tree(tmp_path)
+    assert not hits(FRESH_SRC, "paged-manifest-fresh", path=path)
+
+
+def test_paged_manifest_fresh_positive_when_never_banked(tmp_path):
+    path = _paged_tree(tmp_path, record=False, occupancies=(), rect=False)
+    found = hits(FRESH_SRC, "paged-manifest-fresh", path=path)
+    assert len(found) == 3  # one per family (graph + mem + byte)
+    assert "SOURCES.json missing" in found[0].message
+
+
+def test_paged_manifest_fresh_positive_when_not_folded_in(tmp_path):
+    # manifests exist but predate the paged layer: paged.py absent
+    # from the fingerprint — the silent-non-coverage hole
+    path = _paged_tree(tmp_path, covered=False)
+    found = hits(FRESH_SRC, "paged-manifest-fresh", path=path)
+    assert len(found) == 3
+    assert all("not folded into" in f.message for f in found)
+
+
+def test_paged_manifest_fresh_positive_below_min_occupancies(tmp_path):
+    path = _paged_tree(tmp_path, occupancies=(4,))
+    found = hits(FRESH_SRC, "paged-manifest-fresh", path=path)
+    assert len(found) == 3
+    assert all(">= 2" in f.message for f in found)
+
+
+def test_paged_manifest_fresh_positive_without_rect_baseline(tmp_path):
+    path = _paged_tree(tmp_path, rect=False)
+    found = hits(FRESH_SRC, "paged-manifest-fresh", path=path)
+    assert len(found) == 3
+    assert all("decode_rect" in f.message for f in found)
+
+
+def test_paged_manifest_fresh_suppressed(tmp_path):
+    path = _paged_tree(tmp_path, record=False, occupancies=(), rect=False)
+    src = ("# graftlint: disable-file=paged-manifest-fresh -- "
+           "manifest regen follows in this PR\n" + FRESH_SRC)
+    assert not hits(src, "paged-manifest-fresh", path=path)
+    assert suppressed_hits(src, "paged-manifest-fresh", path=path)
+
+
+def test_paged_manifest_fresh_ignores_other_serve_files(tmp_path):
+    other = tmp_path / "sparknet_tpu" / "serve" / "continuous.py"
+    other.parent.mkdir(parents=True, exist_ok=True)
+    other.write_text(FRESH_SRC)
+    assert not hits(FRESH_SRC, "paged-manifest-fresh", path=str(other))
+    assert not hits(FRESH_SRC, "paged-manifest-fresh")
 
 
 # -- loop-manifest-fresh ----------------------------------------------------
