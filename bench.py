@@ -238,6 +238,23 @@ def _build_step(batch: int, model: str, crop: int, dtype_name: str,
         solver_cfg = dataclasses.replace(solver_cfg, remat=True)
     elif remat_env not in ("", "0"):
         set_config(remat=remat_env)
+    # A/B knob: bf16 activation STORAGE with f32 compute
+    # (Config.activation_dtype) — the saved-activation round trip is
+    # the largest single slice of the train step's bytes and storage
+    # narrowing halves it without touching accumulation.  "bf16"
+    # resolves to the banked docs/num_contracts/mixed_policy.json
+    # winner (what `num --mixed` scored and error-gated); a policy
+    # name ("io", "blocks", "full") pins that policy directly, so the
+    # act_dtype_ab queue job measures exactly what the byte model
+    # scored.  Off by default — the default path is bit-identical to
+    # every banked manifest.
+    act_env = os.environ.get("SPARKNET_BENCH_ACT_DTYPE", "")
+    if act_env in ("bf16", "bfloat16"):
+        from sparknet_tpu.parallel.modes import _banked_act_policy
+
+        set_config(activation_dtype=_banked_act_policy(model))
+    elif act_env not in ("", "0", "f32"):
+        set_config(activation_dtype=act_env)
     solver = Solver(solver_cfg, net_param)
     if scan > 1:
         step, variables, slots, key = solver.jitted_scan_steps(scan, donate=True)
@@ -382,6 +399,12 @@ def measured_run(batch: int, iters: int, warmup: int, model: str, crop: int,
         # the legacy boolean = the "full" policy; names are Config.remat
         # policies out of docs/byte_contracts/remat_policy.json
         rec["remat"] = "full" if remat_env == "1" else remat_env
+    act_env = os.environ.get("SPARKNET_BENCH_ACT_DTYPE", "")
+    if act_env not in ("", "0", "f32"):
+        # A/B provenance (same rule as the remat stamp): stamp the
+        # RESOLVED policy — "bf16" rode the banked mixed_policy.json
+        # winner, so the record names what actually ran
+        rec["activation_dtype"] = get_config().activation_dtype
     # Window-runner provenance: which journaled dial (probe) this record
     # rode, so the judge can corroborate it against the tunnel log without
     # matching timestamps by hand (docs/evidence_r*/journal.jsonl).  Typed

@@ -26,6 +26,15 @@ Three engines share one front door and one findings schema:
   banking docs/byte_contracts/; ``--remat`` runs the chip-free
   remat/donation schedule search that banks the ``Config.remat``
   policy table).
+* ``num``   — numcheck, the static numerics-contract census (dtype
+  flow of every traced mode: matmul/conv accumulation, sum-reduction
+  operands, the cast census with round-trip detection, the f32 loss
+  pin — banking docs/num_contracts/; ``--mixed`` runs the chip-free
+  mixed-precision policy search that banks the
+  ``Config.activation_dtype`` table).
+* ``all``   — every engine above in sequence (lint, conc, graph, mem,
+  bytes, num), merged findings, one exit code — the single
+  pre-commit/CI front door.
 
 Exit codes (all subcommands): 0 clean (or suppressed-only), 1
 unsuppressed findings, 2 usage error.  ``--json`` (or the legacy
@@ -401,6 +410,150 @@ def bytes_main(argv: list[str] | None = None) -> int:
     return 1 if any(not f.suppressed for f in findings) else 0
 
 
+def num_main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m sparknet_tpu.analysis num",
+        description="numcheck: statically census each parallel mode's "
+        "dtype flow on the virtual CPU mesh (matmul/conv accumulation "
+        "dtypes, sum-reduction operands, the cast census with "
+        "round-trip detection, the f32 loss pin) and diff against the "
+        "banked manifests (docs/num_contracts/) — zero chip time.  "
+        "--mixed runs the chip-free mixed-precision policy search "
+        "instead: scores every Config.activation_dtype storage policy "
+        "per zoo family on the byte model, gates each on a "
+        "deterministic CPU error probe, and banks the bytes-minimal "
+        "safe winner (docs/num_contracts/mixed_policy.json)",
+    )
+    ap.add_argument("--mode", action="append", default=[],
+                    help="census only this mode (repeatable; default all)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--json", action="store_true",
+                    help="shorthand for --format json")
+    ap.add_argument("--update", action="store_true",
+                    help="rewrite the banked manifests (and SOURCES.json "
+                    "on a full run) instead of diffing against them")
+    ap.add_argument("--mixed", action="store_true",
+                    help="run the mixed-precision policy search instead "
+                    "of the per-mode census (banks docs/num_contracts/"
+                    "mixed_policy.json with --update)")
+    ap.add_argument("--family", action="append", default=[],
+                    help="--mixed: search only this zoo family "
+                    "(repeatable)")
+    ap.add_argument("--show-suppressed", action="store_true")
+    ap.add_argument("--list-modes", action="store_true",
+                    help="print the mode registry and exit")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the numerics-rule catalog and exit")
+    ap.add_argument("--devices", type=int, default=8,
+                    help="virtual CPU mesh width (default 8, the test "
+                    "harness mesh)")
+    args = ap.parse_args(argv)
+
+    from sparknet_tpu.analysis import numcheck
+
+    if args.list_rules:
+        for rule_id, summary in numcheck.iter_rules():
+            print(f"{rule_id}: {summary}")
+        return 0
+    if args.list_modes:
+        from sparknet_tpu.parallel.modes import list_modes
+
+        for name in list_modes():
+            print(name)
+        return 0
+
+    as_json = args.json or args.format == "json"
+    try:
+        if args.mixed:
+            progress = None if as_json else (
+                lambda f: print(f"numcheck: scoring {f} ...",
+                                file=sys.stderr))
+            findings, _ = numcheck.run_mixed_search(
+                update=args.update, families=args.family or None,
+                n_devices=args.devices, progress=progress)
+        else:
+            progress = None if as_json else (
+                lambda m: print(f"numcheck: censusing {m} ...",
+                                file=sys.stderr))
+            findings, _ = numcheck.run_numcheck(
+                args.mode or None, update=args.update,
+                n_devices=args.devices, progress=progress)
+    except KeyError as e:
+        print(e.args[0], file=sys.stderr)
+        return 2
+    if as_json:
+        print(render_json(findings))
+    else:
+        print(render_text(findings, show_suppressed=args.show_suppressed,
+                          label="numcheck"))
+        if args.update:
+            print(f"numcheck: manifests updated in "
+                  f"{os.path.relpath(numcheck.MANIFEST_DIR)}")
+    return 1 if any(not f.suppressed for f in findings) else 0
+
+
+def _all_engines() -> list:
+    """(label, runner) per engine, cheap-static first — module-level so
+    the smoke test can swap in stubs.  Each runner takes no args and
+    returns a findings list."""
+    from sparknet_tpu.analysis import (
+        bytecheck,
+        conccheck,
+        graphcheck,
+        memcheck,
+        numcheck,
+    )
+
+    return [
+        ("graftlint", lambda: lint_paths(default_paths())),
+        ("conccheck", lambda: conccheck.run_conccheck()[0]),
+        ("graphcheck", lambda: graphcheck.run_graphcheck(None)[0]),
+        ("memcheck", lambda: memcheck.run_memcheck(None)[0]),
+        ("bytecheck", lambda: bytecheck.run_bytecheck(None)[0]),
+        ("numcheck", lambda: numcheck.run_numcheck(None)[0]),
+    ]
+
+
+def all_main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m sparknet_tpu.analysis all",
+        description="run every analysis engine (graftlint, conccheck, "
+        "graphcheck, memcheck, bytecheck, numcheck) in sequence — "
+        "merged findings, one exit code.  The single pre-commit/CI "
+        "front door; each engine stays individually invocable for "
+        "focused runs",
+    )
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--json", action="store_true",
+                    help="shorthand for --format json")
+    ap.add_argument("--show-suppressed", action="store_true")
+    args = ap.parse_args(argv)
+
+    as_json = args.json or args.format == "json"
+    merged: list = []
+    failed: list[str] = []
+    for label, runner in _all_engines():
+        if not as_json:
+            print(f"analysis all: running {label} ...", file=sys.stderr)
+        try:
+            found = runner()
+        except Exception as e:  # an engine crash must not mask the rest
+            failed.append(label)
+            print(f"analysis all: {label} CRASHED: {e}", file=sys.stderr)
+            continue
+        merged.extend(found)
+    if as_json:
+        print(render_json(merged))
+    else:
+        print(render_text(merged, show_suppressed=args.show_suppressed,
+                          label="analysis all"))
+        if failed:
+            print(f"analysis all: engine crash(es): {', '.join(failed)}")
+    if failed:
+        return 1
+    return 1 if any(not f.suppressed for f in merged) else 0
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "graph":
@@ -411,6 +564,10 @@ def main(argv: list[str] | None = None) -> int:
         return bytes_main(argv[1:])
     if argv and argv[0] == "conc":
         return conc_main(argv[1:])
+    if argv and argv[0] == "num":
+        return num_main(argv[1:])
+    if argv and argv[0] == "all":
+        return all_main(argv[1:])
     if argv and argv[0] == "lint":
         return lint_main(argv[1:])
     # legacy invocation: bare paths/flags mean lint

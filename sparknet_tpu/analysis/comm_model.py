@@ -102,8 +102,10 @@ def expected_comm(mode: str, *, param_bytes: int, state_bytes: int = 0,
     modes — a new parallel mode must state its communication contract
     here before it can bank a manifest."""
     # solo_remat shares solo's contract: rematerialization recomputes
-    # on-chip, it never creates a wire
-    if mode in ("solo", "solo_nhwc", "solo_fused", "solo_remat"):
+    # on-chip, it never creates a wire.  solo_act_bf16 likewise:
+    # activation storage narrows on-chip residency, never a wire.
+    if mode in ("solo", "solo_nhwc", "solo_fused", "solo_remat",
+                "solo_act_bf16"):
         return CommExpectation(
             required={},
             forbidden=COLLECTIVE_KINDS,
@@ -129,8 +131,10 @@ def expected_comm(mode: str, *, param_bytes: int, state_bytes: int = 0,
     # the nhwc layout (ops/layout.py), so the grad all-reduce moves the
     # same bytes — a layout that changed this block would be a bug.
     # dp_remat likewise: recompute changes what the backward reads,
-    # not what the mesh reduces.
-    if mode in ("dp", "dp_bf16", "mobilenet_dp", "dp_nhwc", "dp_remat"):
+    # not what the mesh reduces.  dp_act_bf16 likewise: bf16 storage
+    # narrows saved activations, grads stay f32 param-sized.
+    if mode in ("dp", "dp_bf16", "mobilenet_dp", "dp_nhwc", "dp_remat",
+                "dp_act_bf16"):
         return CommExpectation(
             required={"all-reduce": _window(param_bytes, state_bytes)},
             forbidden=("all-to-all", "collective-permute", "all-gather"),

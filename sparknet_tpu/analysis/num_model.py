@@ -1,0 +1,307 @@
+"""Numerics model: precision classes, contract rules, mixed-policy math.
+
+The stdlib half of numcheck (the sixth analysis engine), mirroring
+``byte_model.py``'s split: everything here is pure arithmetic over
+plain dicts so the defect-fixture tests and the graftlint rule can run
+without importing jax.  ``numcheck.py`` walks real jaxprs into the
+record schema below; this module classifies the records and decides
+what is a finding.
+
+Record schema (one census per traced mode):
+
+* ``matmuls``: ``{"op", "operands": [dt...], "out": dt,
+  "preferred": dt|None}`` — one per dot_general / conv_general_dilated
+  eqn.  The ACCUMULATION dtype is ``preferred`` when set, else the
+  result dtype (XLA's convention: no preferred_element_type means the
+  MXU accumulates at the result type's precision contract).
+* ``reduces``: ``{"op", "operand": dt, "out": dt}`` — one per
+  reduction eqn; sum-like ops (reduce_sum, reduce_window_sum, cumsum,
+  reduce_prod) are the ones where a narrow accumulator loses bits,
+  max/min reductions are rounding-free.
+* ``casts``: ``{"src": dt, "dst": dt, "roundtrip": bool}`` — one per
+  convert_element_type eqn; ``roundtrip`` marks the silent
+  double-rounding shape (narrow -> f32 -> same narrow with the f32
+  intermediate consumed ONLY by the second cast — no compute between,
+  so the round trip is pure precision loss).
+* ``loss_dtype``: dtype of the program's final scalar float output
+  (the loss), or None for forward-only programs.
+
+Mixed-precision policy model (the ``num --mixed`` search): activation
+STORAGE policies ``none``/``io``/``blocks``/``full`` discount the
+step's saved-activation bytes analytically — bf16 storage halves
+exactly the tensors the policy stores — and the discounted figure
+rides ``byte_model.step_traffic`` unchanged, so the banked step-bytes
+are directly comparable to the remat table's.
+"""
+
+from __future__ import annotations
+
+# Canonical activation-storage policies in ascending storage-narrowing
+# order (the search enumerates these; partial order for monotonicity:
+# none >= io >= full and none >= blocks >= full on saved bytes).
+ACT_SEARCH_POLICIES = ("none", "io", "blocks", "full")
+
+# The single activation-storage dtype the search scores today — a
+# dimension, not a constant, so an f8 arm slots in without reshaping
+# the banked table.
+ACT_DTYPES = ("bf16",)
+
+# the selected policy must drop the headline family's modeled step
+# bytes by at least this fraction vs the f32-activation baseline
+# (ISSUE 20 acceptance: >= 15%)
+MIXED_DROP_FLOOR = 0.15
+
+# error-probe gate: max of the loss relative error and the global
+# gradient relative l2 of the mixed arm vs the f32 baseline on fixed
+# seeds must stay under the family's gate for a policy to be
+# selectable
+ERROR_GATE_DEFAULT = 0.05
+ERROR_GATES: dict[str, float] = {}
+
+# dtype name normalization: jax/numpy spellings -> the short names the
+# manifests bank (unknown names pass through untouched)
+_DTYPE_SHORT = {
+    "float64": "f64", "float32": "f32", "float16": "f16",
+    "bfloat16": "bf16", "float8_e4m3fn": "f8_e4m3",
+    "float8_e5m2": "f8_e5m2", "float8_e4m3b11fnuz": "f8_e4m3b11",
+    "int64": "s64", "int32": "s32", "int16": "s16", "int8": "s8",
+    "uint64": "u64", "uint32": "u32", "uint16": "u16", "uint8": "u8",
+    "bool": "pred", "complex64": "c64", "complex128": "c128",
+}
+
+_FLOAT_WIDTHS = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2,
+                 "f8_e4m3": 1, "f8_e5m2": 1, "f8_e4m3b11": 1}
+
+# reductions that ACCUMULATE (a narrow accumulator loses bits); max/min
+# style reductions are order-free selections and rounding never
+# compounds
+SUM_REDUCE_OPS = frozenset({
+    "reduce_sum", "reduce_prod", "reduce_window_sum", "cumsum",
+    "cumprod", "cumlogsumexp",
+})
+
+
+def normalize_dtype(name: str) -> str:
+    """Short canonical dtype name ("float32" -> "f32"); unknown names
+    pass through lowercased so a new dtype shows up in the banked
+    census instead of vanishing."""
+    n = str(name).lower()
+    return _DTYPE_SHORT.get(n, n)
+
+
+def is_float(dt: str) -> bool:
+    return normalize_dtype(dt) in _FLOAT_WIDTHS
+
+
+def is_narrow_float(dt: str) -> bool:
+    """A floating dtype narrower than f32 — the storage dtypes whose
+    use as an ACCUMULATOR is what the contracts forbid."""
+    return _FLOAT_WIDTHS.get(normalize_dtype(dt), 4) < 4
+
+
+def accum_dtype(rec: dict) -> str:
+    """The accumulation dtype of one matmul record: the explicit
+    ``preferred_element_type`` when the program pinned one, else the
+    result dtype."""
+    return normalize_dtype(rec.get("preferred") or rec.get("out") or "f32")
+
+
+def storage_config(meta: dict) -> bool:
+    """True when the mode runs bf16 activation STORAGE under f32
+    compute — the configuration whose whole design contract is "every
+    compute op upcasts first", so any narrow operand reaching a
+    dot/conv/sum-reduce is a missed upcast."""
+    return bool(meta.get("act")) and meta.get("dtype", "f32") == "f32"
+
+
+def summarize_census(census: dict) -> dict:
+    """Aggregate one mode's raw records into the banked contract block
+    (counts only — drift-diff stable across runs of the same
+    program)."""
+    matmuls = census.get("matmuls", [])
+    reduces = census.get("reduces", [])
+    casts = census.get("casts", [])
+    by_accum: dict[str, int] = {}
+    for r in matmuls:
+        a = accum_dtype(r)
+        by_accum[a] = by_accum.get(a, 0) + 1
+    pairs: dict[str, int] = {}
+    for c in casts:
+        k = f"{normalize_dtype(c['src'])}->{normalize_dtype(c['dst'])}"
+        pairs[k] = pairs.get(k, 0) + 1
+    return {
+        "matmul": {
+            "total": len(matmuls),
+            "by_accum": by_accum,
+            "narrow_accum": sum(
+                1 for r in matmuls if is_narrow_float(accum_dtype(r))),
+            "narrow_operand": sum(
+                1 for r in matmuls
+                if any(is_narrow_float(d) for d in r.get("operands", []))),
+        },
+        "reduce": {
+            "sum_total": sum(
+                1 for r in reduces if r["op"] in SUM_REDUCE_OPS),
+            "sum_narrow_operand": sum(
+                1 for r in reduces if r["op"] in SUM_REDUCE_OPS
+                and is_narrow_float(r.get("operand", "f32"))),
+            "other_total": sum(
+                1 for r in reduces if r["op"] not in SUM_REDUCE_OPS),
+        },
+        "cast": {
+            "pairs": pairs,
+            "roundtrips": sum(1 for c in casts if c.get("roundtrip")),
+            "float_downcasts": sum(
+                1 for c in casts
+                if normalize_dtype(c["src"]) == "f32"
+                and is_narrow_float(c["dst"])),
+        },
+        "loss_dtype": census.get("loss_dtype"),
+    }
+
+
+def census_problems(census: dict, meta: dict) -> list:
+    """The numerics contracts over one mode's raw records.  Returns
+    ``{"rule", "message"}`` dicts — one per offending op, so a seeded
+    single-defect fixture produces exactly one finding."""
+    problems: list = []
+    storage = storage_config(meta)
+    narrow_compute = meta.get("dtype", "f32") != "f32"
+
+    for i, r in enumerate(census.get("matmuls", [])):
+        acc = accum_dtype(r)
+        # narrow-COMPUTE arms (dp_bf16) accumulate at the compute dtype
+        # by design — the MXU-rate trade the mode exists to make; their
+        # by_accum counts are drift-pinned in the manifest instead of
+        # flagged.  Everywhere else an explicit sub-f32 accumulator is
+        # a contract violation outright.
+        if (not narrow_compute
+                and r.get("preferred") and is_narrow_float(r["preferred"])):
+            problems.append({
+                "rule": "num-accum-dtype",
+                "message": f"matmul #{i} ({r.get('op')}) pins an "
+                           f"explicit {normalize_dtype(r['preferred'])} "
+                           f"accumulator (preferred_element_type) — "
+                           f"accumulation must be >= f32",
+            })
+        elif storage and any(is_narrow_float(d)
+                             for d in r.get("operands", [])):
+            problems.append({
+                "rule": "num-accum-dtype",
+                "message": f"matmul #{i} ({r.get('op')}) consumes "
+                           f"{'/'.join(map(normalize_dtype, r['operands']))} "
+                           f"operands under a bf16-storage config (accum "
+                           f"{acc}) — the layer-entry upcast was skipped, "
+                           f"so accumulation rides the narrow storage "
+                           f"dtype",
+            })
+
+    if storage:
+        for i, r in enumerate(census.get("reduces", [])):
+            if (r["op"] in SUM_REDUCE_OPS
+                    and is_narrow_float(r.get("operand", "f32"))):
+                problems.append({
+                    "rule": "num-reduce-dtype",
+                    "message": f"reduce #{i} ({r['op']}) accumulates a "
+                               f"{normalize_dtype(r['operand'])} operand "
+                               f"under a bf16-storage config — "
+                               f"sum-reductions must accumulate >= f32",
+                })
+
+    for i, c in enumerate(census.get("casts", [])):
+        src = normalize_dtype(c["src"])
+        dst = normalize_dtype(c["dst"])
+        if c.get("roundtrip"):
+            problems.append({
+                "rule": "num-cast-roundtrip",
+                "message": f"cast #{i}: {dst}->{src}->{dst} round-trip "
+                           f"with no compute between the casts — silent "
+                           f"double rounding, the f32 hop buys nothing",
+            })
+        elif (src == "f32" and is_narrow_float(dst)
+              and not storage and not narrow_compute
+              and not meta.get("act")):
+            problems.append({
+                "rule": "num-cast-downcast",
+                "message": f"cast #{i}: f32->{dst} downcast in a mode "
+                           f"with no bf16 arm configured (dtype f32, no "
+                           f"activation-storage policy) — a smuggled "
+                           f"precision loss",
+            })
+
+    loss_dt = census.get("loss_dtype")
+    if loss_dt is not None and normalize_dtype(loss_dt) != "f32":
+        problems.append({
+            "rule": "num-f32-pin",
+            "message": f"the program's scalar loss output is "
+                       f"{normalize_dtype(loss_dt)} — loss accumulation "
+                       f"is pinned f32 in every config",
+        })
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# Mixed-precision policy arithmetic (the `num --mixed` search)
+# ---------------------------------------------------------------------------
+
+
+def mixed_saved_bytes(saved_bytes: int, boundary_bytes: int,
+                      feed_bytes: int, policy: str) -> int:
+    """Modeled saved-activation bytes under one storage policy, from
+    the f32 baseline census: bf16 storage halves exactly the tensors
+    the policy stores.  ``boundary_bytes``: f32 bytes of the
+    pooling-boundary outputs (what "blocks" stores);  ``feed_bytes``:
+    f32 bytes of the floating feed blobs (what "io" adds).  "full"
+    stores every saved activation, so its floor is half the baseline.
+    Discounts clamp at the "full" floor — the partial policies can
+    never model BELOW the policy that stores strictly more."""
+    if policy == "none":
+        return int(saved_bytes)
+    full = int(saved_bytes) // 2
+    if policy == "full":
+        return full
+    if policy == "io":
+        return max(full, int(saved_bytes) - int(feed_bytes) // 2)
+    if policy == "blocks":
+        return max(full, int(saved_bytes) - int(boundary_bytes) // 2)
+    raise ValueError(f"unknown activation-storage policy {policy!r} "
+                     f"(want one of {ACT_SEARCH_POLICIES})")
+
+
+# partial order on storage coverage: the right policy stores at least
+# what the left one stores, so it must never model MORE saved bytes
+_ACT_ORDER = (("none", "io"), ("none", "blocks"), ("io", "full"),
+              ("blocks", "full"))
+
+
+def act_monotonicity_violations(saved_by_policy: dict) -> list:
+    """Pairs (lighter, heavier) where the heavier-storage policy models
+    MORE saved bytes than the lighter one — the coverage partial order
+    is violated, so the scores cannot rank policies."""
+    bad = []
+    for lighter, heavier in _ACT_ORDER:
+        if lighter in saved_by_policy and heavier in saved_by_policy:
+            if saved_by_policy[heavier] > saved_by_policy[lighter]:
+                bad.append((lighter, heavier))
+    return bad
+
+
+def error_gate(family: str) -> float:
+    """The per-family error-probe bound a policy must pass to be
+    selectable."""
+    return ERROR_GATES.get(family, ERROR_GATE_DEFAULT)
+
+
+def selected_act_policy(table: dict, family: str,
+                        act_dtype: str = "bf16",
+                        default: str = "blocks") -> str:
+    """The banked winner for (family, act_dtype) out of a
+    ``mixed_policy.json`` table, with a deterministic fallback for
+    absent/partial tables (first bank of a fresh clone).  Consumers:
+    ``parallel/modes._banked_act_policy`` (the act twins) and
+    bench.py's ``SPARKNET_BENCH_ACT_DTYPE`` arm."""
+    try:
+        policy = table["selected"][family][act_dtype]["policy"]
+    except (KeyError, TypeError):
+        return default
+    return policy if policy in ACT_SEARCH_POLICIES else default

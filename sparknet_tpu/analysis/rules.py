@@ -1282,6 +1282,100 @@ def check_byte_manifest_fresh(ctx: ModuleContext) -> Iterator[tuple[int, str]]:
 
 
 # ---------------------------------------------------------------------------
+# num-manifest-fresh
+# ---------------------------------------------------------------------------
+
+# the numerics-contract source surface: editing any of these changes
+# what numcheck censuses (the dtype flow of the traced programs, the
+# activation-storage cast sites, the policy semantics in common.py, or
+# the classification rules themselves) so the banked
+# docs/num_contracts/ manifests — per-mode census AND the mixed-policy
+# table Config.activation_dtype consumers read — must be regenerated
+# in the same PR (kept in sync with numcheck.NUM_SOURCE_PATTERNS —
+# spelled out here too so this module stays importable without
+# numcheck)
+_NUM_SOURCE_DIRS = (
+    "sparknet_tpu/parallel/",
+    "sparknet_tpu/serve/",
+)
+_NUM_SOURCE_FILES = (
+    "sparknet_tpu/models/zoo.py",
+    "sparknet_tpu/compiler/graph.py",
+    "sparknet_tpu/common.py",
+    "sparknet_tpu/ops/pallas_kernels.py",
+    "sparknet_tpu/ops/layout.py",
+    "sparknet_tpu/solvers/solver.py",
+    "sparknet_tpu/solvers/updates.py",
+    "sparknet_tpu/analysis/numcheck.py",
+    "sparknet_tpu/analysis/num_model.py",
+    "sparknet_tpu/analysis/byte_model.py",
+    "sparknet_tpu/analysis/memcheck.py",
+    "sparknet_tpu/analysis/mem_model.py",
+)
+_NUM_REGEN = ("regenerate with `python -m sparknet_tpu.analysis num "
+              "--update` (+ `--mixed --update` for the policy table)")
+
+
+def _num_source_rel(path: str) -> tuple[str, str] | None:
+    """(repo_root, repo_relative_path) when ``path`` is part of the
+    numerics-contract source surface, else None."""
+    norm = os.path.abspath(path).replace(os.sep, "/")
+    idx = norm.rfind("/sparknet_tpu/")
+    if idx < 0:
+        return None
+    root, rel = norm[:idx], norm[idx + 1:]
+    if rel.startswith(_NUM_SOURCE_DIRS) or rel in _NUM_SOURCE_FILES:
+        return root, rel
+    return None
+
+
+@rule(
+    "num-manifest-fresh",
+    "a PR touching the numerics-contract surface (parallel/, serve/, "
+    "compiler/graph.py, common.py, models/zoo.py, ops/, solvers/, or "
+    "numcheck itself) must regenerate the docs/num_contracts/ "
+    "manifests",
+)
+def check_num_manifest_fresh(ctx: ModuleContext) -> Iterator[tuple[int, str]]:
+    """The num manifests are the repo's precision contract: every
+    traced mode's accumulation/reduction/cast census is drift-pinned,
+    and the mixed-policy table is what ``Config.activation_dtype``
+    actually routes (parallel/modes._banked_act_policy).  A stale
+    table silently stores yesterday's precision.  ``num --update``
+    banks a sha256 per source file in ``docs/num_contracts/
+    SOURCES.json``; this rule re-hashes the linted source and flags
+    any mismatch — the byte-manifest-fresh mechanism on the dtype
+    surface.  Blind spot: an edit that reverts to the banked census
+    passes (correctly — the censused programs are the banked ones
+    again)."""
+    hit = _num_source_rel(ctx.path)
+    if hit is None:
+        return
+    root, rel = hit
+    src = os.path.join(root, "docs", "num_contracts", "SOURCES.json")
+    if not os.path.exists(src):
+        yield (1, f"{rel} is numerics-contract source but no manifests "
+                  f"are banked (docs/num_contracts/SOURCES.json "
+                  f"missing) — {_NUM_REGEN}")
+        return
+    try:
+        with open(src, encoding="utf-8") as f:
+            recorded = json.load(f)
+    except (OSError, ValueError):
+        yield (1, f"docs/num_contracts/SOURCES.json unreadable — "
+                  f"{_NUM_REGEN}")
+        return
+    want = recorded.get(rel)
+    digest = hashlib.sha256(ctx.source.encode("utf-8")).hexdigest()
+    if want is None:
+        yield (1, f"{rel} is new numerics-contract source not covered "
+                  f"by the banked manifests — {_NUM_REGEN}")
+    elif want != digest:
+        yield (1, f"{rel} changed since the num manifests were banked "
+                  f"— {_NUM_REGEN}")
+
+
+# ---------------------------------------------------------------------------
 # ctl-manifest-fresh
 # ---------------------------------------------------------------------------
 

@@ -113,6 +113,23 @@ class Config:
     # ``SPARKNET_REMAT`` seeds it, the bench A/B flips it via
     # ``SPARKNET_BENCH_REMAT``.
     remat: str = os.environ.get("SPARKNET_REMAT", "").lower()
+    # Activation STORAGE policy for the forward graph (ROADMAP item 5's
+    # bf16-storage-with-f32-accumulation lever, scored chip-free by the
+    # numcheck mixed-precision search): ``""`` (default — off, every
+    # traced program byte-identical to the banked manifests), ``"io"``
+    # (feed blobs stored bf16), ``"blocks"`` (pooling-boundary outputs
+    # stored bf16 — the same boundaries remat's "blocks" policy saves,
+    # so the two compose into "save less, and save it half-width"), or
+    # ``"full"`` (every non-loss layer output stored bf16).  Storage
+    # only: every layer UPCASTS its inputs to ``compute_dtype`` before
+    # compute, so dot/conv/reduce accumulation stays f32 and loss/BN
+    # statistics stay pinned f32 (the numcheck contracts).  The banked
+    # winner per family lives in ``docs/num_contracts/
+    # mixed_policy.json``.  Read at TRACE time like every Config field;
+    # ``SPARKNET_ACT_DTYPE`` seeds it ("bf16" aliases to the "blocks"
+    # banked-winner shape), the bench A/B flips it via
+    # ``SPARKNET_BENCH_ACT_DTYPE``.
+    activation_dtype: str = os.environ.get("SPARKNET_ACT_DTYPE", "").lower()
     # Default mesh axis names: data parallelism over 'data', within-layer
     # (tensor) sharding over 'model', sequence/context parallelism over
     # 'seq' (ring / Ulysses attention).
@@ -138,6 +155,32 @@ TPU_PEAK_FLOPS = {
 
 # v5e HBM bandwidth (public spec), the bytes term of the same rooflines.
 V5E_HBM_BYTES_S = 819e9
+
+# Canonical Config.activation_dtype policies and the spellings that
+# normalize into them (set_config and compiler/graph.py share these so
+# a raw SPARKNET_ACT_DTYPE seed and a set_config call agree).  "bf16"
+# aliases to "blocks" — the deterministic shape of the banked winner
+# consumers without table access (set_config cannot read
+# docs/num_contracts/mixed_policy.json) fall back to; bench.py resolves
+# the actual banked policy before seeding.
+ACT_POLICIES = ("", "io", "blocks", "full")
+ACT_POLICY_ALIASES = {"none": "", "off": "", "f32": "", "float32": "",
+                      "bf16": "blocks", "bfloat16": "blocks"}
+
+
+def act_storage_policy(value: str | None = None) -> str:
+    """Normalize an ``activation_dtype`` spelling to its canonical
+    policy (default: the current config's), raising on unknowns — the
+    single read path for trace-time consumers, so an unvalidated env
+    seed can never silently half-apply."""
+    raw = get_config().activation_dtype if value is None else value
+    ap = ACT_POLICY_ALIASES.get(str(raw).lower(), str(raw).lower())
+    if ap not in ACT_POLICIES:
+        raise ValueError(f"unknown activation_dtype policy {raw!r} "
+                         f"(want one of {ACT_POLICIES} or an alias "
+                         f"{tuple(ACT_POLICY_ALIASES)})")
+    return ap
+
 
 _lock = named_lock("common._lock")
 _config = Config()
@@ -182,6 +225,16 @@ def set_config(**overrides) -> Config:
                 f"remat must be one of '', 'full', 'dots', 'blocks', got "
                 f"{overrides['remat']!r}")
         overrides = {**overrides, "remat": rp}
+    if "activation_dtype" in overrides:
+        ap = str(overrides["activation_dtype"]).lower()
+        ap = ACT_POLICY_ALIASES.get(ap, ap)
+        if ap not in ACT_POLICIES:
+            raise ValueError(
+                f"activation_dtype must be one of '', 'io', 'blocks', "
+                f"'full' (or an alias: none/off/f32/float32 -> '', "
+                f"bf16/bfloat16 -> 'blocks'), got "
+                f"{overrides['activation_dtype']!r}")
+        overrides = {**overrides, "activation_dtype": ap}
     with _lock:
         _config = dataclasses.replace(_config, **overrides)
     return _config

@@ -26,7 +26,12 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 
-from sparknet_tpu.common import Phase, get_config, layer_key
+from sparknet_tpu.common import (
+    Phase,
+    act_storage_policy,
+    get_config,
+    layer_key,
+)
 from sparknet_tpu.ops import create_layer
 from sparknet_tpu.ops.base import Layer, ParamSpec
 from sparknet_tpu.ops.data_layers import InputLayer
@@ -406,6 +411,14 @@ class Network:
         # emitted and the traced program is byte-identical to the
         # banked manifests
         tag_blocks = get_config().remat == "blocks"
+        # bf16 activation STORAGE (Config.activation_dtype, default off):
+        # the named boundaries store bf16, but every layer upcasts its
+        # inputs to compute_dtype before compute — accumulation stays
+        # f32, loss/BN statistics stay pinned f32 (the numcheck
+        # contracts).  Off path takes none of the branches below: the
+        # traced program is byte-identical to the banked manifests.
+        act_policy = act_storage_policy()
+        act_store_io = act_policy in ("io", "full")
 
         def _cast(x, dt):
             return (
@@ -422,11 +435,15 @@ class Network:
             # strict input-feed contract below
             for name, val in feeds.items():
                 blob[name] = _cast(val, cdt) if mixed else val
+                if act_store_io:
+                    blob[name] = _cast(blob[name], jnp.bfloat16)
         else:
             for name in self.feed_blobs:
                 if name not in feeds:
                     raise ValueError(f"missing feed for input blob {name!r}")
                 blob[name] = _cast(feeds[name], cdt) if mixed else feeds[name]
+                if act_store_io:
+                    blob[name] = _cast(blob[name], jnp.bfloat16)
         new_state: State = {}
         total_loss = jnp.zeros((), jnp.float32)
         for idx, layer in enumerate(self.layers):
@@ -449,16 +466,28 @@ class Network:
                     "them or start the run at an earlier layer"
                 )
             ins = [blob[b] for b in layer.bottoms]
-            if mixed:
+            if mixed or act_policy:
                 if layer.IS_LOSS:
                     ins = [_cast(x, jnp.float32) for x in ins]
                 else:
-                    p = [_cast(x, cdt) for x in p]
+                    if mixed:
+                        p = [_cast(x, cdt) for x in p]
+                    if act_policy:
+                        # upcast stored-bf16 inputs back to the compute
+                        # dtype: storage is the only thing that narrows
+                        ins = [_cast(x, cdt) for x in ins]
             # the scope lands in HLO op metadata, letting profiler traces
             # attribute fused-op time back to prototxt layers (tpunet
             # time --trace); '/' would nest scopes, so flatten it
             with jax.named_scope("L." + layer.name.replace("/", ".")):
                 out = layer.apply(p, s, ins, train=train, rng=sub)
+            if act_policy and not layer.IS_LOSS and (
+                    act_policy == "full"
+                    or (act_policy == "blocks" and layer.type == "Pooling")):
+                # storage cast BEFORE the checkpoint_name tag so a
+                # composed remat="blocks" run saves the bf16 tensor
+                out = dataclasses.replace(out, outputs=[
+                    _cast(o, jnp.bfloat16) for o in out.outputs])
             if tag_blocks and layer.type == "Pooling":
                 from jax.ad_checkpoint import checkpoint_name
 

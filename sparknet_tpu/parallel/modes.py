@@ -35,7 +35,12 @@ single-chip, forward-only, zero collectives), and
 bytes-minimal ``Config.remat`` policy from
 ``docs/byte_contracts/remat_policy.json`` routed through the same
 build, identical comm contracts; they exist to prove the byte model's
-modeled saved-activation drop lowers as predicted).
+modeled saved-activation drop lowers as predicted), and
+``solo_act_bf16``/``dp_act_bf16`` (the activation-storage twins — the
+banked bytes-minimal safe ``Config.activation_dtype`` policy from
+``docs/num_contracts/mixed_policy.json`` routed the same way; they
+prove the numcheck mixed-precision search's bf16-storage-with-f32-
+accumulation schedule lowers as predicted).
 """
 
 from __future__ import annotations
@@ -149,6 +154,7 @@ def _trainer_target(name: str, family_name: str, mesh, *, tau: int = 1,
                     elastic_alpha: float = 0.0, per_device_batch: int = 2,
                     rules=None, compute_dtype=None, layout=None,
                     fused: bool = False, remat: str | None = None,
+                    act: str | None = None,
                     expects_sharded_params: bool = False) -> TraceTarget:
     """The shared trainer-mode factory: construct Solver+ParallelTrainer
     exactly as the dryrun does, stop at the jitted round function.
@@ -157,7 +163,9 @@ def _trainer_target(name: str, family_name: str, mesh, *, tau: int = 1,
     Solver with the one-pass arena update (Config.fused_update).
     ``remat``: rematerialization policy (Config.remat) for the whole
     build+trace — the dp_remat twin routes the banked byte-minimal
-    policy here."""
+    policy here.  ``act``: activation-storage policy
+    (Config.activation_dtype) — the dp_act_bf16 twin routes the banked
+    numcheck mixed-policy winner here."""
     from sparknet_tpu.common import get_config, set_config
     from sparknet_tpu.models.zoo import GRAPH_SWEEP_FAMILIES
     from sparknet_tpu.parallel.trainer import ParallelTrainer
@@ -179,6 +187,8 @@ def _trainer_target(name: str, family_name: str, mesh, *, tau: int = 1,
             overrides["fused_update"] = True
         if remat is not None:
             overrides["remat"] = remat
+        if act is not None:
+            overrides["activation_dtype"] = act
         if not overrides:
             yield
             return
@@ -233,6 +243,8 @@ def _trainer_target(name: str, family_name: str, mesh, *, tau: int = 1,
     }
     if remat is not None:
         meta["remat"] = remat
+    if act is not None:
+        meta["act"] = act
     if fused:
         meta["fused"] = True
         # the comm model's hi bound prices the PADDED arena (GSPMD may
@@ -268,7 +280,8 @@ def _trainer_target(name: str, family_name: str, mesh, *, tau: int = 1,
 
 def _mode_solo(devices, layout: str | None = None,
                name: str = "solo", fused: bool = False,
-               remat: str | None = None) -> TraceTarget:
+               remat: str | None = None,
+               act: str | None = None) -> TraceTarget:
     """Single-chip Solver step — the negative control (no mesh, so the
     lowered program must contain ZERO collectives) and the donation
     audit's original catch: ``Solver._train_step`` shipped undonated
@@ -278,7 +291,9 @@ def _mode_solo(devices, layout: str | None = None,
     ``fused=True`` builds the one-pass-update twin (mode solo_fused),
     whose manifest pins the arena update block; ``remat`` builds the
     rematerialization twin (mode solo_remat) under the given
-    Config.remat policy."""
+    Config.remat policy; ``act`` builds the activation-storage twin
+    (mode solo_act_bf16) under the given Config.activation_dtype
+    policy."""
     from sparknet_tpu.common import get_config, set_config
     from sparknet_tpu.models.zoo import GRAPH_SWEEP_FAMILIES
     from sparknet_tpu.solvers.solver import Solver
@@ -295,6 +310,8 @@ def _mode_solo(devices, layout: str | None = None,
             overrides["fused_update"] = True
         if remat is not None:
             overrides["remat"] = remat
+        if act is not None:
+            overrides["activation_dtype"] = act
         if not overrides:
             yield
             return
@@ -316,6 +333,8 @@ def _mode_solo(devices, layout: str | None = None,
             "batch": B, "dtype": "f32", "layout": layout or "nchw"}
     if remat is not None:
         meta["remat"] = remat
+    if act is not None:
+        meta["act"] = act
     if fused:
         meta["fused"] = True
         meta["arena_bytes"] = solver._arena.total_bytes
@@ -421,6 +440,51 @@ def _mode_dp_remat(devices) -> TraceTarget:
     return _trainer_target("dp_remat", "cifar10_quick",
                            _data_mesh(devices),
                            remat=_banked_remat_policy())
+
+
+def _banked_act_policy(family: str = "cifar10_quick") -> str:
+    """The bytes-minimal SAFE activation-storage policy the numcheck
+    mixed-precision search banked in ``docs/num_contracts/
+    mixed_policy.json`` for ``family`` — the act twins route THIS
+    policy so the banked graph+mem+byte manifests pin the very
+    schedule ``Config.activation_dtype`` would run.  Deterministic
+    ``"blocks"`` fallback when the table is absent or predates the
+    family (first bank of a fresh clone; matches the common.py
+    ``"bf16" -> "blocks"`` alias)."""
+    import json
+    import pathlib
+
+    from sparknet_tpu.analysis.num_model import selected_act_policy
+
+    path = (pathlib.Path(__file__).resolve().parents[2]
+            / "docs" / "num_contracts" / "mixed_policy.json")
+    try:
+        table = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return "blocks"
+    return selected_act_policy(table, family, default="blocks")
+
+
+def _mode_solo_act_bf16(devices) -> TraceTarget:
+    """The activation-storage twin of solo: same family/batch/layout,
+    the forward built under the banked ``Config.activation_dtype``
+    policy — bf16 at the storage boundaries, every layer upcasting to
+    f32 before compute (accumulation stays f32, the numcheck
+    contract).  Storage changes residency and step bytes, never the
+    zero-collective comm contract."""
+    return _mode_solo(devices, name="solo_act_bf16",
+                      act=_banked_act_policy())
+
+
+def _mode_dp_act_bf16(devices) -> TraceTarget:
+    """tau=1 GSPMD DP under the banked activation-storage policy: the
+    comm contract is dp's exactly (storage narrows what the backward
+    READS, not what the mesh reduces — grads stay f32, the all-reduce
+    moves the same param bytes), plus the mem/byte twins pinning the
+    storage drop at width 8."""
+    return _trainer_target("dp_act_bf16", "cifar10_quick",
+                           _data_mesh(devices),
+                           act=_banked_act_policy())
 
 
 def _mode_mobilenet_dp(devices) -> TraceTarget:
@@ -694,10 +758,12 @@ MODES: dict[str, Callable] = {
     "solo_nhwc": _mode_solo_nhwc,
     "solo_fused": _mode_solo_fused,
     "solo_remat": _mode_solo_remat,
+    "solo_act_bf16": _mode_solo_act_bf16,
     "dp": _mode_dp,
     "dp_nhwc": _mode_dp_nhwc,
     "dp_fused": _mode_dp_fused,
     "dp_remat": _mode_dp_remat,
+    "dp_act_bf16": _mode_dp_act_bf16,
     "dp_bf16": _mode_dp_bf16,
     "tau": _mode_tau,
     "easgd": _mode_easgd,

@@ -67,7 +67,8 @@ def _fixture_feed(rs):
 def fused_off():
     """Restore the default config after any fused-arm test."""
     yield
-    set_config(fused_update=False, storage_dtype="f32")
+    set_config(fused_update=False, storage_dtype="f32",
+               activation_dtype="")
 
 
 # -- the six-rule equivalence sweep -----------------------------------------
@@ -172,8 +173,9 @@ def test_pad_zones_are_update_fixpoints(zoo_step_state):
 # -- the fused Solver path ---------------------------------------------------
 
 
-def _run_solver(fused, storage="f32", n=2, scan=0):
-    set_config(fused_update=fused, storage_dtype=storage)
+def _run_solver(fused, storage="f32", n=2, scan=0, act=""):
+    set_config(fused_update=fused, storage_dtype=storage,
+               activation_dtype=act)
     try:
         rs = np.random.RandomState(3)
         feed = _fixture_feed(rs)
@@ -188,7 +190,8 @@ def _run_solver(fused, storage="f32", n=2, scan=0):
         loss = solver.step(n, lambda it: feed)
         return loss, solver.variables
     finally:
-        set_config(fused_update=False, storage_dtype="f32")
+        set_config(fused_update=False, storage_dtype="f32",
+                   activation_dtype="")
 
 
 def test_fused_solver_step_matches_unfused():
@@ -218,6 +221,45 @@ def test_storage_bf16_arm_trains():
     # persistent state stays blob-wise f32 (dtype-invariant snapshots)
     for p in jax.tree_util.tree_leaves(vbf.params):
         assert p.dtype == jnp.float32
+
+
+def test_three_knob_composition_trains_and_restores(tmp_path):
+    """All three precision/fusion knobs stacked (fused arena update x
+    bf16 slot storage x bf16 activation storage): the composed solver
+    trains finite and loss-close to the all-off baseline, the lowered
+    step is iteration-stable (ONE compile covers it=0 and it=1 — the
+    act policy binds at trace time, never per step), persistent params
+    stay blob-wise f32, and the snapshot restores into a plain
+    all-knobs-off solver on the same trajectory."""
+    l32, _ = _run_solver(False)
+    rs = np.random.RandomState(3)
+    feed = _fixture_feed(rs)
+    set_config(fused_update=True, storage_dtype="bf16",
+               activation_dtype="blocks")
+    try:
+        solver = Solver(models.cifar10_quick_solver(),
+                        models.cifar10_quick(B))
+        fn, v, sl, key = solver.jitted_train_step(donate=False)
+        feeds = {k: jnp.asarray(x) for k, x in feed.items()}
+        v, sl, loss0 = fn(v, sl, 0, feeds, key)
+        v, sl, loss1 = fn(v, sl, 1, feeds, key)
+        assert fn._cache_size() == 1  # no per-step retrace
+        assert np.isfinite(loss0) and np.isfinite(loss1)
+
+        loss = solver.step(2, lambda it: feed)
+        assert np.isfinite(loss)
+        assert abs(loss - l32) < 0.05
+        for p in jax.tree_util.tree_leaves(solver.variables.params):
+            assert p.dtype == jnp.float32
+        snap = solver.save(str(tmp_path / "three_knob_snap"))
+    finally:
+        set_config(fused_update=False, storage_dtype="f32",
+                   activation_dtype="")
+    plain = Solver(models.cifar10_quick_solver(),
+                   models.cifar10_quick(B))
+    plain.restore(snap)
+    assert plain.iter == 2
+    assert np.isfinite(plain.step(1, lambda it: feed))
 
 
 def test_checkpoint_roundtrip_through_index_map(tmp_path):
@@ -324,6 +366,15 @@ def test_config_knobs_validate(fused_off):
     assert get_config().storage_dtype == "bf16"
     with pytest.raises(ValueError):
         set_config(storage_dtype="int8")
+    # the third knob (numcheck's activation-storage policy) validates
+    # through the same gate and defaults off
+    assert get_config().activation_dtype == ""
+    set_config(activation_dtype="bf16")  # dtype alias -> banked default
+    assert get_config().activation_dtype == "blocks"
+    set_config(activation_dtype="off")
+    assert get_config().activation_dtype == ""
+    with pytest.raises(ValueError):
+        set_config(activation_dtype="f16")
 
 
 @pytest.mark.smoke

@@ -46,6 +46,7 @@ EXPECTED_RULES = {
     "conc-manifest-fresh",
     "byte-manifest-fresh",
     "ctl-manifest-fresh",
+    "num-manifest-fresh",
 }
 
 
@@ -546,6 +547,83 @@ def test_byte_manifest_fresh_suppressed(tmp_path):
 def test_byte_manifest_fresh_clean_when_hash_matches(tmp_path):
     path = _byte_tree(tmp_path)
     assert not hits(FRESH_SRC, "byte-manifest-fresh", path=path)
+
+
+# -- num-manifest-fresh -----------------------------------------------------
+
+
+def _num_tree(tmp_path, src=FRESH_SRC, record=True, stale=False,
+              rel="sparknet_tpu/common.py"):
+    """A fake repo: one numerics-contract source file (+ optional
+    docs/num_contracts/SOURCES.json recording its hash).  Defaults to
+    common.py — num surface (the activation_dtype policy semantics)
+    but NOT byte surface, so the two rules stay distinguishable."""
+    import hashlib
+    import json as _json
+
+    mod = tmp_path.joinpath(*rel.split("/"))
+    mod.parent.mkdir(parents=True, exist_ok=True)
+    mod.write_text(src)
+    if record:
+        digest = hashlib.sha256(src.encode()).hexdigest()
+        if stale:
+            digest = "0" * 64
+        cdir = tmp_path / "docs" / "num_contracts"
+        cdir.mkdir(parents=True)
+        (cdir / "SOURCES.json").write_text(_json.dumps({rel: digest}))
+    return str(mod)
+
+
+def test_num_manifest_fresh_positive_on_stale_hash(tmp_path):
+    path = _num_tree(tmp_path, stale=True)
+    found = hits(FRESH_SRC, "num-manifest-fresh", path=path)
+    assert len(found) == 1
+    assert "num --update" in found[0].message
+
+
+def test_num_manifest_fresh_positive_when_never_banked(tmp_path):
+    path = _num_tree(tmp_path, record=False)
+    found = hits(FRESH_SRC, "num-manifest-fresh", path=path)
+    assert len(found) == 1
+    assert "SOURCES.json missing" in found[0].message
+
+
+def test_num_manifest_fresh_covers_common_py_unlike_byte(tmp_path):
+    # common.py carries the activation_dtype policy semantics: num
+    # surface, but deliberately NOT byte surface
+    path = _num_tree(tmp_path, record=False)
+    assert hits(FRESH_SRC, "num-manifest-fresh", path=path)
+    assert not hits(FRESH_SRC, "byte-manifest-fresh", path=path)
+
+
+def test_num_manifest_fresh_ignores_non_surface_files(tmp_path):
+    path = _num_tree(tmp_path, record=False,
+                     rel="sparknet_tpu/obs/report.py")
+    assert not hits(FRESH_SRC, "num-manifest-fresh", path=path)
+
+
+def test_num_manifest_fresh_suppressed(tmp_path):
+    path = _num_tree(tmp_path, stale=True)
+    src = ("# graftlint: disable-file=num-manifest-fresh -- "
+           "manifest regen follows in this PR\n" + FRESH_SRC)
+    assert not hits(src, "num-manifest-fresh", path=path)
+    assert suppressed_hits(src, "num-manifest-fresh", path=path)
+
+
+def test_num_manifest_fresh_clean_when_hash_matches(tmp_path):
+    path = _num_tree(tmp_path)
+    assert not hits(FRESH_SRC, "num-manifest-fresh", path=path)
+
+
+def test_num_manifest_fresh_surface_matches_numcheck():
+    # the rule duplicates numcheck.NUM_SOURCE_PATTERNS so rules.py
+    # stays importable without jax-adjacent modules; pin the two lists
+    # against each other so they cannot drift apart silently
+    from sparknet_tpu.analysis import rules
+    from sparknet_tpu.analysis.numcheck import NUM_SOURCE_PATTERNS
+
+    dup = set(rules._NUM_SOURCE_DIRS) | set(rules._NUM_SOURCE_FILES)
+    assert dup == set(NUM_SOURCE_PATTERNS)
 
 
 def test_graph_manifest_fresh_ignores_non_contract_files(tmp_path):

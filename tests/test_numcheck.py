@@ -1,0 +1,613 @@
+"""numcheck: per-defect fixtures + the banked num-contract smoke gate.
+
+Mirrors test_bytecheck.py for the sixth analysis engine: the contract
+rules are pinned from both sides on hand-built census records (a seeded
+bf16-accumulating dot and a smuggled f32->bf16 downcast each produce
+EXACTLY one finding), the jaxpr walk is validated against a real traced
+function with known dtype flow, the off-by-default path is the IDENTITY
+(the mechanism by which every banked graph/mem/byte manifest stays
+byte-unchanged with ``Config.activation_dtype`` off), the manifest loop
+round-trips bank/drift/allow, the mixed-precision search's winner
+selection, probe-order early exit, error-gate fallback, no-gain and
+monotonicity defects are pinned on fixtures, and the banked
+``mixed_policy.json`` headline (alexnet >= 15% modeled drop under the
+error gate) is asserted against the committed artifact.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from sparknet_tpu.analysis import numcheck as nc
+from sparknet_tpu.analysis.num_model import (
+    ACT_SEARCH_POLICIES,
+    MIXED_DROP_FLOOR,
+    accum_dtype,
+    act_monotonicity_violations,
+    census_problems,
+    error_gate,
+    is_narrow_float,
+    mixed_saved_bytes,
+    normalize_dtype,
+    selected_act_policy,
+    summarize_census,
+)
+from sparknet_tpu.analysis.numcheck import (
+    NUM_RULES,
+    run_mixed_search,
+    run_numcheck,
+    sources_fingerprint,
+)
+
+pytestmark = pytest.mark.smoke
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+F32_META = {"dtype": "f32"}
+STORAGE_META = {"dtype": "f32", "act": "blocks"}
+BF16_META = {"dtype": "bf16"}
+
+
+def _census(matmuls=(), reduces=(), casts=(), loss="f32"):
+    return {"matmuls": list(matmuls), "reduces": list(reduces),
+            "casts": list(casts), "loss_dtype": loss}
+
+
+# -- the dtype model --------------------------------------------------------
+
+
+def test_normalize_and_narrow():
+    assert normalize_dtype("float32") == "f32"
+    assert normalize_dtype("bfloat16") == "bf16"
+    assert normalize_dtype("weird") == "weird"
+    assert is_narrow_float("bf16") and is_narrow_float("float16")
+    assert not is_narrow_float("f32") and not is_narrow_float("s32")
+
+
+def test_accum_dtype_prefers_the_explicit_pin():
+    assert accum_dtype({"out": "bf16", "preferred": "f32"}) == "f32"
+    assert accum_dtype({"out": "bf16", "preferred": None}) == "bf16"
+
+
+# -- defect fixtures: exactly one finding each ------------------------------
+
+
+def test_bf16_accumulating_dot_is_exactly_one_finding():
+    # the seeded defect of ISSUE 20's acceptance: one dot pinning an
+    # explicit bf16 accumulator among otherwise-clean ops
+    census = _census(
+        matmuls=[
+            {"op": "dot_general", "operands": ["f32", "f32"],
+             "out": "f32", "preferred": None},
+            {"op": "dot_general", "operands": ["bf16", "bf16"],
+             "out": "bf16", "preferred": "bf16"},
+        ],
+        reduces=[{"op": "reduce_sum", "operand": "f32", "out": "f32"}],
+    )
+    problems = census_problems(census, F32_META)
+    assert len(problems) == 1
+    assert problems[0]["rule"] == "num-accum-dtype"
+    assert "matmul #1" in problems[0]["message"]
+
+
+def test_f32_to_bf16_downcast_ahead_of_loss_is_exactly_one_finding():
+    # the second seeded defect: a smuggled downcast in a mode with no
+    # bf16 arm configured
+    census = _census(
+        casts=[
+            {"src": "s32", "dst": "f32", "roundtrip": False},
+            {"src": "f32", "dst": "bf16", "roundtrip": False},
+        ],
+    )
+    problems = census_problems(census, F32_META)
+    assert len(problems) == 1
+    assert problems[0]["rule"] == "num-cast-downcast"
+    assert "cast #1" in problems[0]["message"]
+
+
+def test_downcast_is_licensed_by_a_configured_arm():
+    census = _census(
+        casts=[{"src": "f32", "dst": "bf16", "roundtrip": False}])
+    assert not census_problems(census, STORAGE_META)
+    assert not census_problems(census, BF16_META)
+
+
+def test_roundtrip_is_flagged_in_every_config():
+    census = _census(
+        casts=[{"src": "f32", "dst": "bf16", "roundtrip": True}])
+    for meta in (F32_META, STORAGE_META, BF16_META):
+        rules = [p["rule"] for p in census_problems(census, meta)]
+        assert "num-cast-roundtrip" in rules, meta
+
+
+def test_storage_config_narrow_operand_is_a_missed_upcast():
+    census = _census(
+        matmuls=[{"op": "conv_general_dilated",
+                  "operands": ["bf16", "f32"], "out": "f32",
+                  "preferred": "f32"}])
+    # under bf16 STORAGE the layer entry must have upcast first
+    problems = census_problems(census, STORAGE_META)
+    assert [p["rule"] for p in problems] == ["num-accum-dtype"]
+    # plain f32 mode: a narrow operand without storage config is not
+    # this rule's business (accumulation is f32)
+    assert not census_problems(census, F32_META)
+
+
+def test_storage_config_narrow_sum_reduce():
+    census = _census(
+        reduces=[
+            {"op": "reduce_sum", "operand": "bf16", "out": "bf16"},
+            {"op": "reduce_max", "operand": "bf16", "out": "bf16"},
+        ])
+    problems = census_problems(census, STORAGE_META)
+    # max reductions are rounding-free: only the sum is flagged
+    assert [p["rule"] for p in problems] == ["num-reduce-dtype"]
+    assert not census_problems(census, F32_META)
+
+
+def test_narrow_compute_mode_accumulates_narrow_by_design():
+    # dp_bf16's backward dots pin preferred=bf16 — the MXU-rate trade
+    # the mode exists to make; counts are drift-pinned, not flagged
+    census = _census(
+        matmuls=[{"op": "dot_general", "operands": ["bf16", "bf16"],
+                  "out": "bf16", "preferred": "bf16"}])
+    assert not census_problems(census, BF16_META)
+
+
+def test_loss_must_be_f32_in_every_config():
+    census = _census(loss="bf16")
+    for meta in (F32_META, STORAGE_META, BF16_META):
+        rules = [p["rule"] for p in census_problems(census, meta)]
+        assert rules == ["num-f32-pin"], meta
+    # forward-only programs (loss None) are exempt
+    assert not census_problems(_census(loss=None), F32_META)
+
+
+def test_summarize_census_counts():
+    census = _census(
+        matmuls=[
+            {"op": "dot_general", "operands": ["f32", "f32"],
+             "out": "f32", "preferred": None},
+            {"op": "dot_general", "operands": ["bf16", "bf16"],
+             "out": "bf16", "preferred": "bf16"},
+        ],
+        reduces=[
+            {"op": "reduce_sum", "operand": "bf16", "out": "bf16"},
+            {"op": "reduce_max", "operand": "f32", "out": "f32"},
+        ],
+        casts=[
+            {"src": "f32", "dst": "bf16", "roundtrip": False},
+            {"src": "bf16", "dst": "f32", "roundtrip": False},
+            {"src": "f32", "dst": "bf16", "roundtrip": True},
+        ])
+    s = summarize_census(census)
+    assert s["matmul"] == {"total": 2, "by_accum": {"f32": 1, "bf16": 1},
+                           "narrow_accum": 1, "narrow_operand": 1}
+    assert s["reduce"] == {"sum_total": 1, "sum_narrow_operand": 1,
+                           "other_total": 1}
+    assert s["cast"]["pairs"] == {"f32->bf16": 2, "bf16->f32": 1}
+    assert s["cast"]["roundtrips"] == 1
+    assert s["cast"]["float_downcasts"] == 2
+    assert s["loss_dtype"] == "f32"
+
+
+# -- the jaxpr walk on real programs ----------------------------------------
+
+
+def test_walk_records_dot_reduce_and_casts():
+    def f(x, w):
+        y = jnp.dot(x, w, preferred_element_type=jnp.float32)
+        return jnp.sum(y)
+
+    closed = jax.make_jaxpr(f)(
+        jnp.zeros((4, 8), jnp.bfloat16), jnp.zeros((8, 2), jnp.bfloat16))
+    census = nc._census_of(closed)
+    assert len(census["matmuls"]) == 1
+    rec = census["matmuls"][0]
+    assert rec["operands"] == ["bf16", "bf16"]
+    assert rec["preferred"] == "f32"
+    assert accum_dtype(rec) == "f32"
+    assert any(r["op"] == "reduce_sum" for r in census["reduces"])
+    assert census["loss_dtype"] == "f32"
+
+
+def test_walk_detects_the_compute_free_roundtrip():
+    def bad(x):
+        return x.astype(jnp.float32).astype(jnp.bfloat16)
+
+    census = nc._census_of(
+        jax.make_jaxpr(bad)(jnp.zeros((4,), jnp.bfloat16)))
+    assert sum(1 for c in census["casts"] if c["roundtrip"]) == 1
+
+    def good(x):
+        # compute between the casts: the f32 hop buys real precision
+        y = x.astype(jnp.float32)
+        return (y * y).astype(jnp.bfloat16)
+
+    census = nc._census_of(
+        jax.make_jaxpr(good)(jnp.zeros((4,), jnp.bfloat16)))
+    assert not any(c["roundtrip"] for c in census["casts"])
+
+
+def test_walk_recurses_into_sub_jaxprs():
+    def f(x):
+        def body(c, _):
+            return c @ c, jnp.sum(c).astype(jnp.bfloat16)
+
+        _, ys = jax.lax.scan(body, x, None, length=2)
+        return ys
+
+    census = nc._census_of(jax.make_jaxpr(f)(jnp.zeros((3, 3))))
+    assert census["matmuls"], "dot inside scan body must be censused"
+    assert any(normalize_dtype(c["dst"]) == "bf16"
+               for c in census["casts"])
+
+
+# -- the off path is the identity -------------------------------------------
+
+
+def test_activation_dtype_off_is_the_identity_path():
+    from sparknet_tpu.analysis.memcheck import _family_net
+    from sparknet_tpu.common import Phase, get_config, set_config
+    from sparknet_tpu.compiler.graph import NetVars, Network
+
+    net_param, _ = _family_net("cifar10_quick", 2)
+    net = Network(net_param, Phase.TRAIN)
+    variables = net.init(jnp.zeros((2,), jnp.uint32))
+    feeds = {n: jnp.zeros(s, jnp.int32 if n == "label" else jnp.float32)
+             for n, s in net.feed_shapes().items()}
+    rng = jnp.zeros((2,), jnp.uint32)
+
+    def trace(policy):
+        prior = get_config().activation_dtype
+        set_config(activation_dtype=policy)
+        try:
+            return str(jax.make_jaxpr(
+                lambda p: net.apply(
+                    NetVars(params=p, state=variables.state), feeds,
+                    rng, train=True)[2])(variables.params))
+        finally:
+            set_config(activation_dtype=prior)
+
+    base = trace("")
+    assert get_config().activation_dtype == ""  # off by default
+    assert trace("") == base  # idempotent
+    full = trace("full")
+    assert full != base
+    assert full.count("bfloat16") > base.count("bfloat16")
+
+
+def test_config_activation_dtype_validates_and_aliases():
+    from sparknet_tpu.common import (
+        act_storage_policy,
+        get_config,
+        set_config,
+    )
+
+    prior = get_config().activation_dtype
+    try:
+        set_config(activation_dtype="bf16")  # alias -> "blocks"
+        assert get_config().activation_dtype == "blocks"
+        set_config(activation_dtype="none")
+        assert get_config().activation_dtype == ""
+        with pytest.raises(ValueError):
+            set_config(activation_dtype="f8")
+        # the normalizing read guard: an unvalidated env seed cannot
+        # half-apply at the trace site
+        assert act_storage_policy("bfloat16") == "blocks"
+        with pytest.raises(ValueError):
+            act_storage_policy("garbage")
+    finally:
+        set_config(activation_dtype=prior)
+
+
+# -- mixed-policy arithmetic ------------------------------------------------
+
+
+def test_mixed_saved_bytes_hand_computation():
+    assert mixed_saved_bytes(1000, 400, 200, "none") == 1000
+    assert mixed_saved_bytes(1000, 400, 200, "full") == 500
+    assert mixed_saved_bytes(1000, 400, 200, "io") == 900
+    assert mixed_saved_bytes(1000, 400, 200, "blocks") == 800
+    # partial discounts clamp at the full floor
+    assert mixed_saved_bytes(1000, 5000, 200, "blocks") == 500
+    with pytest.raises(ValueError):
+        mixed_saved_bytes(1000, 0, 0, "nope")
+
+
+def test_act_monotonicity():
+    good = {"none": 100, "io": 90, "blocks": 80, "full": 50}
+    assert not act_monotonicity_violations(good)
+    bad = dict(good, full=95)
+    assert ("io", "full") in act_monotonicity_violations(bad)
+    assert ("blocks", "full") in act_monotonicity_violations(bad)
+
+
+def test_selected_act_policy_reader():
+    table = {"selected": {"alexnet": {"bf16": {"policy": "io"}}}}
+    assert selected_act_policy(table, "alexnet") == "io"
+    assert selected_act_policy(table, "vgg16") == "blocks"
+    assert selected_act_policy({}, "alexnet", default="full") == "full"
+    corrupt = {"selected": {"alexnet": {"bf16": {"policy": "nope"}}}}
+    assert selected_act_policy(corrupt, "alexnet") == "blocks"
+
+
+FIXED_CENSUS = {
+    "saved_bytes": 1_000_000, "boundary_bytes": 400_000,
+    "float_feed_bytes": 200_000, "params_bytes": 50_000,
+    "state_bytes": 0, "slots_bytes": 50_000, "feed_bytes": 60_000,
+}
+
+
+def _search(tmp_path, monkeypatch, census=FIXED_CENSUS, probe=0.001,
+            families=("alexnet",), update=False):
+    calls = []
+
+    def fake_probe(family, policy, batch=2):
+        calls.append(policy)
+        return probe if not callable(probe) else probe(policy)
+
+    monkeypatch.setattr(nc, "_family_mixed_census",
+                        lambda family, batch: dict(census))
+    monkeypatch.setattr(nc, "_error_probe", fake_probe)
+    findings, table = run_mixed_search(
+        update=update, banked_path=str(tmp_path / "mixed_policy.json"),
+        families=list(families))
+    return findings, table, calls
+
+
+def test_mixed_search_selects_bytes_minimal_safe_policy(
+        tmp_path, monkeypatch):
+    findings, table, calls = _search(tmp_path, monkeypatch)
+    sel = table["selected"]["alexnet"]["bf16"]
+    assert sel["policy"] == "full"
+    assert sel["drop_frac_vs_f32"] > MIXED_DROP_FLOOR
+    # ascending-bytes probe order stops at the first safe policy:
+    # "full" models the fewest bytes, passes, nothing else is probed
+    assert calls == ["full"]
+    assert not [f for f in findings if f.rule != "num-manifest-missing"]
+
+
+def test_mixed_search_error_gate_falls_back_to_none(tmp_path, monkeypatch):
+    findings, table, calls = _search(tmp_path, monkeypatch, probe=0.9)
+    sel = table["selected"]["alexnet"]["bf16"]
+    assert sel["policy"] == "none"
+    assert sel["drop_frac_vs_f32"] == 0.0
+    # every storage policy was probed (and failed) before the fallback
+    assert set(calls) == {"io", "blocks", "full"}
+    # "none" on the headline family means no gain: the defect fires
+    assert "num-mixed-no-gain" in [f.rule for f in findings]
+
+
+def test_mixed_search_no_gain_defect_fixture(tmp_path, monkeypatch):
+    # saved activations are a rounding error next to params: even the
+    # "full" winner cannot clear the headline drop floor
+    census = dict(FIXED_CENSUS, saved_bytes=10, boundary_bytes=4,
+                  float_feed_bytes=2, params_bytes=10_000_000)
+    findings, table, _ = _search(tmp_path, monkeypatch, census=census)
+    assert table["selected"]["alexnet"]["bf16"]["policy"] == "full"
+    assert [f.rule for f in findings
+            if f.rule == "num-mixed-no-gain"] == ["num-mixed-no-gain"]
+
+
+def test_mixed_search_nonmonotonic_defect_fixture(tmp_path, monkeypatch):
+    def doctored(saved, boundary, feed, policy):
+        return {"none": 100, "io": 90, "blocks": 80, "full": 95}[policy]
+
+    monkeypatch.setattr(nc, "mixed_saved_bytes", doctored)
+    findings, _, _ = _search(tmp_path, monkeypatch)
+    assert "num-mixed-nonmonotonic" in [f.rule for f in findings]
+
+
+def test_mixed_search_non_headline_family_skips_the_drop_gate(
+        tmp_path, monkeypatch):
+    census = dict(FIXED_CENSUS, saved_bytes=10, boundary_bytes=4,
+                  float_feed_bytes=2, params_bytes=10_000_000)
+    findings, _, _ = _search(tmp_path, monkeypatch, census=census,
+                             families=("vgg16",))
+    assert "num-mixed-no-gain" not in [f.rule for f in findings]
+
+
+def test_mixed_search_banks_and_rereads(tmp_path, monkeypatch):
+    _search(tmp_path, monkeypatch, update=True)
+    banked = json.loads((tmp_path / "mixed_policy.json").read_text())
+    assert banked["selected"]["alexnet"]["bf16"]["policy"] == "full"
+    assert banked["policies"] == list(ACT_SEARCH_POLICIES)
+    assert selected_act_policy(banked, "alexnet") == "full"
+    # a second non-update run diffs clean against the bank
+    findings, _, _ = _search(tmp_path, monkeypatch)
+    assert not [f for f in findings if not f.suppressed]
+
+
+# -- manifest loop ----------------------------------------------------------
+
+
+def test_manifest_bank_diff_and_allow(tmp_path, monkeypatch):
+    findings, _ = run_numcheck(["moe"], banked_dir=str(tmp_path))
+    assert [f.rule for f in findings] == ["num-manifest-missing"]
+
+    findings, manifests = run_numcheck(["moe"], banked_dir=str(tmp_path),
+                                       update=True)
+    assert not findings
+    mpath = tmp_path / "moe.json"
+    assert mpath.exists()
+    # no SOURCES.json on a partial or non-default-dir run
+    assert not (tmp_path / "SOURCES.json").exists()
+
+    # clean re-run diffs empty
+    findings, _ = run_numcheck(["moe"], banked_dir=str(tmp_path))
+    assert not findings
+
+    # doctor the banked contract -> drift; allow-map suppresses it
+    banked = json.loads(mpath.read_text())
+    banked["contract"]["matmul"]["total"] += 1
+    mpath.write_text(json.dumps(banked))
+    findings, _ = run_numcheck(["moe"], banked_dir=str(tmp_path))
+    assert [f.rule for f in findings] == ["num-manifest-drift"]
+    assert not findings[0].suppressed
+    banked["allow"] = {"num-manifest-drift": "fixture"}
+    mpath.write_text(json.dumps(banked))
+    findings, _ = run_numcheck(["moe"], banked_dir=str(tmp_path))
+    assert [f.rule for f in findings] == ["num-manifest-drift"]
+    assert findings[0].suppressed
+
+
+def test_unknown_mode_raises_keyerror():
+    with pytest.raises(KeyError):
+        run_numcheck(["no-such-mode"])
+
+
+# -- the banked artifacts (the committed contract) --------------------------
+
+
+def test_banked_manifests_cover_every_mode():
+    from sparknet_tpu.parallel.modes import list_modes
+
+    cdir = os.path.join(ROOT, "docs", "num_contracts")
+    for mode in list_modes():
+        assert os.path.exists(os.path.join(cdir, f"{mode}.json")), mode
+    assert os.path.exists(os.path.join(cdir, "SOURCES.json"))
+
+
+def test_banked_mixed_policy_headline_acceptance():
+    # ISSUE 20 acceptance: the alexnet/bf16 winner drops modeled step
+    # bytes >= 15% vs the f32-activation baseline AND passes the
+    # error-probe gate
+    path = os.path.join(ROOT, "docs", "num_contracts",
+                        "mixed_policy.json")
+    table = json.loads(open(path, encoding="utf-8").read())
+    sel = table["selected"]["alexnet"]["bf16"]
+    assert sel["policy"] in ACT_SEARCH_POLICIES and sel["policy"] != "none"
+    assert sel["drop_frac_vs_f32"] >= MIXED_DROP_FLOOR
+    assert sel["probe_error"] <= sel["error_gate"] == error_gate("alexnet")
+
+
+def test_banked_act_policy_reader_routes_the_table():
+    from sparknet_tpu.parallel.modes import _banked_act_policy
+
+    path = os.path.join(ROOT, "docs", "num_contracts",
+                        "mixed_policy.json")
+    table = json.loads(open(path, encoding="utf-8").read())
+    assert _banked_act_policy("alexnet") == \
+        table["selected"]["alexnet"]["bf16"]["policy"]
+
+
+def test_act_twins_are_registered_with_the_banked_policy():
+    from sparknet_tpu.parallel.modes import build_target, list_modes
+
+    assert "solo_act_bf16" in list_modes()
+    assert "dp_act_bf16" in list_modes()
+    target = build_target("solo_act_bf16")
+    assert target.meta["act"] in ("io", "blocks", "full")
+    assert target.meta["dtype"] == "f32"
+
+
+# -- fingerprints + rule surface --------------------------------------------
+
+
+def test_sources_fingerprint_covers_the_contract_surface():
+    fp = sources_fingerprint()
+    assert "sparknet_tpu/analysis/numcheck.py" in fp
+    assert "sparknet_tpu/analysis/num_model.py" in fp
+    assert "sparknet_tpu/common.py" in fp
+    assert "sparknet_tpu/compiler/graph.py" in fp
+    for rel, digest in fp.items():
+        assert os.path.exists(os.path.join(ROOT, rel)), rel
+        assert len(digest) == 64
+
+
+def test_rule_catalog():
+    assert set(dict(nc.iter_rules())) == set(NUM_RULES)
+    expected = {
+        "num-accum-dtype", "num-reduce-dtype", "num-f32-pin",
+        "num-cast-roundtrip", "num-cast-downcast", "num-mixed-no-gain",
+        "num-mixed-nonmonotonic", "num-manifest-missing",
+        "num-manifest-drift",
+    }
+    assert set(NUM_RULES) == expected
+
+
+# -- CLI --------------------------------------------------------------------
+
+
+def test_cli_num_json_schema(tmp_path, capsys, monkeypatch):
+    from sparknet_tpu.analysis.__main__ import main as cli_main
+
+    monkeypatch.setattr(nc, "MANIFEST_DIR", str(tmp_path))
+    rc = cli_main(["num", "--mode", "moe", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1  # nothing banked yet
+    assert out["findings"][0]["rule"] == "num-manifest-missing"
+
+    rc = cli_main(["num", "--mode", "moe", "--update"])
+    assert rc == 0
+    capsys.readouterr()
+    rc = cli_main(["num", "--mode", "moe", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["unsuppressed"] == 0
+
+
+def test_cli_num_unknown_mode_is_usage_error(capsys):
+    from sparknet_tpu.analysis.__main__ import main as cli_main
+
+    assert cli_main(["num", "--mode", "no-such-mode"]) == 2
+
+
+def test_cli_num_list_rules(capsys):
+    from sparknet_tpu.analysis.__main__ import main as cli_main
+
+    assert cli_main(["num", "--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in NUM_RULES:
+        assert rule_id in out
+
+
+# -- `analysis all` (the meta-subcommand) -----------------------------------
+
+
+def test_all_engines_lists_all_six():
+    import sparknet_tpu.analysis.__main__ as am
+
+    labels = [label for label, _ in am._all_engines()]
+    assert labels == ["graftlint", "conccheck", "graphcheck",
+                      "memcheck", "bytecheck", "numcheck"]
+
+
+def test_cli_all_merges_and_exits_once(capsys, monkeypatch):
+    import sparknet_tpu.analysis.__main__ as am
+    from sparknet_tpu.analysis.core import Finding
+
+    hit = Finding("stub-rule", "x.py", 1, "a stub finding")
+    ok = Finding("stub-ok", "y.py", 2, "suppressed", suppressed=True)
+    monkeypatch.setattr(am, "_all_engines", lambda: [
+        ("alpha", lambda: [hit]),
+        ("beta", lambda: [ok]),
+    ])
+    rc = am.main(["all", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["unsuppressed"] == 1 and out["suppressed"] == 1
+    assert {f["rule"] for f in out["findings"]} == {"stub-rule", "stub-ok"}
+
+    monkeypatch.setattr(am, "_all_engines",
+                        lambda: [("alpha", lambda: [ok])])
+    assert am.main(["all", "--json"]) == 0
+    capsys.readouterr()
+
+
+def test_cli_all_engine_crash_is_not_masked(capsys, monkeypatch):
+    import sparknet_tpu.analysis.__main__ as am
+
+    def boom():
+        raise RuntimeError("engine exploded")
+
+    monkeypatch.setattr(am, "_all_engines", lambda: [
+        ("alpha", boom),
+        ("beta", lambda: []),
+    ])
+    rc = am.main(["all"])
+    assert rc == 1
+    assert "CRASHED" in capsys.readouterr().err
